@@ -1,0 +1,68 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+Provides the capabilities of the reference data-parallel framework
+(Horovod; see SURVEY.md) re-designed for TPU: the eager data plane is
+jitted XLA collectives over the ICI mesh, the compiled path is pjit/
+shard_map sharding (see horovod_tpu.parallel), and the job machinery
+(launcher, elastic, autotune, timeline) is re-built around TPU-VM slices.
+
+Public API shape follows the reference's per-framework modules
+(reference: horovod/torch/mpi_ops.py, horovod/common/basics.py).
+"""
+
+from .version import __version__  # noqa: F401
+
+from .basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mesh, is_homogeneous, mpi_enabled, mpi_built,
+    gloo_enabled, gloo_built, nccl_built, ddl_built, ccl_built, cuda_built,
+    rocm_built, xla_built, mpi_threads_supported,
+)
+from .exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
+    DuplicateNameError, StalledTensorError,
+)
+from .ops.reduce_ops import (  # noqa: F401
+    Average, Sum, Adasum, Min, Max, Product,
+)
+from .ops.compression import Compression  # noqa: F401
+from .ops.collectives import (  # noqa: F401
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
+    grouped_allreduce_async_,
+    allgather, allgather_async, grouped_allgather, grouped_allgather_async,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async, grouped_reducescatter,
+    grouped_reducescatter_async,
+    barrier, join, poll, synchronize,
+)
+from .process_sets import (  # noqa: F401
+    ProcessSet, global_process_set, add_process_set, remove_process_set,
+)
+from .functions import (  # noqa: F401
+    broadcast_object, broadcast_parameters, broadcast_optimizer_state,
+    broadcast_variables, allgather_object,
+)
+
+
+def start_timeline(file_path, mark_cycles=False):
+    """Start recording a Chrome-trace timeline at runtime (reference:
+    horovod/common/basics.py:156 start_timeline)."""
+    from . import basics
+    from .timeline import Timeline
+    rt = basics.runtime()
+    if rt.timeline is not None:
+        rt.timeline.stop()
+    rt.timeline = Timeline(file_path)
+    rt.timeline.start()
+
+
+def stop_timeline():
+    """Stop the runtime timeline (reference: horovod/common/basics.py
+    stop_timeline)."""
+    from . import basics
+    rt = basics.runtime()
+    if rt.timeline is not None:
+        rt.timeline.stop()
+        rt.timeline = None

@@ -1,0 +1,92 @@
+"""Autotuning parameter manager.
+
+The reference tunes fusion-threshold / cycle-time / cache knobs with
+Gaussian-process Bayesian optimization (reference:
+horovod/common/parameter_manager.cc, optim/bayesian_optimization.cc),
+scoring each candidate by observed bytes/sec and broadcasting winners.
+
+On TPU the dominant knobs are the same two — fusion threshold and cycle
+time — but the search space is small, so we use a deterministic
+coordinate-descent sweep over a discrete grid (the reference's categorical
+mode, parameter_manager.h:59-78) scored by coordinator bytes/sec. Results
+can be logged to HVDTPU_AUTOTUNE_LOG like the reference's
+HOROVOD_AUTOTUNE_LOG (reference: operations.cc:588-592).
+"""
+
+import time
+
+from .utils import envparse
+from .utils.logging_util import get_logger
+
+# Discrete candidate grids (reference sweeps similar ranges).
+FUSION_CANDIDATES = [0, 1, 2, 4, 8, 16, 32, 64, 128]      # MiB
+CYCLE_CANDIDATES = [0.1, 0.5, 1.0, 2.5, 5.0, 10.0]        # ms
+WARMUP_SAMPLES = 3
+SAMPLES_PER_CANDIDATE = 10
+
+
+class ParameterManager:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.enabled = True
+        self._log = get_logger()
+        self._log_path = envparse.get_str(envparse.AUTOTUNE_LOG, "")
+        self._samples = 0
+        self._warmup_left = WARMUP_SAMPLES
+        self._grid = [(f * 1024 * 1024, c)
+                      for f in FUSION_CANDIDATES for c in CYCLE_CANDIDATES]
+        self._idx = 0
+        self._scores = {}
+        self._last_bytes = 0
+        self._last_time = time.monotonic()
+        self._best = None
+
+    def record_cycle(self):
+        """Called by the coordinator once per cycle; measures bytes/sec for
+        the active candidate and advances the sweep."""
+        if not self.enabled:
+            return
+        coord = self.runtime.coordinator
+        now = time.monotonic()
+        elapsed = now - self._last_time
+        if elapsed < 0.05:
+            return
+        score = (coord.bytes_processed - self._last_bytes) / elapsed
+        self._last_bytes = coord.bytes_processed
+        self._last_time = now
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            if self._warmup_left == 0:
+                # Start measuring under the first candidate's actual knobs.
+                self._apply(self._grid[0])
+            return
+        self._samples += 1
+        cand = self._grid[self._idx]
+        self._scores.setdefault(cand, []).append(score)
+        if self._samples >= SAMPLES_PER_CANDIDATE:
+            self._samples = 0
+            self._advance()
+
+    def _advance(self):
+        self._idx += 1
+        if self._idx >= len(self._grid):
+            best = max(self._scores,
+                       key=lambda c: sum(self._scores[c]) / len(self._scores[c]))
+            self._apply(best)
+            self._best = best
+            self.enabled = False
+            self._log.info("autotune converged: fusion=%dB cycle=%.2fms",
+                           best[0], best[1])
+            if self._log_path:
+                with open(self._log_path, "a") as f:
+                    for cand, scores in self._scores.items():
+                        f.write(f"{cand[0]},{cand[1]},"
+                                f"{sum(scores)/len(scores):.1f}\n")
+            return
+        self._apply(self._grid[self._idx])
+
+    def _apply(self, cand):
+        fusion, cycle_ms = cand
+        coord = self.runtime.coordinator
+        coord.fusion_threshold = max(fusion, 1)
+        coord.cycle_time_s = cycle_ms / 1000.0

@@ -1,0 +1,121 @@
+"""Eager-collective data-plane backends.
+
+The reference dispatches each collective to a priority-ordered chain of
+backends (NCCL/MPI/Gloo/oneCCL, reference: horovod/common/ops/
+operation_manager.cc:42-80). On TPU there is one first-class data plane —
+XLA collectives over ICI — plus a TCP fallback for CPU-only SPMD jobs (the
+gloo analog) and a loopback for world-size-1:
+
+- ``XlaSingleBackend``: single-controller mode; every op is a jitted XLA
+  program over the replica mesh (see xla_backend.py).
+- ``TcpBackend``: N-process CPU data plane over sockets, backed by the
+  native C++ runtime (see tcp_backend.py).
+- ``LoopbackBackend``: world size 1.
+"""
+
+from abc import ABC, abstractmethod
+
+
+class Backend(ABC):
+    """Interface executed by the coordinator's background cycle.
+
+    Grouped/fused entry points take *lists* of arrays so one call can carry a
+    whole fusion bucket (the analog of the reference's fused response,
+    reference: horovod/common/controller.cc:808 FuseResponses).
+    """
+
+    name = "abstract"
+
+    @abstractmethod
+    def allreduce(self, arrays, op, process_set, prescale=None,
+                  postscale=None):
+        """Reduce each array across ranks. Returns list of results."""
+
+    @abstractmethod
+    def allgather(self, arrays, process_set):
+        """Concatenate each array across ranks along dim 0."""
+
+    @abstractmethod
+    def broadcast(self, arrays, root_rank, process_set):
+        """Every rank receives root_rank's value."""
+
+    @abstractmethod
+    def alltoall(self, array, splits, process_set):
+        """Scatter slices of dim 0 to every rank; returns (output, recv_splits)."""
+
+    @abstractmethod
+    def reducescatter(self, arrays, op, process_set):
+        """Reduce then scatter dim-0 chunks across ranks."""
+
+    @abstractmethod
+    def barrier(self, process_set):
+        """Block until every rank arrives (reference: EnqueueBarrier,
+        horovod/common/operations.cc:1763)."""
+
+    def register_process_set(self, process_set):
+        pass
+
+    def remove_process_set(self, process_set):
+        pass
+
+    def close(self):
+        pass
+
+
+class LoopbackBackend(Backend):
+    """World-size-1 SPMD backend: collectives are identities (after scaling)."""
+
+    name = "loopback"
+
+    def allreduce(self, arrays, op, process_set, prescale=None,
+                  postscale=None):
+        import jax.numpy as jnp
+        outs = []
+        for a in arrays:
+            x = jnp.asarray(a)
+            if prescale is not None and prescale != 1.0:
+                x = x * jnp.asarray(prescale, dtype=x.dtype)
+            if postscale is not None and postscale != 1.0:
+                x = x * jnp.asarray(postscale, dtype=x.dtype)
+            outs.append(x)
+        return outs
+
+    def allgather(self, arrays, process_set):
+        import jax.numpy as jnp
+        return [jnp.asarray(a) for a in arrays]
+
+    def broadcast(self, arrays, root_rank, process_set):
+        import jax.numpy as jnp
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return [jnp.asarray(a) for a in arrays]
+
+    def alltoall(self, array, splits, process_set):
+        import jax.numpy as jnp
+        import numpy as np
+        x = jnp.asarray(array)
+        if splits is None:
+            splits = np.array([x.shape[0]], dtype=np.int32)
+        return x, np.asarray(splits, dtype=np.int32)
+
+    def reducescatter(self, arrays, op, process_set):
+        import jax.numpy as jnp
+        return [jnp.asarray(a) for a in arrays]
+
+    def barrier(self, process_set):
+        pass
+
+
+def make_spmd_backend(topology):
+    """Pick the SPMD data plane like the reference picks its op chain
+    (reference: horovod/common/operations.cc:144-253 CreateOperationManager).
+    """
+    if topology.size == 1:
+        return LoopbackBackend()
+    try:
+        from .tcp_backend import TcpBackend
+    except ImportError as e:
+        raise NotImplementedError(
+            "Multi-process SPMD mode requires the TCP data-plane backend "
+            f"(horovod_tpu/backend/tcp_backend.py): {e}") from e
+    return TcpBackend(topology)

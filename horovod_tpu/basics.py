@@ -1,0 +1,289 @@
+"""Runtime singleton: topology discovery, mesh construction, init/shutdown.
+
+Design (TPU-first rethink of the reference's HorovodGlobalState +
+InitializeHorovodOnce, reference: horovod/common/operations.cc:811,
+horovod/common/global_state.h):
+
+The reference runs one process per accelerator and negotiates collectives
+between processes over MPI/Gloo. On TPU the natural unit is a **device mesh**
+driven by one controller process per host (or one for the whole slice), with
+collectives compiled by XLA onto ICI. This runtime therefore supports two
+execution modes:
+
+- ``single`` (single-controller): one Python process owns all visible TPU
+  chips. Every chip is a *virtual rank*: ``size()`` is the chip count, eager
+  collectives operate on arrays stacked along a leading virtual-rank axis and
+  lower to one jitted XLA collective over the 1-D replica mesh. This is the
+  primary TPU path — the data plane is entirely compiled, the coordination
+  machinery only batches and orders work.
+
+- ``spmd`` (launcher-spawned): N processes, Horovod-identical semantics.
+  ``rank()``/``size()`` come from launcher env vars (analog of
+  HOROVOD_RANK/SIZE, reference: horovod/runner/gloo_run.py:65-77), and the
+  eager data plane runs over the TCP backend (CPU fallback, gloo-analog) or
+  the global XLA backend (multi-host TPU via jax.distributed).
+"""
+
+import atexit
+import os
+import threading
+
+import jax
+import numpy as np
+
+from .exceptions import NotInitializedError
+from .utils import envparse
+from .utils.logging_util import get_logger
+
+MODE_SINGLE = "single"
+MODE_SPMD = "spmd"
+
+
+class Topology:
+    """Process-level topology (reference: rank/size/local/cross getters,
+    horovod/common/basics.py:183-264)."""
+
+    def __init__(self, rank, size, local_rank, local_size, cross_rank,
+                 cross_size):
+        self.rank = rank
+        self.size = size
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
+
+    @classmethod
+    def from_env(cls):
+        rank = envparse.get_int(envparse.RANK, 0)
+        size = envparse.get_int(envparse.SIZE, 1)
+        local_rank = envparse.get_int(envparse.LOCAL_RANK, rank)
+        local_size = envparse.get_int(envparse.LOCAL_SIZE, size)
+        cross_rank = envparse.get_int(envparse.CROSS_RANK, 0)
+        cross_size = envparse.get_int(envparse.CROSS_SIZE, 1)
+        return cls(rank, size, local_rank, local_size, cross_rank, cross_size)
+
+
+class Runtime:
+    """Owns topology, mesh, backend, coordinator and process-set table."""
+
+    def __init__(self, mode, topology, backend, mesh, devices):
+        self.mode = mode
+        self.topology = topology
+        self.backend = backend
+        self.mesh = mesh            # 1-D jax Mesh over the replica axis 'hvd'
+        self.devices = devices      # list of jax devices backing the mesh
+        self.process_set_table = None   # attached by process_sets._setup
+        self.coordinator = None         # attached by coordinator.start
+        self.timeline = None            # attached by timeline module on demand
+        self.autotuner = None
+        self._shutdown = False
+
+    @property
+    def size(self):
+        if self.mode == MODE_SINGLE:
+            return len(self.devices)
+        return self.topology.size
+
+    @property
+    def rank(self):
+        return self.topology.rank
+
+    def check_alive(self):
+        if self._shutdown:
+            raise NotInitializedError("Runtime was shut down; operations")
+
+
+_runtime = None
+_init_lock = threading.Lock()
+
+
+def _select_devices():
+    """All addressable devices form the replica mesh."""
+    return list(jax.local_devices())
+
+
+def _make_replica_mesh(devices):
+    return jax.sharding.Mesh(np.array(devices), ("hvd",))
+
+
+def init(comm=None, process_sets=None):
+    """Initialize the runtime (idempotent; reference: horovod_init,
+    horovod/common/operations.cc:889).
+
+    Args:
+      comm: ignored (MPI communicators do not exist on TPU); accepted for
+        signature compatibility with the reference.
+      process_sets: optional list of ProcessSet objects to materialize at
+        startup (reference: horovod/common/basics.py:48 ``init`` takes
+        process_sets).
+    """
+    global _runtime
+    with _init_lock:
+        if _runtime is not None and not _runtime._shutdown:
+            # Re-sync process sets like the reference's re-init path.
+            from . import process_sets as ps_mod
+            ps_mod._setup(_runtime, process_sets or [])
+            return _runtime
+
+        log = get_logger()
+        topology = Topology.from_env()
+        spmd = (envparse.get_env(envparse.SIZE) is not None
+                and topology.size >= 1
+                and envparse.get_env(envparse.RANK) is not None)
+
+        if spmd:
+            from .backend import make_spmd_backend
+            backend = make_spmd_backend(topology)
+            devices = _select_devices()
+            mesh = _make_replica_mesh(devices[:1])
+            runtime = Runtime(MODE_SPMD, topology, backend, mesh, devices)
+            log.info("init: spmd mode rank=%d size=%d backend=%s",
+                     topology.rank, topology.size, backend.name)
+        else:
+            from .backend.xla_backend import XlaSingleBackend
+            devices = _select_devices()
+            mesh = _make_replica_mesh(devices)
+            backend = XlaSingleBackend(mesh)
+            runtime = Runtime(MODE_SINGLE, topology, backend, mesh, devices)
+            log.info("init: single-controller mode, %d device(s) on mesh",
+                     len(devices))
+
+        from . import process_sets as ps_mod
+        ps_mod._setup(runtime, process_sets or [])
+
+        from .coordinator import Coordinator
+        runtime.coordinator = Coordinator(runtime)
+        runtime.coordinator.start()
+
+        if envparse.get_bool(envparse.AUTOTUNE):
+            from .autotune import ParameterManager
+            runtime.autotuner = ParameterManager(runtime)
+
+        timeline_path = envparse.get_str(envparse.TIMELINE, "")
+        if timeline_path:
+            from .timeline import Timeline
+            runtime.timeline = Timeline(timeline_path)
+            runtime.timeline.start()
+
+        _runtime = runtime
+        return _runtime
+
+
+def shutdown():
+    """Tear down the runtime (reference: horovod_shutdown,
+    horovod/common/operations.cc)."""
+    global _runtime
+    with _init_lock:
+        if _runtime is None:
+            return
+        if _runtime.coordinator is not None:
+            _runtime.coordinator.stop()
+        if _runtime.timeline is not None:
+            _runtime.timeline.stop()
+        if _runtime.backend is not None:
+            _runtime.backend.close()
+        from . import process_sets as ps_mod
+        ps_mod._teardown()
+        _runtime._shutdown = True
+        _runtime = None
+
+
+atexit.register(shutdown)
+
+
+def is_initialized():
+    return _runtime is not None and not _runtime._shutdown
+
+
+def runtime():
+    if _runtime is None or _runtime._shutdown:
+        raise NotInitializedError()
+    return _runtime
+
+
+def rank():
+    return runtime().topology.rank
+
+
+def size():
+    return runtime().size
+
+
+def local_rank():
+    return runtime().topology.local_rank
+
+
+def local_size():
+    rt = runtime()
+    if rt.mode == MODE_SINGLE:
+        return len(rt.devices)
+    return rt.topology.local_size
+
+
+def cross_rank():
+    return runtime().topology.cross_rank
+
+
+def cross_size():
+    return runtime().topology.cross_size
+
+
+def mesh():
+    """The 1-D replica mesh (axis name 'hvd') for in-jit collectives."""
+    return runtime().mesh
+
+
+def is_homogeneous():
+    """True when every host has the same number of slots (reference:
+    horovod_is_homogeneous, horovod/common/operations.cc)."""
+    rt = runtime()
+    if rt.mode == MODE_SINGLE:
+        return True
+    return rt.topology.size == rt.topology.local_size * rt.topology.cross_size
+
+
+# Build-feature queries: kept for API parity with the reference
+# (horovod/torch/mpi_ops.py:55-63). On TPU the data plane is XLA.
+def mpi_enabled():
+    return False
+
+
+def mpi_built():
+    return False
+
+
+def gloo_enabled():
+    # Our TCP backend is the gloo-analog CPU data plane.
+    return True
+
+
+def gloo_built():
+    return True
+
+
+def nccl_built():
+    return False
+
+
+def ddl_built():
+    return False
+
+
+def ccl_built():
+    return False
+
+
+def cuda_built():
+    return False
+
+
+def rocm_built():
+    return False
+
+
+def xla_built():
+    return True
+
+
+def mpi_threads_supported():
+    return False

@@ -1,0 +1,54 @@
+"""Framework exceptions.
+
+Mirrors the exception taxonomy of the reference framework
+(reference: horovod/common/exceptions.py) so elastic training loops can be
+written the same way: a recoverable collective failure raises
+``HorovodInternalError`` and a membership change raises
+``HostsUpdatedInterrupt``; both are caught by ``elastic.run``.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective routine fails.
+
+    Recoverable via elastic mode: the training loop restores the last
+    committed state and re-initializes (reference: horovod/common/elastic.py:151).
+    """
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised when the set of participating hosts/devices changed.
+
+    In elastic mode the driver notifies workers of host-set changes; the
+    worker raises this at the next commit/state-check boundary
+    (reference: horovod/common/exceptions.py, horovod/common/elastic.py:57).
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class HorovodVersionMismatchError(ImportError):
+    """Library/extension version mismatch (reference: horovod/common/exceptions.py)."""
+
+
+class NotInitializedError(RuntimeError):
+    """An API that requires ``init()`` was called before initialization."""
+
+    def __init__(self, what="Collective operations"):
+        super().__init__(
+            f"{what} called before init(); call horovod_tpu.init() first.")
+
+
+class DuplicateNameError(ValueError):
+    """Two in-flight tensors share a name within one process set.
+
+    Matches the reference's DUPLICATE_NAME_ERROR surfaced by the tensor queue
+    (reference: horovod/common/common.h:229, tensor_queue.cc).
+    """
+
+
+class StalledTensorError(RuntimeError):
+    """A named tensor was submitted by some ranks but not all within the stall
+    timeout (reference: horovod/common/stall_inspector.cc:26)."""

@@ -1,0 +1,134 @@
+"""High-level convenience collectives on Python objects and parameter trees.
+
+Mirrors the reference helpers (reference: horovod/torch/functions.py:269,
+horovod/tensorflow/functions.py:66-177): object (de)serialization rides the
+byte-tensor broadcast/allgather path; parameter-tree sync broadcasts every
+leaf from a root rank in one grouped (fused) operation.
+
+Object-level collectives operate at **process** granularity: in
+single-controller mode there is exactly one process that owns all virtual
+ranks, so object broadcast/allgather degenerate to identity/[obj] — the
+model state is global by construction (the key simplification of the
+single-controller TPU design).
+"""
+
+import io
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import basics
+from .ops import reduce_ops
+from .ops.collectives import (allgather, broadcast, grouped_allreduce,
+                              synchronize, broadcast_async)
+from .process_sets import global_process_set
+
+
+def broadcast_object(obj, root_rank=0, name=None,
+                     process_set=global_process_set):
+    """Serialize and broadcast an arbitrary object from root_rank
+    (reference: horovod/torch/functions.py broadcast_object — serialized
+    bytes broadcast as a uint8 tensor preceded by its length)."""
+    rt = basics.runtime()
+    if rt.mode == basics.MODE_SINGLE:
+        return obj
+    name = name or "broadcast_object"
+    if rt.topology.rank == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+        length = np.array([payload.size], dtype=np.int64)
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
+        length = np.array([0], dtype=np.int64)
+    length = np.asarray(broadcast(jnp.asarray(length), root_rank,
+                                  name=f"{name}.len",
+                                  process_set=process_set))
+    if rt.topology.rank != root_rank:
+        payload = np.zeros(int(length[0]), dtype=np.uint8)
+    payload = np.asarray(broadcast(jnp.asarray(payload), root_rank,
+                                   name=f"{name}.data",
+                                   process_set=process_set))
+    return pickle.loads(payload.tobytes())
+
+
+def allgather_object(obj, name=None, process_set=global_process_set):
+    """Gather arbitrary objects from every rank into a list (reference:
+    horovod/tensorflow/functions.py:177 allgather_object)."""
+    rt = basics.runtime()
+    if rt.mode == basics.MODE_SINGLE:
+        return [obj]
+    name = name or "allgather_object"
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    sizes = np.asarray(allgather(jnp.asarray(
+        np.array([payload.size], dtype=np.int64)),
+        name=f"{name}.sizes", process_set=process_set))
+    gathered = np.asarray(allgather(jnp.asarray(payload),
+                                    name=f"{name}.data",
+                                    process_set=process_set))
+    objs, off = [], 0
+    for s in sizes:
+        objs.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
+        off += int(s)
+    return objs
+
+
+def broadcast_variables(params, root_rank=0, process_set=global_process_set):
+    """Broadcast every leaf of a parameter pytree from root_rank (reference:
+    horovod/tensorflow/functions.py:66 broadcast_variables,
+    horovod/torch/functions.py broadcast_parameters).
+
+    Single-controller mode: parameters are a single global pytree already —
+    returns them unchanged (there is no divergent replica copy to overwrite).
+    SPMD mode: each leaf is broadcast, fused into as few collectives as the
+    fusion threshold allows.
+    """
+    rt = basics.runtime()
+    if rt.mode == basics.MODE_SINGLE:
+        return params
+    leaves, treedef = jax.tree.flatten(params)
+    handles = [broadcast_async(jnp.asarray(leaf), root_rank,
+                               name=f"broadcast_variables.{i}",
+                               process_set=process_set)
+               for i, leaf in enumerate(leaves)]
+    out = [synchronize(h) for h in handles]
+    return jax.tree.unflatten(treedef, out)
+
+
+# Reference naming aliases (torch flavor).
+broadcast_parameters = broadcast_variables
+
+
+def broadcast_optimizer_state(opt_state, root_rank=0,
+                              process_set=global_process_set):
+    """Broadcast an optimizer-state pytree (reference:
+    horovod/torch/functions.py broadcast_optimizer_state). Works for any
+    optax state: non-array leaves ride the object path."""
+    rt = basics.runtime()
+    if rt.mode == basics.MODE_SINGLE:
+        return opt_state
+
+    def is_array(x):
+        # Strings and other non-numeric scalars ride the object path.
+        return isinstance(x, (jax.Array, np.ndarray, int, float, complex,
+                              bool, np.number))
+
+    leaves, treedef = jax.tree.flatten(opt_state)
+    array_idx = [i for i, l in enumerate(leaves) if is_array(l)]
+    obj_idx = [i for i, l in enumerate(leaves) if not is_array(l)]
+    if array_idx:
+        synced = broadcast_variables([jnp.asarray(leaves[i])
+                                      for i in array_idx],
+                                     root_rank, process_set)
+        for i, v in zip(array_idx, synced):
+            leaves[i] = v
+    if obj_idx:
+        objs = broadcast_object([leaves[i] for i in obj_idx], root_rank,
+                                process_set=process_set)
+        for i, v in zip(obj_idx, objs):
+            leaves[i] = v
+    return jax.tree.unflatten(treedef, leaves)

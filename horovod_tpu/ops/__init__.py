@@ -1,0 +1,1 @@
+from .reduce_ops import Sum, Average, Adasum, Min, Max, Product  # noqa: F401

@@ -1,0 +1,301 @@
+"""Eager named-tensor collective API.
+
+Mirrors the reference's handle-based async API (reference:
+horovod/torch/mpi_ops.py:107-976) with JAX arrays. Arrays are immutable, so
+the reference's in-place variants (``allreduce_`` etc.) are aliases that
+return the new array.
+
+Input conventions by runtime mode (see basics.py):
+
+- ``spmd``: the tensor is this process's local value — Horovod-identical.
+- ``single`` (single-controller TPU): the tensor carries every virtual
+  rank's value stacked along a leading axis of length ``size()``; outputs
+  are stacked the same way. For ragged per-rank shapes (allgather), pass a
+  list of per-rank arrays instead.
+"""
+
+import threading
+
+import jax.numpy as jnp
+
+from .. import basics
+from ..coordinator import TensorEntry
+from ..process_sets import global_process_set
+from . import reduce_ops
+from .compression import Compression
+
+_name_counter = [0]
+_name_lock = threading.Lock()
+
+
+def _auto_name(kind):
+    with _name_lock:
+        _name_counter[0] += 1
+        return f"{kind}.noname.{_name_counter[0]}"
+
+
+def _submit(entry):
+    rt = basics.runtime()
+    rt.check_alive()
+    return rt.coordinator.submit(entry)
+
+
+def _check_stacked(tensor, process_set, kind):
+    rt = basics.runtime()
+    if rt.mode == basics.MODE_SINGLE:
+        n = len(process_set.ranks)
+        if tensor.ndim == 0 or tensor.shape[0] != n:
+            raise ValueError(
+                f"{kind}: in single-controller mode the input must be "
+                f"stacked with leading axis == process set size ({n}); got "
+                f"shape {tensor.shape}. Each slice i is virtual rank i's "
+                "tensor.")
+
+
+# --------------------------------------------------------------------------
+# allreduce
+# --------------------------------------------------------------------------
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=global_process_set):
+    """Async allreduce; returns a Handle (reference:
+    horovod/torch/mpi_ops.py:154)."""
+    op = reduce_ops.handle_average_backwards_compatibility(op, average)
+    tensor = jnp.asarray(tensor)
+    _check_stacked(tensor, process_set, "allreduce")
+    entry = TensorEntry(name or _auto_name("allreduce"), "allreduce",
+                        [tensor], process_set, op=op,
+                        prescale=prescale_factor, postscale=postscale_factor)
+    return _submit(entry)
+
+
+def allreduce(tensor, average=None, name=None, compression=Compression.none,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    """Blocking allreduce (reference: horovod/torch/mpi_ops.py:211)."""
+    tensor = jnp.asarray(tensor)
+    compressed, ctx = compression.compress(tensor)
+    handle = allreduce_async(compressed, average, name, op, prescale_factor,
+                             postscale_factor, process_set)
+    return compression.decompress(synchronize(handle), ctx)
+
+
+# JAX arrays are immutable: the reference's in-place spellings return the
+# reduced array (reference: horovod/torch/mpi_ops.py:255,290).
+allreduce_async_ = allreduce_async
+allreduce_ = allreduce
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=global_process_set):
+    """Grouped allreduce: the group is fused atomically — one compiled
+    collective for all tensors (reference: horovod/torch/mpi_ops.py:375 +
+    group_table.cc semantics)."""
+    op = reduce_ops.handle_average_backwards_compatibility(op, average)
+    arrays = [jnp.asarray(t) for t in tensors]
+    for a in arrays:
+        _check_stacked(a, process_set, "grouped_allreduce")
+    entry = TensorEntry(name or _auto_name("grouped_allreduce"), "allreduce",
+                        arrays, process_set, op=op,
+                        prescale=prescale_factor, postscale=postscale_factor)
+    return _submit(entry)
+
+
+def grouped_allreduce(tensors, average=None, name=None,
+                      compression=Compression.none, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    compressed, ctxs = [], []
+    for t in tensors:
+        c, ctx = compression.compress(jnp.asarray(t))
+        compressed.append(c)
+        ctxs.append(ctx)
+    handle = grouped_allreduce_async(compressed, average, name, op,
+                                     prescale_factor, postscale_factor,
+                                     process_set)
+    outputs = synchronize(handle)
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    return [compression.decompress(o, ctx)
+            for o, ctx in zip(outputs, ctxs)]
+
+
+grouped_allreduce_async_ = grouped_allreduce_async
+grouped_allreduce_ = grouped_allreduce
+
+
+# --------------------------------------------------------------------------
+# allgather
+# --------------------------------------------------------------------------
+def allgather_async(tensor, name=None, process_set=global_process_set):
+    """Async allgather (reference: horovod/torch/mpi_ops.py:596). In
+    single-controller mode pass a list of per-rank arrays for ragged
+    first-dim gathering."""
+    rt = basics.runtime()
+    if isinstance(tensor, (list, tuple)):
+        if rt.mode != basics.MODE_SINGLE:
+            raise ValueError("List input to allgather is only meaningful in "
+                             "single-controller mode")
+        arrays = [jnp.asarray(t) for t in tensor]
+        if len(arrays) != len(process_set.ranks):
+            raise ValueError(
+                f"allgather list input must have one tensor per rank "
+                f"({len(process_set.ranks)}), got {len(arrays)}")
+        entry = TensorEntry(name or _auto_name("allgather"), "allgather",
+                            arrays, process_set, uneven=True)
+    else:
+        tensor = jnp.asarray(tensor)
+        _check_stacked(tensor, process_set, "allgather")
+        entry = TensorEntry(name or _auto_name("allgather"), "allgather",
+                            [tensor], process_set)
+    return _submit(entry)
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+def grouped_allgather_async(tensors, name=None,
+                            process_set=global_process_set):
+    arrays = [jnp.asarray(t) for t in tensors]
+    for a in arrays:
+        _check_stacked(a, process_set, "grouped_allgather")
+    entry = TensorEntry(name or _auto_name("grouped_allgather"), "allgather",
+                        arrays, process_set)
+    return _submit(entry)
+
+
+def grouped_allgather(tensors, name=None, process_set=global_process_set):
+    out = synchronize(grouped_allgather_async(tensors, name, process_set))
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# --------------------------------------------------------------------------
+# broadcast
+# --------------------------------------------------------------------------
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=global_process_set):
+    """Async broadcast from root_rank (reference:
+    horovod/torch/mpi_ops.py:685)."""
+    tensor = jnp.asarray(tensor)
+    _check_stacked(tensor, process_set, "broadcast")
+    n = len(process_set.ranks)
+    if not 0 <= root_rank < n:
+        raise ValueError(f"root_rank {root_rank} out of range [0, {n})")
+    entry = TensorEntry(name or _auto_name("broadcast"), "broadcast",
+                        [tensor], process_set, root_rank=root_rank)
+    return _submit(entry)
+
+
+def broadcast(tensor, root_rank, name=None, process_set=global_process_set):
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+broadcast_async_ = broadcast_async
+broadcast_ = broadcast
+
+
+# --------------------------------------------------------------------------
+# alltoall
+# --------------------------------------------------------------------------
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=global_process_set):
+    """Async alltoall (reference: horovod/torch/mpi_ops.py:824). ``splits``
+    partitions dim 0 per destination rank; in single-controller mode a
+    (n, n) matrix gives each virtual rank its own splits row."""
+    tensor = jnp.asarray(tensor)
+    _check_stacked(tensor, process_set, "alltoall")
+    entry = TensorEntry(name or _auto_name("alltoall"), "alltoall",
+                        [tensor], process_set, splits=splits)
+    return _submit(entry)
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    """Blocking alltoall; returns output or (output, received_splits) when
+    splits was provided (reference: horovod/torch/mpi_ops.py:880)."""
+    out, recv_splits = synchronize(
+        alltoall_async(tensor, splits, name, process_set))
+    if splits is None:
+        return out
+    return out, recv_splits
+
+
+# --------------------------------------------------------------------------
+# reducescatter
+# --------------------------------------------------------------------------
+def reducescatter_async(tensor, op=reduce_ops.Average, name=None,
+                        process_set=global_process_set):
+    """Async reduce-scatter (reference: horovod/tensorflow reducescatter +
+    ReducescatterOp in ops/collective_operations.cc).
+
+    Single-controller output shape: when dim0 of the per-rank tensor divides
+    evenly by the set size the result is stacked (n, s0/n, ...); otherwise
+    ranks receive unequal chunks (earlier ranks take the remainder, matching
+    the reference) and the result is a list of n per-rank arrays."""
+    tensor = jnp.asarray(tensor)
+    _check_stacked(tensor, process_set, "reducescatter")
+    entry = TensorEntry(name or _auto_name("reducescatter"), "reducescatter",
+                        [tensor], process_set, op=op)
+    return _submit(entry)
+
+
+def reducescatter(tensor, op=reduce_ops.Average, name=None,
+                  process_set=global_process_set):
+    return synchronize(reducescatter_async(tensor, op, name, process_set))
+
+
+def grouped_reducescatter_async(tensors, op=reduce_ops.Average, name=None,
+                                process_set=global_process_set):
+    arrays = [jnp.asarray(t) for t in tensors]
+    for a in arrays:
+        _check_stacked(a, process_set, "grouped_reducescatter")
+    entry = TensorEntry(name or _auto_name("grouped_reducescatter"),
+                        "reducescatter", arrays, process_set, op=op)
+    return _submit(entry)
+
+
+def grouped_reducescatter(tensors, op=reduce_ops.Average, name=None,
+                          process_set=global_process_set):
+    out = synchronize(grouped_reducescatter_async(tensors, op, name,
+                                                  process_set))
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# --------------------------------------------------------------------------
+# barrier / join / handles
+# --------------------------------------------------------------------------
+def barrier(process_set=global_process_set):
+    """Block until all ranks reach the barrier (reference:
+    horovod/torch/mpi_ops.py:976)."""
+    entry = TensorEntry(_auto_name("barrier"), "barrier", [], process_set)
+    synchronize(_submit(entry))
+
+
+def join(device=-1):
+    """Signal this rank has no more work; returns the last joined rank
+    (reference: horovod/torch/mpi_ops.py:954 + EnqueueJoin,
+    horovod/common/operations.cc:1729). In single-controller mode every
+    virtual rank is driven by this process, so join degenerates to a
+    barrier."""
+    rt = basics.runtime()
+    if rt.mode == basics.MODE_SINGLE:
+        barrier()
+        return rt.size - 1
+    if hasattr(rt.backend, "join"):
+        return rt.backend.join(device)
+    barrier()
+    return rt.size - 1
+
+
+def poll(handle):
+    """True when the async op backing ``handle`` completed (reference:
+    horovod/torch/mpi_ops.py:914)."""
+    return handle.poll()
+
+
+def synchronize(handle):
+    """Wait for an async op and return its result (reference:
+    horovod/torch/mpi_ops.py:930)."""
+    return handle.wait()

@@ -1,0 +1,74 @@
+"""Gradient compression (reference: horovod/torch/compression.py,
+horovod/tensorflow/compression.py — same 74-line API in both bindings).
+
+On TPU the natural wire format is bfloat16 (MXU-native); fp16 is kept for
+parity with the reference.
+"""
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context) needed to decompress."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast float tensors to fp16 before the collective, back after."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.float16:
+            return tensor.astype(jnp.float16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native compression: bfloat16 keeps fp32 dynamic range and is the
+    MXU's preferred operand type (no reference analog; TPU value-add)."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.bfloat16:
+            return tensor.astype(jnp.bfloat16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class Compression:
+    """Optional gradient compression algorithms used during allreduce."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,50 @@
+"""Reduction-op constants.
+
+Numeric values follow the reference's ReduceOp enum exposed through the C API
+(reference: horovod/common/operations.cc horovod_reduce_op_sum/average/adasum,
+horovod/torch/mpi_ops.py:78-81) extended with Min/Max/Product which the
+reference exposes for its TensorFlow binding.
+"""
+
+Average = 0
+Sum = 1
+Adasum = 2
+Min = 3
+Max = 4
+Product = 5
+
+_NAMES = {
+    Average: "Average",
+    Sum: "Sum",
+    Adasum: "Adasum",
+    Min: "Min",
+    Max: "Max",
+    Product: "Product",
+}
+
+
+def op_name(op):
+    return _NAMES.get(op, f"Unknown({op})")
+
+
+def check_op(op):
+    if op not in _NAMES:
+        raise ValueError(f"Unknown reduction op: {op}")
+    return op
+
+
+def handle_average_backwards_compatibility(op, average):
+    """Reconcile the legacy ``average=`` flag with ``op=``.
+
+    Mirrors the reference helper (reference: horovod/common/util.py
+    get_average_backwards_compatibility_fun): specifying both is an error;
+    ``average=True`` maps to Average, ``average=False`` to Sum.
+    """
+    if op is not None:
+        if average is not None:
+            raise ValueError("The op parameter supersedes average. Please "
+                             "provide only one of them.")
+        return op
+    if average is not None:
+        return Average if average else Sum
+    return Average

@@ -1,0 +1,176 @@
+"""Process sets: concurrent sub-communicators.
+
+API mirrors the reference (reference: horovod/common/process_sets.py:18-145,
+horovod/common/process_set.cc). On TPU a process set maps onto a subset of
+the replica mesh: in single-controller mode the "ranks" are virtual ranks
+(device indices into the global replica mesh) and each set owns its own
+sub-mesh, so collectives on disjoint sets compile into independent XLA
+programs over disjoint ICI domains.
+"""
+
+import threading
+
+import numpy as np
+
+from .exceptions import NotInitializedError
+
+
+class ProcessSet:
+    """A set of ranks able to run collectives among themselves."""
+
+    process_set_id = None
+
+    def __init__(self, ranks_or_comm):
+        self.ranks = sorted(int(r) for r in ranks_or_comm)
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ValueError("Duplicate ranks in process set")
+        self.mesh = None        # sub-mesh, attached on materialization
+
+    def _invalidate(self):
+        self.process_set_id = None
+        self.mesh = None
+
+    def size(self):
+        if self.process_set_id is None:
+            return None
+        return len(self.ranks)
+
+    def rank(self):
+        """This process's rank within the set, or None if not included.
+
+        In single-controller mode the controlling process is a member of
+        every set (it owns all virtual ranks) and this returns 0.
+        """
+        if self.process_set_id is None:
+            return None
+        from . import basics
+        rt = basics.runtime()
+        if rt.mode == basics.MODE_SINGLE:
+            return 0
+        try:
+            return self.ranks.index(rt.topology.rank)
+        except ValueError:
+            return None
+
+    def included(self):
+        if self.process_set_id is None:
+            return None
+        from . import basics
+        rt = basics.runtime()
+        if rt.mode == basics.MODE_SINGLE:
+            return True
+        return rt.topology.rank in self.ranks
+
+    def __eq__(self, other):
+        return (type(self) == type(other)
+                and self.process_set_id == other.process_set_id
+                and self.ranks == other.ranks)
+
+    def __hash__(self):
+        return hash((self.process_set_id, tuple(self.ranks)))
+
+    def __str__(self):
+        return f"ProcessSet(process_set_id={self.process_set_id}, ranks={self.ranks})"
+
+
+class _ProcessSetTable:
+    """Id-indexed registry (reference: horovod/common/process_set.h:89-171)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_id = {}
+        self._next_id = 0
+
+    def register(self, ps, runtime):
+        with self._lock:
+            for existing in self._by_id.values():
+                if existing.ranks == ps.ranks:
+                    raise ValueError(
+                        f"A process set with ranks {ps.ranks} already exists "
+                        f"(id={existing.process_set_id})")
+            ps.process_set_id = self._next_id
+            self._next_id += 1
+            self._materialize(ps, runtime)
+            self._by_id[ps.process_set_id] = ps
+            return ps
+
+    def _materialize(self, ps, runtime):
+        from . import basics
+        world = runtime.size
+        for r in ps.ranks:
+            if not 0 <= r < world:
+                raise ValueError(
+                    f"Rank {r} in process set out of range [0, {world})")
+        if runtime.mode == basics.MODE_SINGLE:
+            sub_devices = [runtime.devices[r] for r in ps.ranks]
+            import jax
+            ps.mesh = jax.sharding.Mesh(np.array(sub_devices), ("hvd",))
+        else:
+            ps.mesh = runtime.mesh
+        runtime.backend.register_process_set(ps)
+
+    def remove(self, ps, runtime):
+        with self._lock:
+            if ps.process_set_id is None:
+                return
+            if ps.process_set_id == 0:
+                raise ValueError("Cannot remove the global process set")
+            self._by_id.pop(ps.process_set_id, None)
+            runtime.backend.remove_process_set(ps)
+            ps._invalidate()
+
+    def get(self, set_id):
+        with self._lock:
+            return self._by_id.get(set_id)
+
+    def all(self):
+        with self._lock:
+            return list(self._by_id.values())
+
+
+global_process_set = ProcessSet([])
+
+
+def _setup(runtime, extra_sets):
+    """Materialize the global set and any user sets (called from init;
+    reference: horovod/common/process_sets.py:99 _init_process_sets)."""
+    table = runtime.process_set_table
+    if table is None:
+        table = _ProcessSetTable()
+        runtime.process_set_table = table
+    if global_process_set.process_set_id is None:
+        global_process_set.ranks = list(range(runtime.size))
+        table.register(global_process_set, runtime)
+    for ps in extra_sets:
+        if ps.process_set_id is None:
+            table.register(ps, runtime)
+
+
+def add_process_set(process_set):
+    """Add a new process set after init (reference:
+    horovod/common/process_sets.py:123)."""
+    from . import basics
+    rt = basics.runtime()
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    return rt.process_set_table.register(process_set, rt)
+
+
+def remove_process_set(process_set):
+    """Remove a process set (reference: horovod/common/process_sets.py:145)."""
+    from . import basics
+    rt = basics.runtime()
+    rt.process_set_table.remove(process_set, rt)
+    return True
+
+
+def process_set_by_id(set_id):
+    from . import basics
+    ps = basics.runtime().process_set_table.get(set_id)
+    if ps is None:
+        raise ValueError(f"No process set with id {set_id}")
+    return ps
+
+
+def _teardown():
+    global_process_set._invalidate()

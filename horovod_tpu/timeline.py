@@ -1,0 +1,89 @@
+"""Chrome-tracing timeline (reference: horovod/common/timeline.cc/.h).
+
+The reference feeds a lock-free SPSC queue drained by a writer thread
+(reference: timeline.h:48-100); events move through NEGOTIATING → TOP_LEVEL →
+ACTIVITY states. Here the coordinator emits begin/end activity events into a
+thread-safe queue and a writer thread streams Chrome ``trace_event`` JSON.
+Runtime start/stop mirrors hvd.start_timeline/stop_timeline
+(reference: horovod/common/basics.py:156, operations.cc:1032-1064).
+"""
+
+import json
+import queue
+import threading
+import time
+
+
+class Timeline:
+    def __init__(self, path):
+        self.path = path
+        self._queue = queue.Queue()
+        self._thread = None
+        self._running = False
+        self._file = None
+        self._first = True
+        self._pids = {}
+
+    # -- producer side (coordinator) --------------------------------------
+    def begin(self, names, activity):
+        if self._running:
+            self._queue.put(("B", tuple(names), activity,
+                             time.perf_counter_ns() // 1000))
+
+    def end(self, names, activity):
+        if self._running:
+            self._queue.put(("E", tuple(names), activity,
+                             time.perf_counter_ns() // 1000))
+
+    def marker(self, name):
+        if self._running:
+            self._queue.put(("I", (name,), name,
+                             time.perf_counter_ns() // 1000))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._running:
+            return
+        self._file = open(self.path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._running = True
+        self._thread = threading.Thread(target=self._writer,
+                                        name="hvd-tpu-timeline", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if not self._running:
+            return
+        self._running = False
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        try:
+            self._file.write("\n]\n")
+            self._file.close()
+        except (OSError, ValueError):
+            pass
+
+    # -- writer thread -----------------------------------------------------
+    def _emit(self, event):
+        if not self._first:
+            self._file.write(",\n")
+        self._first = False
+        self._file.write(json.dumps(event))
+
+    def _writer(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            phase, names, activity, ts_us = item
+            for name in names:
+                tid = self._pids.setdefault(name, len(self._pids) + 1)
+                if phase == "I":
+                    self._emit({"name": activity, "ph": "i", "ts": ts_us,
+                                "pid": 0, "tid": tid, "s": "g"})
+                else:
+                    self._emit({"name": activity, "cat": "hvd",
+                                "ph": phase, "ts": ts_us, "pid": 0,
+                                "tid": tid, "args": {"tensor": name}})
+            self._file.flush()

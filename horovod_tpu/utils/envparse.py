@@ -1,0 +1,80 @@
+"""Environment-variable driven configuration.
+
+The reference framework is configured exclusively through environment
+variables (reference: horovod/common/common.h:107-141, utils/env_parser.cc).
+We keep the same model: every runtime knob has an ``HVDTPU_*`` name and, for
+drop-in compatibility with scripts written for the reference, the matching
+``HOROVOD_*`` name is accepted as a fallback.
+"""
+
+import os
+
+_PREFIXES = ("HVDTPU_", "HOROVOD_")
+
+
+def get_env(name, default=None):
+    """Look up knob ``name`` (without prefix) under HVDTPU_ then HOROVOD_."""
+    for prefix in _PREFIXES:
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def get_int(name, default=0):
+    val = get_env(name)
+    if val is None or val == "":
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name, default=0.0):
+    val = get_env(name)
+    if val is None or val == "":
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+def get_bool(name, default=False):
+    val = get_env(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_str(name, default=""):
+    val = get_env(name)
+    return default if val is None else val
+
+
+# Canonical knob names (subset of reference common.h:107-141, plus TPU-native ones)
+FUSION_THRESHOLD = "FUSION_THRESHOLD"          # bytes, default 128 MiB
+CYCLE_TIME = "CYCLE_TIME"                      # ms, default 1.0
+CACHE_CAPACITY = "CACHE_CAPACITY"              # default 1024
+TIMELINE = "TIMELINE"                          # path to chrome-trace json
+LOG_LEVEL = "LOG_LEVEL"
+STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
+STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
+STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
+AUTOTUNE = "AUTOTUNE"
+AUTOTUNE_LOG = "AUTOTUNE_LOG"
+ELASTIC = "ELASTIC"
+
+# Launcher-set topology env (analog of HOROVOD_RANK/SIZE/...; reference:
+# horovod/runner/gloo_run.py:65-77)
+RANK = "RANK"
+SIZE = "SIZE"
+LOCAL_RANK = "LOCAL_RANK"
+LOCAL_SIZE = "LOCAL_SIZE"
+CROSS_RANK = "CROSS_RANK"
+CROSS_SIZE = "CROSS_SIZE"
+RENDEZVOUS_ADDR = "RENDEZVOUS_ADDR"            # analog of HOROVOD_GLOO_RENDEZVOUS_ADDR
+RENDEZVOUS_PORT = "RENDEZVOUS_PORT"
+CONTROLLER = "CONTROLLER"                      # 'tcp' | 'loopback'
+CPU_OPERATIONS = "CPU_OPERATIONS"              # 'tcp' | 'xla'

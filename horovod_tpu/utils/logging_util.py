@@ -1,0 +1,37 @@
+"""Rank-prefixed leveled logging (analog of reference horovod/common/logging.cc).
+
+Controlled by HVDTPU_LOG_LEVEL / HOROVOD_LOG_LEVEL: trace/debug/info/warning/error.
+"""
+
+import logging
+import sys
+
+from . import envparse
+
+_LEVELS = {
+    "trace": logging.DEBUG - 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(logging.DEBUG - 5, "TRACE")
+
+_logger = None
+
+
+def get_logger():
+    global _logger
+    if _logger is None:
+        _logger = logging.getLogger("horovod_tpu")
+        level_name = envparse.get_str(envparse.LOG_LEVEL, "warning").lower()
+        _logger.setLevel(_LEVELS.get(level_name, logging.WARNING))
+        if not _logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [hvd-tpu] %(message)s"))
+            _logger.addHandler(handler)
+        _logger.propagate = False
+    return _logger

@@ -1,0 +1,39 @@
+"""Test fixtures: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's CI strategy of simulating distribution on localhost
+(reference: .buildkite/gen-pipeline.sh runs parallel tests at np=2 on one
+machine). Here "multi-chip" is 8 virtual XLA CPU devices
+(xla_force_host_platform_device_count), which exercises the same shard_map/
+collective code paths the TPU mesh uses.
+"""
+
+import os
+import sys
+
+# Must happen before the first JAX backend initialization.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (if present) force-selects itself; tests always run on
+# the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    return len(jax.devices())

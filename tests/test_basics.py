@@ -1,0 +1,55 @@
+"""Runtime init/topology tests (reference analog: rank/size assertions at
+the top of test/parallel/test_tensorflow.py:128+)."""
+
+import jax
+import numpy as np
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_topology_single_mode(hvd, n_devices):
+    assert hvd.size() == n_devices == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == n_devices
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_mesh(hvd, n_devices):
+    mesh = hvd.mesh()
+    assert mesh.axis_names == ("hvd",)
+    assert mesh.devices.size == n_devices
+
+
+def test_feature_queries(hvd):
+    assert hvd.xla_built()
+    assert hvd.gloo_built()       # TCP backend is the gloo analog
+    assert not hvd.nccl_built()
+    assert not hvd.cuda_built()
+    assert not hvd.mpi_built()
+
+
+def test_global_process_set(hvd, n_devices):
+    from horovod_tpu.process_sets import global_process_set
+    assert global_process_set.process_set_id == 0
+    assert global_process_set.size() == n_devices
+    assert global_process_set.included()
+    assert global_process_set.rank() == 0
+
+
+def test_not_initialized_error():
+    import horovod_tpu.basics as basics
+    from horovod_tpu.exceptions import NotInitializedError
+    saved = basics._runtime
+    basics._runtime = None
+    try:
+        with pytest.raises(NotInitializedError):
+            basics.runtime()
+    finally:
+        basics._runtime = saved

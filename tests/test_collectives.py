@@ -1,0 +1,304 @@
+"""Collective-semantics tests on the 8-device virtual mesh.
+
+Modeled on the reference's parallel suites (reference:
+test/parallel/test_torch.py, test/parallel/test_tensorflow.py): random
+per-rank tensors, rank-dependent values, grouped ops, process sets, error
+propagation. Virtual-rank stacked semantics: input axis 0 is the rank axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.exceptions import DuplicateNameError
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    pass
+
+
+def rand(n, *shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(-1, 1, size=(n,) + shape).astype(dtype)
+
+
+# -- allreduce -------------------------------------------------------------
+def test_allreduce_sum(hvd, n_devices):
+    x = rand(n_devices, 16, 5)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    expect = np.broadcast_to(x.sum(axis=0), x.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_allreduce_average_default(hvd, n_devices):
+    x = rand(n_devices, 33)
+    out = np.asarray(hvd.allreduce(x))
+    expect = np.broadcast_to(x.mean(axis=0), x.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_allreduce_min_max(hvd, n_devices):
+    x = rand(n_devices, 7, 3)
+    out_min = np.asarray(hvd.allreduce(x, op=hvd.Min))
+    out_max = np.asarray(hvd.allreduce(x, op=hvd.Max))
+    np.testing.assert_allclose(out_min,
+                               np.broadcast_to(x.min(axis=0), x.shape))
+    np.testing.assert_allclose(out_max,
+                               np.broadcast_to(x.max(axis=0), x.shape))
+
+
+def test_allreduce_product(hvd, n_devices):
+    x = rand(n_devices, 9) * 0.5 + 1.0
+    out = np.asarray(hvd.allreduce(x, op=hvd.Product))
+    np.testing.assert_allclose(out,
+                               np.broadcast_to(np.prod(x, axis=0), x.shape),
+                               rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale(hvd, n_devices):
+    x = rand(n_devices, 11)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                                   postscale_factor=4.0))
+    expect = np.broadcast_to((x * 0.5).sum(axis=0) * 4.0, x.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_allreduce_average_backwards_compat(hvd, n_devices):
+    x = rand(n_devices, 4)
+    out = np.asarray(hvd.allreduce(x, average=False))
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(axis=0), x.shape),
+                               rtol=1e-5)
+    with pytest.raises(ValueError):
+        hvd.allreduce(x, average=True, op=hvd.Sum)
+
+
+def test_allreduce_int_dtype(hvd, n_devices):
+    x = np.arange(n_devices * 6, dtype=np.int32).reshape(n_devices, 6)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    np.testing.assert_array_equal(out,
+                                  np.broadcast_to(x.sum(axis=0), x.shape))
+
+
+def test_allreduce_async_poll(hvd, n_devices):
+    x = rand(n_devices, 5)
+    handle = hvd.allreduce_async(x, op=hvd.Sum)
+    result = hvd.synchronize(handle)
+    assert hvd.poll(handle)
+    np.testing.assert_allclose(np.asarray(result),
+                               np.broadcast_to(x.sum(axis=0), x.shape),
+                               rtol=1e-5)
+
+
+def test_allreduce_compression_fp16(hvd, n_devices):
+    x = rand(n_devices, 64)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum,
+                                   compression=hvd.Compression.fp16))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(axis=0), x.shape),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_grouped_allreduce(hvd, n_devices):
+    xs = [rand(n_devices, 8, seed=i) for i in range(4)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    assert len(outs) == 4
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.broadcast_to(x.sum(axis=0), x.shape),
+                                   rtol=1e-5)
+
+
+def test_fusion_many_small_tensors(hvd, n_devices):
+    """Many async submissions fused in one cycle (reference fusion:
+    horovod/common/controller.cc:808)."""
+    xs = [rand(n_devices, 3, seed=100 + i) for i in range(32)]
+    handles = [hvd.allreduce_async(x, op=hvd.Sum, name=f"fuse.{i}")
+               for i, x in enumerate(xs)]
+    for x, h in zip(xs, handles):
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   np.broadcast_to(x.sum(axis=0), x.shape),
+                                   rtol=1e-5)
+
+
+def test_adasum_allreduce(hvd, n_devices):
+    def pair(a, b):
+        dot = np.sum(a * b)
+        na = np.sum(a * a)
+        nb = np.sum(b * b)
+        return (1 - dot / (2 * na)) * a + (1 - dot / (2 * nb)) * b
+
+    x = rand(n_devices, 10)
+    xs = [x[i] for i in range(n_devices)]
+    dist = 1
+    while dist < n_devices:
+        for i in range(0, n_devices, 2 * dist):
+            xs[i] = pair(xs[i], xs[i + dist])
+        dist *= 2
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    np.testing.assert_allclose(out, np.broadcast_to(xs[0], x.shape),
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- allgather -------------------------------------------------------------
+def test_allgather(hvd, n_devices):
+    x = rand(n_devices, 4, 3)
+    out = np.asarray(hvd.allgather(x))
+    expect_one = x.reshape(n_devices * 4, 3)
+    assert out.shape == (n_devices, n_devices * 4, 3)
+    for r in range(n_devices):
+        np.testing.assert_allclose(out[r], expect_one)
+
+
+def test_allgather_uneven(hvd, n_devices):
+    parts = [rand(1, 2 + r, 3, seed=r)[0] for r in range(n_devices)]
+    out = np.asarray(hvd.allgather(parts))
+    expect = np.concatenate(parts, axis=0)
+    assert out.shape == (n_devices,) + expect.shape
+    for r in range(n_devices):
+        np.testing.assert_allclose(out[r], expect)
+
+
+def test_grouped_allgather(hvd, n_devices):
+    xs = [rand(n_devices, 2, seed=i) for i in range(3)]
+    outs = hvd.grouped_allgather(xs)
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   x.reshape(n_devices * 2), rtol=1e-6)
+
+
+# -- broadcast -------------------------------------------------------------
+def test_broadcast(hvd, n_devices):
+    for root in (0, 3, n_devices - 1):
+        x = rand(n_devices, 6, seed=root)
+        out = np.asarray(hvd.broadcast(x, root_rank=root))
+        np.testing.assert_allclose(out,
+                                   np.broadcast_to(x[root], x.shape))
+
+
+def test_broadcast_bad_root(hvd, n_devices):
+    x = rand(n_devices, 2)
+    with pytest.raises(ValueError):
+        hvd.broadcast(x, root_rank=n_devices)
+
+
+# -- alltoall --------------------------------------------------------------
+def test_alltoall_uniform(hvd, n_devices):
+    s = 2
+    x = np.arange(n_devices * n_devices * s, dtype=np.float32)
+    x = x.reshape(n_devices, n_devices * s)
+    out = hvd.alltoall(x)
+    outs = [np.asarray(o) for o in out]
+    for r in range(n_devices):
+        expect = np.concatenate([x[src, r * s:(r + 1) * s]
+                                 for src in range(n_devices)])
+        np.testing.assert_array_equal(outs[r], expect)
+
+
+def test_alltoall_ragged(hvd, n_devices):
+    n = n_devices
+    rng = np.random.RandomState(7)
+    splits = rng.randint(0, 3, size=(n, n))
+    dim0 = int(splits.sum(axis=1).max())
+    # Make every rank's tensor long enough, padding splits of rank r to sum
+    # to its dim0 by growing the last split.
+    splits[:, -1] += dim0 - splits.sum(axis=1)
+    x = rng.uniform(size=(n, dim0, 2)).astype(np.float32)
+    out, recv = hvd.alltoall(x, splits=splits)
+    outs = [np.asarray(o) for o in out]
+    offs = np.zeros((n, n), dtype=int)
+    offs[:, 1:] = np.cumsum(splits, axis=1)[:, :-1]
+    for r in range(n):
+        expect = np.concatenate(
+            [x[s, offs[s, r]:offs[s, r] + splits[s, r]] for s in range(n)],
+            axis=0)
+        np.testing.assert_allclose(outs[r], expect)
+        np.testing.assert_array_equal(recv[r], splits[:, r])
+
+
+# -- reducescatter ---------------------------------------------------------
+def test_reducescatter_even(hvd, n_devices):
+    x = rand(n_devices, n_devices * 3, 2)
+    out = np.asarray(hvd.reducescatter(x, op=hvd.Sum))
+    reduced = x.sum(axis=0)
+    assert out.shape == (n_devices, 3, 2)
+    for r in range(n_devices):
+        np.testing.assert_allclose(out[r], reduced[r * 3:(r + 1) * 3],
+                                   rtol=1e-5)
+
+
+def test_reducescatter_uneven(hvd, n_devices):
+    s0 = n_devices * 2 + 3
+    x = rand(n_devices, s0)
+    chunks = hvd.reducescatter(x, op=hvd.Sum)
+    reduced = x.sum(axis=0)
+    base, rem = divmod(s0, n_devices)
+    off = 0
+    for r in range(n_devices):
+        size = base + (1 if r < rem else 0)
+        np.testing.assert_allclose(np.asarray(chunks[r]),
+                                   reduced[off:off + size], rtol=1e-5)
+        off += size
+
+
+# -- process sets ----------------------------------------------------------
+def test_process_set_allreduce(hvd, n_devices):
+    ps = hvd_mod.add_process_set([0, 2, 4, 6])
+    try:
+        x = rand(4, 5)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+        np.testing.assert_allclose(out,
+                                   np.broadcast_to(x.sum(axis=0), x.shape),
+                                   rtol=1e-5)
+        assert ps.size() == 4
+    finally:
+        hvd_mod.remove_process_set(ps)
+    assert ps.process_set_id is None
+
+
+def test_process_set_duplicate_rejected(hvd):
+    ps = hvd_mod.add_process_set([1, 3])
+    try:
+        with pytest.raises(ValueError):
+            hvd_mod.add_process_set([1, 3])
+    finally:
+        hvd_mod.remove_process_set(ps)
+
+
+def test_cannot_remove_global_set(hvd):
+    from horovod_tpu.process_sets import global_process_set
+    with pytest.raises(ValueError):
+        hvd_mod.remove_process_set(global_process_set)
+
+
+# -- misc ------------------------------------------------------------------
+def test_barrier_and_join(hvd, n_devices):
+    hvd.barrier()
+    assert hvd.join() == n_devices - 1
+
+
+def test_duplicate_name_error(hvd, n_devices):
+    import horovod_tpu.basics as basics
+    coord = basics.runtime().coordinator
+    saved = coord.cycle_time_s
+    coord.cycle_time_s = 1.0  # hold the cycle open so both submissions queue
+    try:
+        x = rand(n_devices, 2)
+        h1 = hvd.allreduce_async(x, op=hvd.Sum, name="dup.tensor")
+        with pytest.raises(DuplicateNameError):
+            hvd.allreduce_async(x, op=hvd.Sum, name="dup.tensor")
+    finally:
+        coord.cycle_time_s = saved
+    hvd.synchronize(h1)
+
+
+def test_error_propagation_unknown_op(hvd, n_devices):
+    with pytest.raises(ValueError):
+        hvd.allreduce(rand(n_devices, 2), op=99)
+
+
+def test_stacked_shape_validation(hvd, n_devices):
+    with pytest.raises(ValueError):
+        hvd.allreduce(np.zeros((n_devices + 1, 3), dtype=np.float32))
