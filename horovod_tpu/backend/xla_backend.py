@@ -97,6 +97,9 @@ class XlaSingleBackend(Backend):
         One jitted shard_map carries the whole list (a fusion bucket) in a
         single XLA program → one fused ICI collective sequence.
         """
+        if op == reduce_ops.Adasum:
+            return self._adasum_allreduce(arrays, process_set, prescale,
+                                          postscale)
         mesh = self._mesh(process_set)
         n = mesh.devices.size
         key = ("ar", process_set.process_set_id, op)
@@ -107,8 +110,7 @@ class XlaSingleBackend(Backend):
                 outs = []
                 for x in xs:
                     x = _scale(x, pre)
-                    if op in (reduce_ops.Sum, reduce_ops.Average,
-                              reduce_ops.Adasum):
+                    if op in (reduce_ops.Sum, reduce_ops.Average):
                         y = lax.psum(x, AXIS)
                         if op == reduce_ops.Average:
                             y = (y / n).astype(x.dtype)
@@ -131,9 +133,6 @@ class XlaSingleBackend(Backend):
                 in_specs=(P(), P(AXIS)), out_specs=P(AXIS))
             return jax.jit(sm)
 
-        if op == reduce_ops.Adasum:
-            return self._adasum_allreduce(arrays, process_set, prescale,
-                                          postscale)
         fn = self._cached(key, build)
         pre = jnp.asarray(1.0 if prescale is None else prescale,
                           dtype=jnp.float32)

@@ -128,8 +128,12 @@ def init(comm=None, process_sets=None):
         log = get_logger()
         topology = Topology.from_env()
         spmd = (envparse.get_env(envparse.SIZE) is not None
-                and topology.size >= 1
                 and envparse.get_env(envparse.RANK) is not None)
+        if spmd and (topology.size < 1
+                     or not 0 <= topology.rank < topology.size):
+            raise ValueError(
+                f"Invalid launcher topology: rank={topology.rank} "
+                f"size={topology.size}")
 
         if spmd:
             from .backend import make_spmd_backend
@@ -183,7 +187,7 @@ def shutdown():
         if _runtime.backend is not None:
             _runtime.backend.close()
         from . import process_sets as ps_mod
-        ps_mod._teardown()
+        ps_mod._teardown(_runtime)
         _runtime._shutdown = True
         _runtime = None
 
@@ -253,12 +257,17 @@ def mpi_built():
 
 
 def gloo_enabled():
-    # Our TCP backend is the gloo-analog CPU data plane.
-    return True
+    return gloo_built()
 
 
 def gloo_built():
-    return True
+    # Our TCP backend is the gloo-analog CPU data plane; report it built
+    # only if the module actually imports.
+    try:
+        from .backend import tcp_backend  # noqa: F401
+        return True
+    except ImportError:
+        return False
 
 
 def nccl_built():

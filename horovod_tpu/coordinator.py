@@ -161,6 +161,12 @@ class Coordinator:
         self._wakeup.set()
         return entry.handle
 
+    def _release_name(self, entry):
+        if entry.name:
+            with self._lock:
+                self._pending_names.discard(
+                    (entry.process_set.process_set_id, entry.name))
+
     # -- background cycle --------------------------------------------------
     def _loop(self):
         while self._running:
@@ -191,6 +197,8 @@ class Coordinator:
             for e in others:
                 self._run_single(backend, e, timeline)
         finally:
+            # Safety net for failure paths (idempotent: success paths
+            # already released their names before completing handles).
             with self._lock:
                 for e in batch:
                     if e.name:
@@ -248,6 +256,11 @@ class Coordinator:
             i = 0
             for e in bucket:
                 k = len(e.arrays)
+                # Release the name BEFORE completing the handle: a waiter
+                # may legally resubmit the same name the moment wait()
+                # returns (reference: tensor_queue erases the entry when the
+                # response is handed to the op layer).
+                self._release_name(e)
                 e.handle._complete(results[i:i + k] if k > 1
                                    else results[i])
                 self.tensors_processed += k
@@ -287,6 +300,7 @@ class Coordinator:
             self.bytes_processed += sum(
                 _nbytes(np.asarray(a)) if not hasattr(a, "dtype") else
                 _nbytes(a) for a in e.arrays)
+            self._release_name(e)
             e.handle._complete(out)
             if timeline:
                 timeline.end([e.name], e.kind.upper())

@@ -1,0 +1,38 @@
+"""Small reference models for MNIST-scale smoke tests (reference:
+examples/keras/keras_mnist.py model — two conv layers + dense head)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Plain MLP classifier."""
+
+    features: tuple = (128, 64)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class MnistCNN(nn.Module):
+    """LeNet-style CNN matching the reference MNIST example topology
+    (reference: examples/keras/keras_mnist.py:55-65 — conv 32, conv 64,
+    maxpool, dense 128, dense 10)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -180,11 +180,16 @@ def broadcast_async(tensor, root_rank, name=None,
     horovod/torch/mpi_ops.py:685)."""
     tensor = jnp.asarray(tensor)
     _check_stacked(tensor, process_set, "broadcast")
-    n = len(process_set.ranks)
-    if not 0 <= root_rank < n:
-        raise ValueError(f"root_rank {root_rank} out of range [0, {n})")
+    # root_rank is a GLOBAL rank (reference semantics: process-set
+    # collectives name roots by global rank); backends receive the
+    # set-local index.
+    if root_rank not in process_set.ranks:
+        raise ValueError(
+            f"root_rank {root_rank} is not a member of process set "
+            f"{process_set.ranks}")
+    local_root = process_set.ranks.index(root_rank)
     entry = TensorEntry(name or _auto_name("broadcast"), "broadcast",
-                        [tensor], process_set, root_rank=root_rank)
+                        [tensor], process_set, root_rank=local_root)
     return _submit(entry)
 
 

@@ -172,5 +172,11 @@ def process_set_by_id(set_id):
     return ps
 
 
-def _teardown():
+def _teardown(runtime=None):
+    """Invalidate every registered set so a later init() re-registers them
+    against the fresh runtime (shutdown+init is the elastic reset path,
+    reference: horovod/torch/elastic/__init__.py:46-48)."""
+    if runtime is not None and runtime.process_set_table is not None:
+        for ps in runtime.process_set_table.all():
+            ps._invalidate()
     global_process_set._invalidate()

@@ -21,6 +21,13 @@ def get_env(name, default=None):
     return default
 
 
+def _warn_malformed(name, val, default):
+    import warnings
+    warnings.warn(
+        f"Environment knob {name}={val!r} is not a valid number; using "
+        f"default {default!r}", stacklevel=3)
+
+
 def get_int(name, default=0):
     val = get_env(name)
     if val is None or val == "":
@@ -28,6 +35,7 @@ def get_int(name, default=0):
     try:
         return int(val)
     except ValueError:
+        _warn_malformed(name, val, default)
         return default
 
 
@@ -38,6 +46,7 @@ def get_float(name, default=0.0):
     try:
         return float(val)
     except ValueError:
+        _warn_malformed(name, val, default)
         return default
 
 

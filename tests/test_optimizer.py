@@ -1,0 +1,154 @@
+"""Distributed optimizer + end-to-end training tests (reference analog:
+DistributedOptimizer tests in test/parallel/test_torch.py and the MNIST
+example smoke runs in CI, .buildkite/gen-pipeline.sh:155-279)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd_mod
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu.models import MLP
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    pass
+
+
+def _loss_fn(model):
+    def loss(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+    return loss
+
+
+def _make_data(n, batch_per_rank, key=0):
+    rng = np.random.RandomState(key)
+    x = rng.uniform(size=(n * batch_per_rank, 8, 8, 1)).astype(np.float32)
+    y = rng.randint(0, 10, size=(n * batch_per_rank,))
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_train_step_loss_decreases(hvd, n_devices):
+    model = MLP(features=(32,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8, 8, 1)))
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2))
+    step = hvd_jax.make_train_step(_loss_fn(model), opt)
+    opt_state = opt.init(params)
+    batch = _make_data(n_devices, 16)
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_grads_reduced_identically(hvd, n_devices):
+    """After a step, params on every replica must be identical (the
+    defining property of DP allreduce training)."""
+    model = MLP(features=(16,), num_classes=4)
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 4, 4, 1)))
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    step = hvd_jax.make_train_step(_loss_fn(model), opt, donate=False)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.uniform(size=(n_devices * 4, 4, 4, 1)),
+                    dtype=jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, size=(n_devices * 4,)))
+    new_params, _, _ = step(params, opt_state, (x, y))
+    # Replicated output: sharding must report full replication.
+    leaf = jax.tree.leaves(new_params)[0]
+    assert leaf.sharding.is_fully_replicated
+
+
+def test_train_step_matches_single_device_sgd(hvd, n_devices):
+    """Sharded training must be numerically equivalent to one big-batch
+    SGD step on a single device (grad of mean over full batch)."""
+    model = MLP(features=(8,), num_classes=3)
+    params = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 2, 2, 1)))
+    loss = _loss_fn(model)
+    batch = _make_data(n_devices, 8, key=9)
+    batch = (batch[0][:, :2, :2, :], batch[1] % 3)
+
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.5))
+    step = hvd_jax.make_train_step(loss, opt, donate=False)
+    opt_state = opt.init(params)
+    dist_params, _, dist_loss = step(params, opt_state, batch)
+
+    ref_grads = jax.grad(loss)(params, batch)
+    ref_params = jax.tree.map(lambda p, g: p - 0.5 * g, params, ref_grads)
+    for a, b in zip(jax.tree.leaves(dist_params),
+                    jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_backward_passes_per_step(hvd, n_devices):
+    """Local gradient aggregation: updates apply only every k-th step
+    (reference: horovod/tensorflow/gradient_aggregation.py)."""
+    model = MLP(features=(8,), num_classes=3)
+    params = model.init(jax.random.PRNGKey(4), jnp.zeros((1, 2, 2, 1)))
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1),
+                                       backward_passes_per_step=2)
+    step = hvd_jax.make_train_step(_loss_fn(model), opt, donate=False)
+    opt_state = opt.init(params)
+    batch = _make_data(n_devices, 4, key=5)
+    batch = (batch[0][:, :2, :2, :], batch[1] % 3)
+
+    p1, s1, _ = step(params, opt_state, batch)
+    # First micro-batch: no update applied yet.
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    p2, s2, _ = step(p1, s1, batch)
+    # Second micro-batch: aggregated update applied.
+    changed = any(not np.allclose(np.asarray(a), np.asarray(b))
+                  for a, b in zip(jax.tree.leaves(p2),
+                                  jax.tree.leaves(params)))
+    assert changed
+
+
+def test_adasum_optimizer_runs(hvd, n_devices):
+    model = MLP(features=(8,), num_classes=3)
+    params = model.init(jax.random.PRNGKey(6), jnp.zeros((1, 2, 2, 1)))
+    opt = hvd_jax.DistributedAdasumOptimizer(optax.sgd(0.1))
+    step = hvd_jax.make_train_step(_loss_fn(model), opt, donate=False)
+    opt_state = opt.init(params)
+    batch = _make_data(n_devices, 4, key=7)
+    batch = (batch[0][:, :2, :2, :], batch[1] % 3)
+    p, s, loss = step(params, opt_state, batch)
+    assert np.isfinite(float(loss))
+    changed = any(not np.allclose(np.asarray(a), np.asarray(b))
+                  for a, b in zip(jax.tree.leaves(p),
+                                  jax.tree.leaves(params)))
+    assert changed
+
+
+def test_compression_bf16_training(hvd, n_devices):
+    model = MLP(features=(8,), num_classes=3)
+    params = model.init(jax.random.PRNGKey(8), jnp.zeros((1, 2, 2, 1)))
+    opt = hvd_jax.DistributedOptimizer(
+        optax.sgd(0.1), compression=hvd_mod.Compression.bf16)
+    step = hvd_jax.make_train_step(_loss_fn(model), opt, donate=False)
+    opt_state = opt.init(params)
+    batch = _make_data(n_devices, 4, key=11)
+    batch = (batch[0][:, :2, :2, :], batch[1] % 3)
+    p, s, loss = step(params, opt_state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_broadcast_variables_single_mode_identity(hvd):
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+    out = hvd_jax.broadcast_parameters(params, root_rank=0)
+    assert out is params
+
+
+def test_broadcast_object_single_mode(hvd):
+    obj = {"epoch": 3, "lr": 0.1}
+    assert hvd_jax.broadcast_object(obj) == obj
+    assert hvd_jax.allgather_object(obj) == [obj]
