@@ -1,0 +1,507 @@
+"""Pallas TPU flash attention with log-sum-exp outputs.
+
+This is the hot-op kernel of the framework's model zoo and the inner step of
+ring attention (horovod_tpu.parallel.ring_attention). The reference framework
+has no attention kernels at all (it is a communication layer; SURVEY.md §2.6)
+— this kernel exists because the TPU rebuild's flagship models are
+transformers and attention is where HBM bandwidth goes.
+
+Design (MXU/VMEM-first):
+- Online-softmax tiling: grid (batch*heads, q_blocks, k_blocks); the k axis
+  is the innermost (sequential) grid dimension, with fp32 running max /
+  denominator / accumulator in VMEM scratch that persists across k steps.
+- Logits and accumulation in fp32 on the MXU (``preferred_element_type``),
+  inputs bf16 or fp32.
+- Global-position masking: query/key chunk offsets arrive as dynamic scalars
+  (scalar-prefetch), so the same compiled kernel serves local attention and
+  every step of a ring schedule (offsets are device-varying under shard_map).
+- Returns (out, lse); lse makes partial results mergeable (ring attention)
+  and feeds the backward pass.
+- Custom VJP with two backward kernels (dk/dv by key block, dq by query
+  block), the standard flash-attention backward split.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so the full
+test suite exercises the exact kernel logic on the CPU mesh.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_LANE = 128          # TPU lane width: scratch vectors are (block, _LANE)
+_NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _struct(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of the inputs' varying-mesh-axes
+    type so pallas_call type-checks inside shard_map (check_vma)."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in like))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _pad_to(x, multiple, axis):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
+                block_k, n_k):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                      # (block_q, d)
+    k = k_ref[0]                      # (block_k, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale   # (block_q, block_k)
+
+    q_start = lens_ref[0]
+    k_start = lens_ref[1]
+    kv_len = lens_ref[2]
+    qb = pl.program_id(1)
+    rows = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = cols < kv_len              # mask key padding
+    if causal:
+        mask = jnp.logical_and(mask, (q_start + rows) >= (k_start + cols))
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_scr[:, :1]             # (block_q, 1)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)            # (block_q, block_k) fp32
+    # Fully-masked rows: m_new stays _NEG_INF and p would be exp(0)=1 —
+    # zero those contributions so l stays 0 for them.
+    p = jnp.where(mask, p, 0.0)
+
+    l_prev = l_scr[:, :1]
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        m = m_scr[:, 0]
+        lse = jnp.where(l_scr[:, 0] == 0.0, _NEG_INF,
+                        m + jnp.log(l_scr[:, 0]))
+        # lse is laid out (bh, 1, sq): TPU requires the last two block dims
+        # to divide (8, 128) or equal the array dims — (1, 1, block_q) does.
+        lse_ref[0, 0] = lse.astype(lse_ref.dtype)
+
+
+def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    n_q = sq // block_q
+    n_k = sk // block_k
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    out_shapes = [
+        _struct((bh, sq, d), q.dtype, q, k, v, lens),
+        _struct((bh, 1, sq), jnp.float32, q, k, v, lens),
+    ]
+    try:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except TypeError:  # older/newer jax without this field
+        compiler_params = None
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(lens, q, k, v)
+    return o, lse[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    sm_scale, causal, block_q, block_k, n_q):
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]                      # (block_q, d)
+    k = k_ref[0]                      # (block_k, d)
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]               # (block_q,)
+    delta = delta_ref[0, 0]           # (block_q,)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale   # (bq, bk)
+    q_start = lens_ref[0]
+    k_start = lens_ref[1]
+    kv_len = lens_ref[2]
+    kb = pl.program_id(1)
+    rows = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = cols < kv_len
+    if causal:
+        mask = jnp.logical_and(mask, (q_start + rows) >= (k_start + cols))
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (bq, bk)
+
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # (bq, bk)
+    ds = p * (dp - delta[:, None]) * sm_scale
+    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qb == n_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
+                   block_q, block_k, n_k):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    q_start = lens_ref[0]
+    k_start = lens_ref[1]
+    kv_len = lens_ref[2]
+    qb = pl.program_id(1)
+    rows = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = cols < kv_len
+    if causal:
+        mask = jnp.logical_and(mask, (q_start + rows) >= (k_start + cols))
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * sm_scale
+    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
+              g_lse=None):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    n_q = sq // block_q
+    n_k = sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                        # (bh, sq)
+    if g_lse is not None:
+        # dlse_i/ds_ij = p_ij, so the lse cotangent enters the shared
+        # ds = p*(dp - delta')*scale term as delta' = delta - g_lse.
+        delta = delta - g_lse.astype(jnp.float32)
+    # 3-D (bh, 1, sq) layout for TPU block-shape rules (see _fwd_kernel).
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+
+    try:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except TypeError:
+        compiler_params = None
+
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid_spec=dkv_spec,
+        out_shape=[
+            _struct((bh, sk, d), k.dtype, q, k, v, do, lens),
+            _struct((bh, sk, d), v.dtype, q, k, v, do, lens),
+        ],
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(lens, q, k, v, do, lse3, delta3)
+
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    (dq,) = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid_spec=dq_spec,
+        out_shape=[_struct((bh, sq, d), q.dtype, q, k, v, do, lens)],
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(lens, q, k, v, do, lse3, delta3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Differentiable public entry points
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, lens, sm_scale, causal, block_q, block_k):
+    o, _ = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, lens, sm_scale, causal, block_q, block_k):
+    o, lse = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse, lens)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v, o, lse, lens = res
+    dq, dk, dv = _bwd_call(q, k, v, o, g, lse, lens, sm_scale, causal,
+                           block_q, block_k)
+    dlens = np.zeros((3,), jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_with_lse(q, k, v, lens, sm_scale, causal, block_q, block_k):
+    return _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k)
+
+
+def _flash_with_lse_fwd(q, k, v, lens, sm_scale, causal, block_q, block_k):
+    o, lse = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse, lens)
+
+
+def _flash_with_lse_bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v, o, lse, lens = res
+    go, g_lse = g
+    dq, dk, dv = _bwd_call(q, k, v, o, go, lse, lens, sm_scale, causal,
+                           block_q, block_k, g_lse=g_lse)
+    dlens = np.zeros((3,), jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
+def _prepare(q, k, v, block_q, block_k):
+    """Reshape (B,H,S,D)→(BH,S,D), pad D to the 128-lane tile and S to
+    block multiples. Returns padded tensors + original dims."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, max(8, 1 << (sq - 1).bit_length()))
+    block_q = min(block_q, DEFAULT_BLOCK_Q)
+    block_k = min(block_k, DEFAULT_BLOCK_K)
+
+    def flat(x):
+        return x.reshape((b * h,) + x.shape[2:])
+
+    q, k, v = flat(q), flat(k), flat(v)
+    q = _pad_to(_pad_to(q, _LANE, 2), block_q, 1)
+    k = _pad_to(_pad_to(k, _LANE, 2), block_k, 1)
+    v = _pad_to(_pad_to(v, _LANE, 2), block_k, 1)
+    return q, k, v, (b, h, sq, sk, d), block_q, block_k
+
+
+def _varying(*xs):
+    """True when any input is device-varying under shard_map (vma)."""
+    try:
+        return bool(frozenset().union(
+            *(jax.typeof(x).vma for x in xs if hasattr(x, "dtype")
+              or not np.isscalar(x))))
+    except (AttributeError, TypeError):
+        return False
+
+
+def flash_attention(q, k, v, *, causal=False, sm_scale=None,
+                    q_offset=0, k_offset=0, kv_len=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    with_lse=False):
+    """Flash attention over (batch, heads, seq, head_dim) tensors.
+
+    Args:
+      causal: apply a causal mask in *global* coordinates:
+        position(q) = q_offset + row, position(k) = k_offset + col. Offsets
+        may be traced scalars (device-varying under shard_map) — this is what
+        lets one compiled kernel serve every ring-attention step.
+      kv_len: number of valid keys in ``k`` (defaults to its length);
+        keys at or beyond this index are masked (padding).
+      with_lse: also return the per-query log-sum-exp (fp32, (B,H,Sq)).
+    """
+    orig_dtype = q.dtype
+    b, h, sq, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    if kv_len is None:
+        kv_len = k.shape[2]
+    if _interpret() and _varying(q, k, v, q_offset, k_offset):
+        # Pallas's HLO interpreter cannot run with device-varying operands
+        # inside shard_map (check_vma dynamic_slice limitation); on non-TPU
+        # backends use the einsum oracle there. On TPU the compiled kernel
+        # handles shard_map natively.
+        return reference_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset,
+            k_offset=k_offset, kv_len=kv_len, with_lse=with_lse)
+    qp, kp, vp, dims, bq, bk = _prepare(q, k, v, block_q, block_k)
+    lens = jnp.asarray([q_offset, k_offset, kv_len], jnp.int32)
+    if with_lse:
+        o, lse = _flash_with_lse(qp, kp, vp, lens, float(sm_scale),
+                                 bool(causal), bq, bk)
+        o = o[:, :sq, :d].reshape(b, h, sq, d).astype(orig_dtype)
+        lse = lse[:, :sq].reshape(b, h, sq)
+        return o, lse
+    o = _flash(qp, kp, vp, lens, float(sm_scale), bool(causal), bq, bk)
+    return o[:, :sq, :d].reshape(b, h, sq, d).astype(orig_dtype)
+
+
+def reference_attention(q, k, v, *, causal=False, sm_scale=None,
+                        q_offset=0, k_offset=0, kv_len=None,
+                        with_lse=False):
+    """Plain einsum attention with the same masking semantics — the
+    correctness oracle for the kernel tests and the shard_map-on-CPU
+    fallback. Offsets may be traced scalars."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    if kv_len is None:
+        kv_len = sk
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    cols = jnp.arange(sk)
+    mask = (cols < kv_len)[None, None, None, :]
+    if causal:
+        rows = q_offset + jnp.arange(sq)
+        cmask = rows[:, None] >= (k_offset + cols)[None, :]
+        mask = jnp.logical_and(mask, cmask[None, None])
+    mask = jnp.broadcast_to(mask, s.shape)
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    any_visible = jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o = (jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+         / safe_l).astype(q.dtype)
+    if not with_lse:
+        return o
+    lse = jnp.where(any_visible[..., 0], m[..., 0] + jnp.log(safe_l[..., 0]),
+                    _NEG_INF)
+    return o, lse

@@ -1,0 +1,26 @@
+"""Parallelism strategies over TPU device meshes.
+
+The reference is a data-parallel product whose extension point for hybrid
+schemes is process sets (reference: horovod/common/process_sets.py,
+SURVEY.md §2.6 — TP/PP/SP/EP are explicitly absent there). This package is
+the TPU-native strategy layer built on that substrate: every strategy is a
+mesh axis, every data exchange is an XLA collective over ICI.
+
+- mesh:            N-D mesh construction + axis bookkeeping (dp/fsdp/tp/pp)
+- ring_attention:  context parallelism — blockwise attention with k/v blocks
+                   rotating over the 'sp' axis via ppermute
+- ulysses:         sequence parallelism via head-scatter all_to_all
+- sharding:        parameter/activation PartitionSpec rules (tp + fsdp)
+- pipeline:        pipeline parallelism via shard_map + microbatch streaming
+- moe:             expert parallelism — top-k gating + all_to_all dispatch
+"""
+
+from .mesh import MeshConfig, make_mesh  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .sharding import (  # noqa: F401
+    transformer_param_rules, make_param_specs, shard_params,
+    constrain, batch_spec,
+)
+from .pipeline import pipeline_apply  # noqa: F401
+from .moe import MoELayer, moe_apply  # noqa: F401

@@ -1,0 +1,71 @@
+"""Pipeline parallelism: microbatch streaming over the 'pp' mesh axis.
+
+GPipe-style schedule expressed as a differentiable lax.scan inside
+shard_map: each pp rank holds one stage's parameters; every tick each rank
+applies its stage and ppermutes the activation to the next rank, so after
+the n_pp-1 warm-up ticks every stage is busy. Reverse-mode autodiff of the
+scan yields the mirrored backward schedule (1F1B-shaped in steady state)
+without any hand-written backward plumbing.
+
+Bubble fraction is (n_pp-1)/(M+n_pp-1) for M microbatches — choose M >= 4x
+the stage count for >80% utilization.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp", *,
+                   replicate_out=True):
+    """Run microbatches through the pipeline (inside shard_map over
+    ``axis_name``).
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x) -> y`` with y.shape == x.shape
+        (a transformer stage: hidden states in, hidden states out).
+      stage_params: THIS rank's stage parameters (the caller shards the
+        stacked per-stage tree over 'pp' via shard_map in_specs).
+      microbatches: (M, mb, ...) activations entering stage 0 (replicated
+        across pp ranks; only rank 0 consumes them).
+      replicate_out: psum the final outputs so every pp rank returns the
+        full (M, mb, ...) result (needed when loss is computed under further
+        dp reduction); if False, only the last rank's values are meaningful.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    ticks = m + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        state = carry
+        mb_idx = jnp.clip(t, 0, m - 1)
+        x0 = lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+                                      keepdims=False)
+        xin = jnp.where(idx == 0, x0, state)
+        y = stage_fn(stage_params, xin)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return nxt, y
+
+    init = jnp.zeros_like(microbatches[0])
+    try:  # scan carry must be typed pp-varying (it crosses ranks)
+        init = lax.pcast(init, axis_name, to="varying")
+    except (AttributeError, TypeError):
+        init = lax.pvary(init, axis_name)
+    _, ys = lax.scan(tick, init, jnp.arange(ticks))
+    # On the last rank, tick t produced microbatch t-(n-1); slice the
+    # steady-state window. (On other ranks this window is their stage's
+    # intermediate activations — discarded.)
+    outputs = ys[n - 1:]
+    if replicate_out:
+        outputs = lax.psum(
+            jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+    return outputs
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage param trees along a new leading 'stage'
+    axis — shard that axis over 'pp' in shard_map in_specs."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
