@@ -1,0 +1,438 @@
+#include "collectives.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+namespace hvdcore {
+namespace {
+
+// --- half-precision conversion (IEEE fp16 and bfloat16) --------------------
+
+float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400) == 0) {
+        mant <<= 1;
+        --exp;
+      }
+      mant &= 0x3FF;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp + 127 - 15) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xFF) - 127 + 15;
+  uint32_t mant = bits & 0x7FFFFF;
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_mant = mant >> shift;
+    // round-to-nearest-even
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_mant & 1))) ++half_mant;
+    return static_cast<uint16_t>(sign | half_mant);
+  }
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00);
+  uint32_t half_mant = mant >> 13;
+  uint32_t rem = mant & 0x1FFF;
+  if (rem > 0x1000 || (rem == 0x1000 && (half_mant & 1))) {
+    ++half_mant;
+    if (half_mant == 0x400) {
+      half_mant = 0;
+      ++exp;
+      if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00);
+    }
+  }
+  return static_cast<uint16_t>(sign | (static_cast<uint32_t>(exp) << 10) |
+                               half_mant);
+}
+
+float BF16ToFloat(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t FloatToBF16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even on the truncated 16 bits
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+template <typename T>
+void ReduceTyped(T* dst, const T* src, int64_t n, RedOp op) {
+  switch (op) {
+    case RedOp::kSum:
+      for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<T>(dst[i] + src[i]);
+      break;
+    case RedOp::kMin:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case RedOp::kMax:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case RedOp::kProd:
+      for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<T>(dst[i] * src[i]);
+      break;
+  }
+}
+
+template <uint16_t (*ToBits)(float), float (*FromBits)(uint16_t)>
+void ReduceHalfLike(uint16_t* dst, const uint16_t* src, int64_t n, RedOp op) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = FromBits(dst[i]);
+    float b = FromBits(src[i]);
+    float r;
+    switch (op) {
+      case RedOp::kSum: r = a + b; break;
+      case RedOp::kMin: r = std::min(a, b); break;
+      case RedOp::kMax: r = std::max(a, b); break;
+      case RedOp::kProd: r = a * b; break;
+      default: r = a; break;
+    }
+    dst[i] = ToBits(r);
+  }
+}
+
+void ReduceBool(uint8_t* dst, const uint8_t* src, int64_t n, RedOp op) {
+  // Sum/Max => logical OR, Min/Prod => logical AND (reference maps bool
+  // allreduce onto MPI LOR/LAND semantics).
+  if (op == RedOp::kSum || op == RedOp::kMax) {
+    for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] || src[i];
+  } else {
+    for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] && src[i];
+  }
+}
+
+}  // namespace
+
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
+                RedOp op) {
+  switch (dtype) {
+    case DataType::kUint8:
+      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+                  count, op);
+      break;
+    case DataType::kInt8:
+      ReduceTyped(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+                  count, op);
+      break;
+    case DataType::kInt32:
+      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src),
+                  count, op);
+      break;
+    case DataType::kInt64:
+      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src),
+                  count, op);
+      break;
+    case DataType::kFloat32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src),
+                  count, op);
+      break;
+    case DataType::kFloat64:
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(src),
+                  count, op);
+      break;
+    case DataType::kFloat16:
+      ReduceHalfLike<FloatToHalf, HalfToFloat>(
+          static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+          count, op);
+      break;
+    case DataType::kBFloat16:
+      ReduceHalfLike<FloatToBF16, BF16ToFloat>(
+          static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+          count, op);
+      break;
+    case DataType::kBool:
+      ReduceBool(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+                 count, op);
+      break;
+  }
+}
+
+void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::kFloat32: {
+      float* p = static_cast<float*>(buf);
+      float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i) p[i] *= f;
+      break;
+    }
+    case DataType::kFloat64: {
+      double* p = static_cast<double*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::kFloat16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToHalf(HalfToFloat(p[i]) * f);
+      break;
+    }
+    case DataType::kBFloat16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBF16(BF16ToFloat(p[i]) * f);
+      break;
+    }
+    case DataType::kInt32: {
+      int32_t* p = static_cast<int32_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int32_t>(std::llround(p[i] * factor));
+      break;
+    }
+    case DataType::kInt64: {
+      int64_t* p = static_cast<int64_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int64_t>(std::llround(p[i] * factor));
+      break;
+    }
+    default:
+      break;  // uint8/int8/bool: scaling not meaningful
+  }
+}
+
+namespace {
+
+// Chunk boundaries for the ring: chunk i covers [offsets[i], offsets[i+1]).
+std::vector<int64_t> EvenOffsets(int64_t count, int size) {
+  std::vector<int64_t> offsets(size + 1, 0);
+  int64_t base = count / size, rem = count % size;
+  for (int i = 0; i < size; ++i)
+    offsets[i + 1] = offsets[i] + base + (i < rem ? 1 : 0);
+  return offsets;
+}
+
+std::vector<int64_t> PrefixOffsets(const std::vector<int64_t>& counts) {
+  std::vector<int64_t> offsets(counts.size() + 1, 0);
+  for (size_t i = 0; i < counts.size(); ++i)
+    offsets[i + 1] = offsets[i] + counts[i];
+  return offsets;
+}
+
+// Ring reduce-scatter on buf with chunk layout `offsets`. After this, chunk
+// (rank+1) % size in buf holds the fully reduced values.
+Status RingReduceScatterPhase(Transport* t, uint8_t* buf,
+                              const std::vector<int64_t>& offsets,
+                              DataType dtype, RedOp op) {
+  const int size = t->size();
+  const int rank = t->rank();
+  const size_t esize = DataTypeSize(dtype);
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  std::vector<uint8_t> incoming;
+  for (int s = 0; s < size - 1; ++s) {
+    int send_chunk = (rank - s + size) % size;
+    int recv_chunk = (rank - s - 1 + size) % size;
+    uint8_t* send_ptr = buf + offsets[send_chunk] * esize;
+    int64_t send_n = offsets[send_chunk + 1] - offsets[send_chunk];
+    Status st = t->SendRecv(right, send_ptr, send_n * esize, left, &incoming);
+    if (!st.ok()) return st;
+    int64_t recv_n = offsets[recv_chunk + 1] - offsets[recv_chunk];
+    if (incoming.size() != static_cast<size_t>(recv_n) * esize)
+      return Status::Error(StatusCode::kUnknownError, "ring size mismatch");
+    ReduceInto(buf + offsets[recv_chunk] * esize, incoming.data(), recv_n,
+               dtype, op);
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+Status RingAllreduce(Transport* t, void* vbuf, int64_t count, DataType dtype,
+                     RedOp op) {
+  const int size = t->size();
+  if (size == 1 || count == 0) return Status::OK();
+  uint8_t* buf = static_cast<uint8_t*>(vbuf);
+  const size_t esize = DataTypeSize(dtype);
+  auto offsets = EvenOffsets(count, size);
+  const int rank = t->rank();
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+
+  Status st = RingReduceScatterPhase(t, buf, offsets, dtype, op);
+  if (!st.ok()) return st;
+
+  // Allgather phase: circulate reduced chunks. After reduce-scatter, this
+  // rank owns fully-reduced chunk (rank+1) % size.
+  std::vector<uint8_t> incoming;
+  for (int s = 0; s < size - 1; ++s) {
+    int send_chunk = (rank + 1 - s + size) % size;
+    int recv_chunk = (rank - s + size) % size;
+    uint8_t* send_ptr = buf + offsets[send_chunk] * esize;
+    int64_t send_n = offsets[send_chunk + 1] - offsets[send_chunk];
+    st = t->SendRecv(right, send_ptr, send_n * esize, left, &incoming);
+    if (!st.ok()) return st;
+    int64_t recv_n = offsets[recv_chunk + 1] - offsets[recv_chunk];
+    if (incoming.size() != static_cast<size_t>(recv_n) * esize)
+      return Status::Error(StatusCode::kUnknownError, "ring size mismatch");
+    std::memcpy(buf + offsets[recv_chunk] * esize, incoming.data(),
+                incoming.size());
+  }
+  return Status::OK();
+}
+
+Status RingAllgatherv(Transport* t, const void* sendbuf, void* recvbuf,
+                      const std::vector<int64_t>& counts, DataType dtype) {
+  const int size = t->size();
+  const int rank = t->rank();
+  const size_t esize = DataTypeSize(dtype);
+  auto offsets = PrefixOffsets(counts);
+  uint8_t* out = static_cast<uint8_t*>(recvbuf);
+  if (counts[rank] > 0)
+    std::memcpy(out + offsets[rank] * esize, sendbuf, counts[rank] * esize);
+  if (size == 1) return Status::OK();
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  std::vector<uint8_t> incoming;
+  for (int s = 0; s < size - 1; ++s) {
+    int send_block = (rank - s + size) % size;
+    int recv_block = (rank - s - 1 + size) % size;
+    Status st = t->SendRecv(right, out + offsets[send_block] * esize,
+                            counts[send_block] * esize, left, &incoming);
+    if (!st.ok()) return st;
+    if (incoming.size() != static_cast<size_t>(counts[recv_block]) * esize)
+      return Status::Error(StatusCode::kUnknownError, "allgather size mismatch");
+    std::memcpy(out + offsets[recv_block] * esize, incoming.data(),
+                incoming.size());
+  }
+  return Status::OK();
+}
+
+Status TreeBroadcast(Transport* t, void* vbuf, int64_t count, DataType dtype,
+                     int root) {
+  const int size = t->size();
+  if (size == 1) return Status::OK();
+  const int rank = t->rank();
+  const size_t nbytes = static_cast<size_t>(count) * DataTypeSize(dtype);
+  const int vrank = (rank - root + size) % size;
+
+  // Receive once from the parent, then forward to children: standard
+  // binomial tree on virtual ranks.
+  int mask = 1;
+  while (mask < size && (vrank & mask) == 0) mask <<= 1;
+  if (vrank != 0) {
+    int parent = ((vrank & ~mask) + root) % size;
+    std::vector<uint8_t> data;
+    Status st = t->Recv(parent, &data);
+    if (!st.ok()) return st;
+    if (data.size() != nbytes)
+      return Status::Error(StatusCode::kUnknownError, "broadcast size mismatch");
+    std::memcpy(vbuf, data.data(), nbytes);
+  }
+  // Children: vrank + m for m in descending powers of two below mask.
+  for (int m = mask >> 1; m >= 1; m >>= 1) {
+    int child_v = vrank + m;
+    if (child_v < size) {
+      Status st = t->Send((child_v + root) % size, vbuf, nbytes);
+      if (!st.ok()) return st;
+    }
+  }
+  return Status::OK();
+}
+
+Status PairwiseAlltoallv(Transport* t, const void* sendbuf, void* recvbuf,
+                         const std::vector<int64_t>& send_splits,
+                         const std::vector<int64_t>& recv_splits,
+                         DataType dtype) {
+  const int size = t->size();
+  const int rank = t->rank();
+  const size_t esize = DataTypeSize(dtype);
+  auto soff = PrefixOffsets(send_splits);
+  auto roff = PrefixOffsets(recv_splits);
+  const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
+  uint8_t* out = static_cast<uint8_t*>(recvbuf);
+  std::memcpy(out + roff[rank] * esize, in + soff[rank] * esize,
+              send_splits[rank] * esize);
+  std::vector<uint8_t> incoming;
+  for (int d = 1; d < size; ++d) {
+    int to = (rank + d) % size;
+    int from = (rank - d + size) % size;
+    Status st = t->SendRecv(to, in + soff[to] * esize,
+                            send_splits[to] * esize, from, &incoming);
+    if (!st.ok()) return st;
+    if (incoming.size() != static_cast<size_t>(recv_splits[from]) * esize)
+      return Status::Error(StatusCode::kUnknownError, "alltoall size mismatch");
+    std::memcpy(out + roff[from] * esize, incoming.data(), incoming.size());
+  }
+  return Status::OK();
+}
+
+Status RingReducescatter(Transport* t, const void* sendbuf, void* recvbuf,
+                         const std::vector<int64_t>& recv_counts,
+                         DataType dtype, RedOp op) {
+  const int size = t->size();
+  const int rank = t->rank();
+  const size_t esize = DataTypeSize(dtype);
+  auto offsets = PrefixOffsets(recv_counts);
+  const int64_t total = offsets[size];
+  // Work on a scratch copy: the reduce-scatter phase mutates the full buffer.
+  std::vector<uint8_t> scratch(static_cast<size_t>(total) * esize);
+  std::memcpy(scratch.data(), sendbuf, scratch.size());
+  if (size > 1) {
+    Status st = RingReduceScatterPhase(t, scratch.data(), offsets, dtype, op);
+    if (!st.ok()) return st;
+  }
+  // RingReduceScatterPhase leaves chunk (rank+1)%size fully reduced at this
+  // rank... but reducescatter semantics say this rank gets chunk `rank`.
+  // One extra neighbor exchange aligns them — unless size == 1.
+  if (size == 1) {
+    std::memcpy(recvbuf, scratch.data() + offsets[rank] * esize,
+                recv_counts[rank] * esize);
+    return Status::OK();
+  }
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  int owned = (rank + 1) % size;  // chunk index this rank holds reduced
+  std::vector<uint8_t> incoming;
+  Status st = t->SendRecv(right, scratch.data() + offsets[owned] * esize,
+                          recv_counts[owned] * esize, left, &incoming);
+  if (!st.ok()) return st;
+  if (incoming.size() != static_cast<size_t>(recv_counts[rank]) * esize)
+    return Status::Error(StatusCode::kUnknownError, "reducescatter mismatch");
+  std::memcpy(recvbuf, incoming.data(), incoming.size());
+  return Status::OK();
+}
+
+Status DisseminationBarrier(Transport* t) {
+  const int size = t->size();
+  const int rank = t->rank();
+  uint8_t token = 1;
+  std::vector<uint8_t> incoming;
+  for (int mask = 1; mask < size; mask <<= 1) {
+    int to = (rank + mask) % size;
+    int from = (rank - mask + size) % size;
+    Status st = t->SendRecv(to, &token, 1, from, &incoming);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+}  // namespace hvdcore

@@ -1,0 +1,60 @@
+// CPU data-plane collectives over a point-to-point Transport.
+//
+// This is the native core's gloo-analog op set (reference:
+// horovod/common/ops/gloo_operations.cc, mpi_operations.cc). Algorithms are
+// the classic bandwidth-optimal ones: ring allreduce (reduce-scatter +
+// allgather), ring allgatherv, binomial-tree broadcast, pairwise alltoallv,
+// dissemination barrier. On TPU the data plane is XLA/ICI (Python side);
+// these run the same negotiated schedule for CPU-only SPMD jobs and tests.
+#ifndef HVDCORE_COLLECTIVES_H_
+#define HVDCORE_COLLECTIVES_H_
+
+#include <cstdint>
+#include <vector>
+
+#include "common.h"
+#include "transport.h"
+
+namespace hvdcore {
+
+// In-place allreduce over `count` elements of `dtype` at `buf`.
+Status RingAllreduce(Transport* t, void* buf, int64_t count, DataType dtype,
+                     RedOp op);
+
+// Gather variable-size blocks: rank r contributes counts[r] elements from
+// sendbuf; recvbuf (sum(counts) elements) receives blocks ordered by rank.
+Status RingAllgatherv(Transport* t, const void* sendbuf, void* recvbuf,
+                      const std::vector<int64_t>& counts, DataType dtype);
+
+// Binomial-tree broadcast of `count` elements from `root`.
+Status TreeBroadcast(Transport* t, void* buf, int64_t count, DataType dtype,
+                     int root);
+
+// Pairwise exchange: send_splits[r] elements go to rank r (in rank order in
+// sendbuf); recv_splits[r] elements arrive from rank r (in rank order in
+// recvbuf). Splits are element counts of `dtype`.
+Status PairwiseAlltoallv(Transport* t, const void* sendbuf, void* recvbuf,
+                         const std::vector<int64_t>& send_splits,
+                         const std::vector<int64_t>& recv_splits,
+                         DataType dtype);
+
+// Reduce then scatter: input `count` = sum(recv_counts) elements in sendbuf;
+// this rank's reduced block (recv_counts[rank] elements, offset = prefix sum)
+// lands in recvbuf.
+Status RingReducescatter(Transport* t, const void* sendbuf, void* recvbuf,
+                         const std::vector<int64_t>& recv_counts,
+                         DataType dtype, RedOp op);
+
+Status DisseminationBarrier(Transport* t);
+
+// Elementwise accumulate src into dst (used by fusion + tests).
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
+                RedOp op);
+
+// Scale buffer elementwise by `factor` (pre/postscale application,
+// reference: ScaleBufferCPUImpl, horovod/common/ops/collective_operations.h:91).
+void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
+
+}  // namespace hvdcore
+
+#endif  // HVDCORE_COLLECTIVES_H_

@@ -1,0 +1,184 @@
+// Core runtime context: tensor queues, per-process-set controllers,
+// fusion-buffer execution, async handles.
+//
+// Native rethink of the reference's HorovodGlobalState + background loop
+// (reference: horovod/common/operations.cc:385 BackgroundThreadLoop, :706
+// RunLoopOnce, :257 PerformOperation; process-set table:
+// horovod/common/process_set.h:89-171). Differences by design:
+//  - The cycle is *driven from outside* (the Python coordinator thread calls
+//    RunCycle) instead of owning a thread: on TPU the heavy data plane is
+//    compiled XLA programs dispatched from Python, so the native core slots
+//    under the same driver thread rather than competing with it.
+//  - Each process set is a channel over one multiplexed transport; a set's
+//    controller, response cache, queue, and fusion buffer are private to the
+//    channel, mirroring the reference's per-set controller+queue.
+#ifndef HVDCORE_CORE_H_
+#define HVDCORE_CORE_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "collectives.h"
+#include "controller.h"
+#include "message.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdcore {
+
+// Channel-multiplexed wrapper over one base transport: every message gets a
+// u32 channel header; out-of-channel frames are parked in per-(channel,peer)
+// inboxes. Lets N process sets share one socket mesh (the reference gives
+// each process set its own communicator; one mesh + channels is the
+// TCP-native equivalent).
+class MuxTransport {
+ public:
+  explicit MuxTransport(std::unique_ptr<Transport> base)
+      : base_(std::move(base)) {}
+  int rank() const { return base_->rank(); }
+  int size() const { return base_->size(); }
+  Status Send(uint32_t ch, int to, const void* data, size_t len);
+  Status Recv(uint32_t ch, int from, std::vector<uint8_t>* out);
+  Status SendRecv(uint32_t ch, int to, const void* sdata, size_t slen,
+                  int from, std::vector<uint8_t>* out);
+  void Close() { base_->Close(); }
+
+ private:
+  Status TakeFromInbox(uint32_t ch, int from, std::vector<uint8_t>* out,
+                       bool* found);
+  std::unique_ptr<Transport> base_;
+  std::map<std::pair<uint32_t, int>, std::vector<std::vector<uint8_t>>> inbox_;
+};
+
+// Adapts (channel, member-rank-list) to the Transport interface consumed by
+// the controller and the ring collectives.
+class ChannelView : public Transport {
+ public:
+  ChannelView(MuxTransport* mux, uint32_t ch, std::vector<int> members,
+              int my_index)
+      : mux_(mux), ch_(ch), members_(std::move(members)), my_index_(my_index) {}
+  int rank() const override { return my_index_; }
+  int size() const override { return static_cast<int>(members_.size()); }
+  Status Send(int to, const void* data, size_t len) override {
+    return mux_->Send(ch_, members_[to], data, len);
+  }
+  Status Recv(int from, std::vector<uint8_t>* out) override {
+    return mux_->Recv(ch_, members_[from], out);
+  }
+  Status SendRecv(int to, const void* sdata, size_t slen, int from,
+                  std::vector<uint8_t>* out) override {
+    return mux_->SendRecv(ch_, members_[to], sdata, slen, members_[from], out);
+  }
+  void Close() override {}
+
+ private:
+  MuxTransport* mux_;
+  uint32_t ch_;
+  std::vector<int> members_;
+  int my_index_;
+};
+
+enum class HandleState : int { kInProgress = 0, kDone = 1, kError = 2 };
+
+struct Entry {
+  Request req;
+  std::vector<uint8_t> input;    // copied at enqueue (owner-safe)
+  std::vector<uint8_t> output;
+  std::vector<int64_t> out_shape;
+  std::vector<int32_t> recv_splits;  // alltoall only
+  HandleState state = HandleState::kInProgress;
+  std::string error;
+};
+
+struct CoreOptions {
+  ControllerOptions controller;
+  std::string timeline_path;  // empty = disabled
+};
+
+class Core {
+ public:
+  // transport_kind: "tcp" (peers = "host:port,...") or "local"
+  // (peers = job name for the in-process hub).
+  static Status Create(int rank, int size, const std::string& transport_kind,
+                       const std::string& peers, const CoreOptions& opts,
+                       std::unique_ptr<Core>* out);
+
+  // Must be called collectively in the same order on every member rank.
+  // Returns the new process-set id (>0; 0 is the global set).
+  int AddProcessSet(const std::vector<int>& ranks);
+  bool RemoveProcessSet(int ps_id);
+
+  // Thread-safe enqueue; returns handle >= 0 or negative error code
+  // (-1 duplicate name, -2 bad args, -3 shutting down, -4 not a member).
+  int64_t Enqueue(int ps_id, const Request& req, const void* data,
+                  size_t nbytes);
+
+  // One negotiation+execution cycle over every process set this rank
+  // belongs to. Returns completed-handle count, or -1 after shutdown.
+  int RunCycle();
+
+  void RequestShutdown() { shutdown_requested_.store(true); }
+  bool ShutdownComplete() const { return shutdown_complete_.load(); }
+
+  HandleState Poll(int64_t handle, std::string* error);
+  Status Wait(int64_t handle, double timeout_s);
+  const Entry* Get(int64_t handle);
+  void Release(int64_t handle);
+
+  int rank() const { return mux_->rank(); }
+  int size() const { return mux_->size(); }
+  uint64_t cycles() const { return cycles_; }
+  uint64_t bytes_processed() const { return bytes_processed_; }
+  Timeline* timeline() { return timeline_.get(); }
+
+ private:
+  Core(std::unique_ptr<Transport> base, const CoreOptions& opts);
+
+  struct PsState {
+    uint32_t channel;
+    std::vector<int> members;           // global ranks, sorted
+    int my_index;                       // -1 if not a member
+    bool active = false;  // cycled only after cross-rank activation
+    std::unique_ptr<ChannelView> view;
+    std::unique_ptr<Controller> controller;
+    std::vector<std::pair<Request, int64_t>> queue;  // pending (req, handle)
+    std::map<std::string, int64_t> inflight;         // name -> handle
+    std::vector<uint8_t> fusion_buffer;              // persistent
+  };
+
+  void ExecuteResponse(PsState& ps, const Response& resp, int* completed);
+  void CompleteHandle(int64_t handle, HandleState state,
+                      const std::string& error);
+
+  CoreOptions opts_;
+  std::unique_ptr<MuxTransport> mux_;
+  std::unique_ptr<Timeline> timeline_;
+
+  std::mutex mu_;  // guards handles_ + queues + process-set table
+  std::condition_variable cv_;
+  std::map<int, std::unique_ptr<PsState>> process_sets_;
+  // Creation/removal is staged locally and applied only once every rank has
+  // staged the same change (MIN-consensus through the global set's phase-A
+  // exchange; see controller.h PsConsensus). Both lists are consumed FIFO,
+  // which is why every rank must stage changes in the same order.
+  std::vector<int> staged_adds_;      // ps ids awaiting activation
+  std::vector<int> staged_removals_;  // ps ids awaiting removal
+  int next_ps_id_ = 1;
+  uint32_t next_channel_ = 1;
+  std::map<int64_t, std::unique_ptr<Entry>> handles_;
+  int64_t next_handle_ = 0;
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> shutdown_complete_{false};
+  uint64_t cycles_ = 0;
+  uint64_t bytes_processed_ = 0;
+};
+
+}  // namespace hvdcore
+
+#endif  // HVDCORE_CORE_H_

@@ -1,0 +1,398 @@
+"""Multi-process SPMD data plane backed by the native C++ core.
+
+This is the gloo-analog path (reference: horovod/common/ops/
+gloo_operations.cc + gloo/gloo_context.cc): N launcher-spawned processes
+negotiate named tensors through the native controller (csrc/controller.cc)
+and move bytes with ring collectives over a TCP mesh (csrc/collectives.cc).
+
+Unlike the synchronous single-controller backend, this backend *owns the
+cycle*: local fusion decisions would diverge across ranks, so grouping is
+negotiated by the native controller exactly like the reference's background
+loop. The Python coordinator detects ``drives_own_cycle`` and switches to
+submit/cycle/complete mode (see coordinator.py).
+"""
+
+import numpy as np
+
+from . import Backend
+from .. import native
+from ..exceptions import HorovodInternalError
+from ..ops import reduce_ops
+from ..utils import envparse
+from ..utils.logging_util import get_logger
+
+_KIND_TO_REQ = {
+    "allreduce": native.REQ_ALLREDUCE,
+    "allgather": native.REQ_ALLGATHER,
+    "broadcast": native.REQ_BROADCAST,
+    "alltoall": native.REQ_ALLTOALL,
+    "reducescatter": native.REQ_REDUCESCATTER,
+    "barrier": native.REQ_BARRIER,
+    "join": native.REQ_JOIN,
+}
+
+_OP_TO_RED = {
+    reduce_ops.Sum: native.RED_SUM,
+    reduce_ops.Min: native.RED_MIN,
+    reduce_ops.Max: native.RED_MAX,
+    reduce_ops.Product: native.RED_PROD,
+}
+
+
+class _Pending:
+    """Bookkeeping from one TensorEntry to its native handles."""
+
+    __slots__ = ("entry", "handles", "unpack")
+
+    def __init__(self, entry, handles, unpack):
+        self.entry = entry
+        self.handles = handles
+        self.unpack = unpack
+
+
+class TcpBackend(Backend):
+    name = "tcp-native"
+    drives_own_cycle = True
+
+    def __init__(self, topology):
+        peers = envparse.get_str(envparse.PEERS, "")
+        if not peers:
+            raise HorovodInternalError(
+                "SPMD mode needs HVDTPU_PEERS=host:port,... (set by the "
+                "hvdrun launcher)")
+        timeline = envparse.get_str(envparse.TIMELINE, "")
+        self.core = native.NativeCore(
+            topology.rank, topology.size, transport="tcp", peers=peers,
+            fusion_threshold=envparse.get_int(envparse.FUSION_THRESHOLD, 0),
+            cache_capacity=envparse.get_int(envparse.CACHE_CAPACITY, 0),
+            stall_warning_s=envparse.get_float(
+                envparse.STALL_CHECK_TIME_SECONDS, 0.0),
+            timeline_path=(timeline + f".rank{topology.rank}") if timeline
+            else "")
+        self.topology = topology
+        self._pending = []
+        self._ps_map = {0: 0}  # python process-set id -> native id
+        self._log = get_logger()
+        # Set by the coordinator so in-flight tensor names release when the
+        # entry completes (duplicate-name semantics live in Python too).
+        self.entry_done_cb = None
+
+    # -- process sets -----------------------------------------------------
+    def register_process_set(self, ps):
+        if ps.process_set_id == 0:
+            return
+        self._ps_map[ps.process_set_id] = self.core.add_process_set(ps.ranks)
+
+    def remove_process_set(self, ps):
+        native_id = self._ps_map.pop(ps.process_set_id, None)
+        if native_id:
+            self.core.remove_process_set(native_id)
+
+    def _native_ps(self, ps):
+        try:
+            return self._ps_map[ps.process_set_id]
+        except KeyError:
+            raise HorovodInternalError(
+                f"process set {ps.process_set_id} not registered with the "
+                "native core")
+
+    # -- submission (called from the coordinator cycle thread) ------------
+    def submit_entry(self, entry):
+        """Translate a TensorEntry into native enqueues; returns False if
+        the entry failed synchronously (its handle is completed)."""
+        try:
+            pending = self._enqueue_entry(entry)
+            self._pending.append(pending)
+            return True
+        except Exception as exc:  # noqa: BLE001 - surfaced via the handle
+            if self.entry_done_cb:
+                self.entry_done_cb(entry)
+            entry.handle._fail(exc if isinstance(exc, HorovodInternalError)
+                               else HorovodInternalError(str(exc)))
+            return False
+
+    def _red_op(self, entry, n):
+        """Map framework reduce op to (native op, extra postscale)."""
+        op = entry.op
+        if op is None or op == reduce_ops.Average:
+            return native.RED_SUM, 1.0 / n
+        if op == reduce_ops.Adasum:
+            raise HorovodInternalError(
+                "Adasum over the TCP data plane is not implemented; use the "
+                "compiled XLA path (horovod_tpu.jax) for Adasum reductions")
+        try:
+            return _OP_TO_RED[op], 1.0
+        except KeyError:
+            raise HorovodInternalError(f"unknown reduce op {op!r}")
+
+    def _enqueue_entry(self, entry):
+        kind = entry.kind
+        ps = self._native_ps(entry.process_set)
+        n = len(entry.process_set.ranks)
+        pre = 1.0 if entry.prescale is None else float(entry.prescale)
+        post = 1.0 if entry.postscale is None else float(entry.postscale)
+        core = self.core
+
+        if kind == "allreduce":
+            red, post_extra = self._red_op(entry, n)
+            arrays = [np.asarray(a) for a in entry.arrays]
+            if len(arrays) == 1:
+                h = core.enqueue(ps, entry.name, native.REQ_ALLREDUCE,
+                                 arrays[0], red_op=red, prescale=pre,
+                                 postscale=post * post_extra)
+                return _Pending(entry, [h],
+                                _unpack_single(arrays[0].dtype,
+                                               arrays[0].shape))
+            # Grouped allreduce: concat-flatten so the group is one atomic
+            # negotiated tensor (reference: group_table.cc semantics — the
+            # group fuses as a unit).
+            dtype = arrays[0].dtype
+            if any(a.dtype != dtype for a in arrays):
+                raise HorovodInternalError(
+                    "grouped allreduce requires uniform dtype per group")
+            flat = np.concatenate([a.reshape(-1) for a in arrays])
+            h = core.enqueue(ps, entry.name, native.REQ_ALLREDUCE, flat,
+                             red_op=red, prescale=pre,
+                             postscale=post * post_extra)
+            return _Pending(entry, [h], _unpack_group(arrays))
+
+        if kind == "allgather":
+            arrays = [np.asarray(a) for a in entry.arrays]
+            handles = []
+            for i, a in enumerate(arrays):
+                nm = entry.name if len(arrays) == 1 else f"{entry.name}.{i}"
+                handles.append(core.enqueue(ps, nm, native.REQ_ALLGATHER, a))
+            return _Pending(entry, handles, _unpack_list(arrays))
+
+        if kind == "broadcast":
+            # Root arrives as a process-set-relative index (collectives.py
+            # translates global -> set-relative before submission).
+            arrays = [np.asarray(a) for a in entry.arrays]
+            handles = []
+            for i, a in enumerate(arrays):
+                nm = entry.name if len(arrays) == 1 else f"{entry.name}.{i}"
+                handles.append(core.enqueue(
+                    ps, nm, native.REQ_BROADCAST, a,
+                    root_rank=entry.root_rank))
+            return _Pending(entry, handles, _unpack_list(arrays))
+
+        if kind == "alltoall":
+            a = np.asarray(entry.arrays[0])
+            splits = entry.splits
+            if splits is None:
+                if a.shape[0] % n != 0:
+                    raise HorovodInternalError(
+                        f"alltoall without splits requires dim0 divisible "
+                        f"by process-set size {n}")
+                splits = np.full(n, a.shape[0] // n, dtype=np.int32)
+            h = core.enqueue(ps, entry.name, native.REQ_ALLTOALL, a,
+                             splits=np.asarray(splits, dtype=np.int32))
+            return _Pending(entry, [h], _unpack_alltoall(a.dtype, self))
+
+        if kind == "reducescatter":
+            red, post_extra = self._red_op(entry, n)
+            arrays = [np.asarray(a) for a in entry.arrays]
+            handles = []
+            for i, a in enumerate(arrays):
+                nm = entry.name if len(arrays) == 1 else f"{entry.name}.{i}"
+                handles.append(core.enqueue(
+                    ps, nm, native.REQ_REDUCESCATTER, a, red_op=red,
+                    postscale=post * post_extra))
+            return _Pending(entry, handles, _unpack_list(arrays))
+
+        if kind == "barrier":
+            h = core.enqueue(ps, entry.name, native.REQ_BARRIER)
+            return _Pending(entry, [h], lambda core, hs: None)
+
+        if kind == "join":
+            h = core.enqueue(ps, "__join__", native.REQ_JOIN)
+            return _Pending(entry, [h], _unpack_join())
+
+        raise HorovodInternalError(f"unknown op kind {kind}")
+
+    # -- the cycle --------------------------------------------------------
+    def run_cycle(self):
+        """One native negotiation cycle + completion sweep. Returns the
+        number of TensorEntries completed."""
+        rc = self.core.run_cycle()
+        if rc == -2:
+            self._fail_all(HorovodInternalError(
+                "native core transport failure (peer died?)"))
+            return 0
+        done = 0
+        still = []
+        for p in self._pending:
+            states = [self.core.poll(h) for h in p.handles]
+            if any(s == 0 for s in states):
+                # Never release in-flight handles: a multi-handle entry with
+                # one early error waits until every handle is terminal so
+                # the native negotiation stays consistent.
+                still.append(p)
+            elif any(s == 2 for s in states):
+                errs = [self.core.error(h) for h, s in zip(p.handles, states)
+                        if s == 2]
+                for h in p.handles:
+                    self.core.release(h)
+                if self.entry_done_cb:
+                    self.entry_done_cb(p.entry)
+                p.entry.handle._fail(HorovodInternalError("; ".join(errs)))
+                done += 1
+            else:  # all handles done
+                try:
+                    result = p.unpack(self.core, p.handles)
+                    if self.entry_done_cb:
+                        self.entry_done_cb(p.entry)
+                    p.entry.handle._complete(result)
+                except Exception as exc:  # noqa: BLE001
+                    p.entry.handle._fail(HorovodInternalError(str(exc)))
+                finally:
+                    for h in p.handles:
+                        self.core.release(h)
+                done += 1
+        self._pending = still
+        return done
+
+    def _fail_all(self, exc):
+        for p in self._pending:
+            if self.entry_done_cb:
+                self.entry_done_cb(p.entry)
+            p.entry.handle._fail(exc)
+        self._pending = []
+
+    def pending_count(self):
+        return len(self._pending)
+
+    # -- synchronous Backend interface ------------------------------------
+    # These let the backend be used directly (without the coordinator), e.g.
+    # from unit tests. Each drives cycles inline until completion.
+    def _sync(self, entry):
+        from ..coordinator import TensorEntry  # noqa: F401  (type only)
+        if not self.submit_entry(entry):
+            entry.handle.wait(0)
+        while any(p.entry is entry for p in self._pending):
+            self.run_cycle()
+        return entry.handle.wait(300)
+
+    def allreduce(self, arrays, op, process_set, prescale=None,
+                  postscale=None):
+        from ..coordinator import TensorEntry
+        e = TensorEntry(_name("allreduce"), "allreduce", list(arrays),
+                        process_set, op=op, prescale=prescale,
+                        postscale=postscale)
+        out = self._sync(e)
+        return out if isinstance(out, list) else [out]
+
+    def allgather(self, arrays, process_set):
+        from ..coordinator import TensorEntry
+        e = TensorEntry(_name("allgather"), "allgather", list(arrays),
+                        process_set)
+        out = self._sync(e)
+        return out if isinstance(out, list) else [out]
+
+    def broadcast(self, arrays, root_rank, process_set):
+        from ..coordinator import TensorEntry
+        e = TensorEntry(_name("broadcast"), "broadcast", list(arrays),
+                        process_set, root_rank=root_rank)
+        out = self._sync(e)
+        return out if isinstance(out, list) else [out]
+
+    def alltoall(self, array, splits, process_set):
+        from ..coordinator import TensorEntry
+        e = TensorEntry(_name("alltoall"), "alltoall", [array], process_set,
+                        splits=splits)
+        return self._sync(e)
+
+    def reducescatter(self, arrays, op, process_set):
+        from ..coordinator import TensorEntry
+        e = TensorEntry(_name("reducescatter"), "reducescatter", list(arrays),
+                        process_set, op=op)
+        out = self._sync(e)
+        return out if isinstance(out, list) else [out]
+
+    def barrier(self, process_set):
+        from ..coordinator import TensorEntry
+        e = TensorEntry(_name("barrier"), "barrier", [], process_set)
+        self._sync(e)
+
+    def join(self, device=-1):
+        from ..coordinator import TensorEntry
+        from ..process_sets import global_process_set
+        e = TensorEntry(_name("join"), "join", [], global_process_set)
+        return self._sync(e)
+
+    def close(self):
+        try:
+            self.core.request_shutdown()
+            # Bounded drain through the FULL cycle (completion sweep
+            # included) so waiters on in-flight entries resolve; peers must
+            # agree before the consensus shutdown lands.
+            for _ in range(10000):
+                if self.core.shutdown_complete():
+                    break
+                self.run_cycle()
+            self._fail_all(HorovodInternalError(
+                "runtime shut down with operations in flight"))
+        finally:
+            self.core.close()
+
+
+_counter = [0]
+
+
+def _name(kind):
+    _counter[0] += 1
+    return f"{kind}.sync.{_counter[0]}"
+
+
+# -- unpack helpers (native outputs -> framework results) ------------------
+
+def _to_jax(arr):
+    import jax.numpy as jnp
+    return jnp.asarray(arr)
+
+
+def _unpack_single(dtype, shape):
+    def unpack(core, handles):
+        out = core.output(handles[0], dtype)
+        return _to_jax(out.reshape(shape))
+    return unpack
+
+
+def _unpack_group(arrays):
+    shapes = [a.shape for a in arrays]
+    sizes = [a.size for a in arrays]
+    dtype = arrays[0].dtype
+
+    def unpack(core, handles):
+        flat = core.output(handles[0], dtype)
+        outs, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            outs.append(_to_jax(flat[off:off + size].reshape(shape)))
+            off += size
+        return outs
+    return unpack
+
+
+def _unpack_list(arrays):
+    dtypes = [a.dtype for a in arrays]
+
+    def unpack(core, handles):
+        outs = [_to_jax(core.output(h, dt))
+                for h, dt in zip(handles, dtypes)]
+        return outs if len(outs) > 1 else outs[0]
+    return unpack
+
+
+def _unpack_alltoall(dtype, backend):
+    def unpack(core, handles):
+        out = core.output(handles[0], dtype)
+        splits = core.recv_splits(handles[0])
+        return _to_jax(out), splits
+    return unpack
+
+
+def _unpack_join():
+    def unpack(core, handles):
+        out = core.output(handles[0], np.int32).reshape(-1)
+        return int(out[0]) if out.size else -1
+    return unpack

@@ -169,6 +169,10 @@ class Coordinator:
 
     # -- background cycle --------------------------------------------------
     def _loop(self):
+        backend = self.runtime.backend
+        if getattr(backend, "drives_own_cycle", False):
+            self._loop_native(backend)
+            return
         while self._running:
             self._wakeup.wait(timeout=0.25)
             self._wakeup.clear()
@@ -176,6 +180,25 @@ class Coordinator:
                 break
             time.sleep(self.cycle_time_s)
             self._run_cycle()
+
+    def _loop_native(self, backend):
+        """SPMD mode: the native core owns negotiation and fusion — local
+        grouping decisions would diverge across ranks, so every entry is
+        handed to the native controller and the loop just drives cycles
+        (the analog of the reference background thread calling RunLoopOnce,
+        reference: horovod/common/operations.cc:706). Cycles run even with
+        an empty local queue: peers may need this rank for negotiation."""
+        backend.entry_done_cb = self._release_name
+        while self._running:
+            time.sleep(self.cycle_time_s)
+            with self._lock:
+                batch = self._queue
+                self._queue = []
+            for e in batch:
+                backend.submit_entry(e)
+            self.cycles += 1
+            self.tensors_processed += backend.run_cycle()
+            self.bytes_processed = backend.core.bytes_processed()
 
     def _run_cycle(self):
         with self._lock:

@@ -288,8 +288,13 @@ def join(device=-1):
     if rt.mode == basics.MODE_SINGLE:
         barrier()
         return rt.size - 1
-    if hasattr(rt.backend, "join"):
-        return rt.backend.join(device)
+    if getattr(rt.backend, "drives_own_cycle", False):
+        # SPMD: submit through the coordinator so the background thread
+        # stays the only cycle driver; the native core pads this rank into
+        # peers' collectives with zeros until everyone joins.
+        entry = TensorEntry(_auto_name("join"), "join", [],
+                            global_process_set)
+        return synchronize(_submit(entry))
     barrier()
     return rt.size - 1
 

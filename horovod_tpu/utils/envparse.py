@@ -83,6 +83,7 @@ LOCAL_RANK = "LOCAL_RANK"
 LOCAL_SIZE = "LOCAL_SIZE"
 CROSS_RANK = "CROSS_RANK"
 CROSS_SIZE = "CROSS_SIZE"
+PEERS = "PEERS"                                # "host:port,..." one per rank
 RENDEZVOUS_ADDR = "RENDEZVOUS_ADDR"            # analog of HOROVOD_GLOO_RENDEZVOUS_ADDR
 RENDEZVOUS_PORT = "RENDEZVOUS_PORT"
 CONTROLLER = "CONTROLLER"                      # 'tcp' | 'loopback'

@@ -1,0 +1,164 @@
+"""Worker script for SPMD multi-process tests (launched by test_spmd.py).
+
+Runs the full framework API as one rank of an N-process job — the analog of
+the reference's parallel test suite executed under horovodrun
+(reference: test/parallel/test_torch.py run at np=2, .buildkite/
+gen-pipeline.sh:231). Asserts rank-locally; any failure exits non-zero.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    assert hvd.is_initialized()
+    assert 0 <= rank < size
+
+    # -- allreduce: average (default) and sum ------------------------------
+    x = jnp.arange(8, dtype=jnp.float32) * (rank + 1)
+    avg = hvd.allreduce(x, name="ar.avg")
+    factor = sum(r + 1 for r in range(size)) / size
+    np.testing.assert_allclose(np.asarray(avg),
+                               np.arange(8, dtype=np.float32) * factor,
+                               rtol=1e-5)
+    tot = hvd.allreduce(x, name="ar.sum", op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(tot),
+                               np.arange(8, dtype=np.float32) * factor * size,
+                               rtol=1e-5)
+
+    # Steady state: same names again must ride the response-cache fast path.
+    for _ in range(3):
+        again = hvd.allreduce(x, name="ar.avg")
+        np.testing.assert_allclose(np.asarray(again), np.asarray(avg),
+                                   rtol=1e-6)
+
+    # -- grouped allreduce --------------------------------------------------
+    ts = [jnp.full((3,), float(rank), jnp.float32),
+          jnp.full((2, 2), float(rank) * 2, jnp.float32)]
+    outs = hvd.grouped_allreduce(ts, name="gar", op=hvd.Sum)
+    sum_ranks = sum(range(size))
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((3,), sum_ranks))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((2, 2), 2.0 * sum_ranks))
+
+    # -- min / max / product -----------------------------------------------
+    v = jnp.full((4,), float(rank + 1), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(v, name="mn", op=hvd.Min)), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(v, name="mx", op=hvd.Max)), float(size))
+    prod = 1.0
+    for r in range(size):
+        prod *= r + 1
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(v, name="pr", op=hvd.Product)), prod)
+
+    # -- prescale / postscale ----------------------------------------------
+    s = hvd.allreduce(jnp.ones(4, jnp.float32), name="scaled", op=hvd.Sum,
+                      prescale_factor=2.0, postscale_factor=0.5)
+    np.testing.assert_allclose(np.asarray(s), float(size))
+
+    # -- ragged allgather ---------------------------------------------------
+    mine = jnp.full((rank + 1, 2), float(rank), jnp.float32)
+    gathered = hvd.allgather(mine, name="ag")
+    total_rows = sum(r + 1 for r in range(size))
+    assert gathered.shape == (total_rows, 2), gathered.shape
+    off = 0
+    for r in range(size):
+        np.testing.assert_allclose(np.asarray(gathered[off:off + r + 1]),
+                                   float(r))
+        off += r + 1
+
+    # -- broadcast ----------------------------------------------------------
+    b = jnp.full((5,), float(rank), jnp.float32)
+    out = hvd.broadcast(b, root_rank=min(1, size - 1), name="bc")
+    np.testing.assert_allclose(np.asarray(out), float(min(1, size - 1)))
+
+    # -- broadcast_object ---------------------------------------------------
+    obj = {"rank": rank, "payload": list(range(10))}
+    got = hvd.broadcast_object(obj, root_rank=0, name="bo")
+    assert got["rank"] == 0 and got["payload"] == list(range(10))
+
+    # -- alltoall -----------------------------------------------------------
+    splits = jnp.array([1] * size, jnp.int32)
+    a2a_in = jnp.arange(size, dtype=jnp.float32) + 100 * rank
+    a2a_out, rsplits = hvd.alltoall(a2a_in, splits=splits, name="a2a")
+    np.testing.assert_array_equal(np.asarray(rsplits), np.ones(size))
+    np.testing.assert_allclose(
+        np.asarray(a2a_out),
+        np.array([100.0 * r + rank for r in range(size)], np.float32))
+
+    # -- reducescatter -------------------------------------------------------
+    rs_in = jnp.ones((2 * size, 3), jnp.float32) * (rank + 1)
+    rs_out = hvd.reducescatter(rs_in, op=hvd.Sum, name="rs")
+    assert rs_out.shape == (2, 3), rs_out.shape
+    np.testing.assert_allclose(np.asarray(rs_out),
+                               sum(r + 1 for r in range(size)))
+
+    # -- barrier ------------------------------------------------------------
+    hvd.barrier()
+
+    # -- duplicate name rejection -------------------------------------------
+    h1 = hvd.allreduce_async(jnp.ones(1024, jnp.float32), name="dup")
+    try:
+        try:
+            hvd.allreduce_async(jnp.ones(1024, jnp.float32), name="dup")
+            raised = False
+        except hvd.DuplicateNameError:
+            raised = True
+        assert raised, "duplicate name must be rejected"
+    finally:
+        hvd.synchronize(h1)
+
+    # -- cross-rank validation error ----------------------------------------
+    bad_shape = (3,) if rank == 0 else (4,)
+    try:
+        hvd.allreduce(jnp.zeros(bad_shape, jnp.float32), name="bad")
+        failed = False
+    except hvd.HorovodInternalError as e:
+        failed = "mismatched" in str(e)
+    assert failed, "shape mismatch must fail on every rank"
+
+    # -- process sets --------------------------------------------------------
+    # A strict subset (a set equal to the global one is rejected, matching
+    # the reference's duplicate-ranks check).
+    members = [0, size - 1] if size >= 3 else [0]
+    ps = hvd.add_process_set(members)
+    if rank in members:
+        r = hvd.allreduce(jnp.full((3,), float(rank + 1)), op=hvd.Sum,
+                          name="ps.ar", process_set=ps)
+        np.testing.assert_allclose(np.asarray(r),
+                                   sum(m + 1 for m in members))
+        assert ps.rank() == members.index(rank)
+    else:
+        assert ps.rank() is None
+        assert not ps.included()
+    hvd.remove_process_set(ps)
+
+    # -- join with unequal work ---------------------------------------------
+    if rank % 2 == 1:
+        last = hvd.join()
+        assert 0 <= last < size
+    else:
+        extra = hvd.allreduce(jnp.ones(6, jnp.float32), op=hvd.Sum,
+                              name="tail")
+        evens = len([r for r in range(size) if r % 2 == 0])
+        np.testing.assert_allclose(np.asarray(extra), float(evens))
+        hvd.join()
+
+    hvd.shutdown()
+    print(f"rank {rank}/{size}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Native C++ core binding tests: N ranks as N threads over the in-process
+local transport (csrc/transport.h LocalTransport).
+
+This exercises the ctypes marshaling layer plus the negotiation protocol
+without subprocesses; the full multi-process TCP path is covered by
+test_spmd.py. (Reference analog: the controller is only ever tested under
+real launchers, test/parallel/; the in-process hub makes it unit-testable.)
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu import native
+
+
+def run_ranks(size, fn, job):
+    """Run fn(core, rank) on `size` ranks, each a thread with its own core."""
+    errors = []
+
+    def worker(rank):
+        core = native.NativeCore(rank, size, transport="local", peers=job)
+        try:
+            fn(core, rank)
+            core.request_shutdown()
+            while not core.shutdown_complete():
+                if core.run_cycle() < 0:
+                    break
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            core.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, f"rank failures: {errors}"
+
+
+def drive(core, h):
+    while core.poll(h) == 0:
+        rc = core.run_cycle()
+        assert rc >= 0, "cycle failed"
+
+
+def test_allreduce_sum_threads():
+    def fn(core, rank):
+        x = np.arange(10, dtype=np.float32) * (rank + 1)
+        h = core.enqueue(0, "t", native.REQ_ALLREDUCE, x)
+        drive(core, h)
+        assert core.poll(h) == 1, core.error(h)
+        out = core.output(h, np.float32).reshape(10)
+        factor = sum(r + 1 for r in range(3))
+        np.testing.assert_allclose(out, np.arange(10, dtype=np.float32) * factor)
+        core.release(h)
+
+    run_ranks(3, fn, "pytest-allreduce")
+
+
+def test_average_via_postscale_and_cache_path():
+    def fn(core, rank):
+        # Three identical steps: step 2+ rides the bitvector cache fast path.
+        for step in range(3):
+            x = np.full((4, 4), float(rank), dtype=np.float64)
+            h = core.enqueue(0, "avg", native.REQ_ALLREDUCE, x,
+                             postscale=1.0 / 2)
+            drive(core, h)
+            assert core.poll(h) == 1, core.error(h)
+            out = core.output(h, np.float64)
+            np.testing.assert_allclose(out, np.full((4, 4), 0.5))
+            core.release(h)
+
+    run_ranks(2, fn, "pytest-avg")
+
+
+def test_error_mismatched_shapes():
+    def fn(core, rank):
+        x = np.zeros(4 if rank == 0 else 5, dtype=np.float32)
+        h = core.enqueue(0, "bad", native.REQ_ALLREDUCE, x)
+        drive(core, h)
+        assert core.poll(h) == 2
+        assert "mismatched shapes" in core.error(h)
+        core.release(h)
+
+    run_ranks(2, fn, "pytest-mismatch")
+
+
+def test_bfloat16_allreduce():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+
+    def fn(core, rank):
+        x = np.ones(16, dtype=bf16)
+        h = core.enqueue(0, "bf", native.REQ_ALLREDUCE, x)
+        drive(core, h)
+        assert core.poll(h) == 1, core.error(h)
+        out = core.output(h, bf16)
+        np.testing.assert_allclose(out.astype(np.float32), 2.0)
+        core.release(h)
+
+    run_ranks(2, fn, "pytest-bf16")
+
+
+def test_alltoall_recv_splits():
+    def fn(core, rank):
+        n = 2
+        splits = np.array([1, 2], dtype=np.int32)
+        x = np.arange(3, dtype=np.int64) + 10 * rank
+        h = core.enqueue(0, "a2a", native.REQ_ALLTOALL, x, splits=splits)
+        drive(core, h)
+        assert core.poll(h) == 1, core.error(h)
+        out = core.output(h, np.int64)
+        rs = core.recv_splits(h)
+        if rank == 0:
+            np.testing.assert_array_equal(rs, [1, 1])
+            np.testing.assert_array_equal(out, [0, 10])
+        else:
+            np.testing.assert_array_equal(rs, [2, 2])
+            np.testing.assert_array_equal(out, [1, 2, 11, 12])
+        core.release(h)
+        del n
+
+    run_ranks(2, fn, "pytest-a2a")
+
+
+def test_timeline_written(tmp_path):
+    paths = {r: str(tmp_path / f"tl.{r}.json") for r in range(2)}
+    done = []
+
+    def worker(rank):
+        core = native.NativeCore(rank, 2, transport="local",
+                                 peers="pytest-timeline",
+                                 timeline_path=paths[rank])
+        x = np.ones(8, dtype=np.float32)
+        h = core.enqueue(0, "tl", native.REQ_ALLREDUCE, x)
+        drive(core, h)
+        core.release(h)
+        core.request_shutdown()
+        while not core.shutdown_complete():
+            if core.run_cycle() < 0:
+                break
+        core.close()
+        done.append(rank)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sorted(done) == [0, 1]
+    import json
+    for r in range(2):
+        events = json.load(open(paths[r]))
+        names = {e.get("name") for e in events}
+        assert "NEGOTIATE" in names
+        assert "RING_ALLREDUCE" in names or "EXEC" in names

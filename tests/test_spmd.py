@@ -1,0 +1,73 @@
+"""Multi-process SPMD tests: N local processes over the TCP data plane.
+
+The analog of the reference CI running every parallel test under the
+launcher at np=2 on localhost (reference: .buildkite/gen-pipeline.sh:231,
+test/parallel/). Workers run tests/spmd_worker.py; this file only spawns,
+plumbs env (the launcher's job, reference: horovod/runner/gloo_run.py:65-77)
+and checks exit codes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "spmd_worker.py")
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def launch(size, script=WORKER, extra_env=None, timeout=180):
+    ports = free_ports(size)
+    peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.update({
+            "HVDTPU_RANK": str(rank),
+            "HVDTPU_SIZE": str(size),
+            "HVDTPU_LOCAL_RANK": str(rank),
+            "HVDTPU_LOCAL_SIZE": str(size),
+            "HVDTPU_CROSS_RANK": "0",
+            "HVDTPU_CROSS_SIZE": "1",
+            "HVDTPU_PEERS": peers,
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.pop("XLA_FLAGS", None)
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    codes = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+        codes.append(p.returncode)
+    return codes, outs
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_spmd_full_api(size):
+    codes, outs = launch(size)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+        assert f"rank {rank}/{size}: OK" in out
