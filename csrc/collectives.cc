@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cmath>
 #include <cstring>
+#include <limits>
 
 namespace hvdcore {
 namespace {
@@ -42,6 +43,10 @@ uint16_t FloatToHalf(float f) {
   uint32_t sign = (bits >> 16) & 0x8000u;
   int32_t exp = static_cast<int32_t>((bits >> 23) & 0xFF) - 127 + 15;
   uint32_t mant = bits & 0x7FFFFF;
+  // NaN must stay NaN (not clamp to Inf) so numerical errors upstream of an
+  // fp16 reduction are not silently masked.
+  if (((bits >> 23) & 0xFF) == 0xFF && mant != 0)
+    return static_cast<uint16_t>(sign | 0x7E00);
   if (exp <= 0) {
     if (exp < -10) return static_cast<uint16_t>(sign);
     mant |= 0x800000;
@@ -216,6 +221,72 @@ void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
     }
     default:
       break;  // uint8/int8/bool: scaling not meaningful
+  }
+}
+
+namespace {
+
+template <typename T>
+void FillTyped(void* buf, int64_t count, T value) {
+  T* p = static_cast<T*>(buf);
+  for (int64_t i = 0; i < count; ++i) p[i] = value;
+}
+
+}  // namespace
+
+void FillReduceIdentity(void* buf, int64_t count, DataType dtype, RedOp op) {
+  if (op == RedOp::kSum) {
+    std::memset(buf, 0, static_cast<size_t>(count) * DataTypeSize(dtype));
+    return;
+  }
+  float fval = op == RedOp::kProd
+                   ? 1.0f
+                   : (op == RedOp::kMin ? std::numeric_limits<float>::infinity()
+                                        : -std::numeric_limits<float>::infinity());
+  switch (dtype) {
+    case DataType::kFloat32:
+      FillTyped<float>(buf, count, fval);
+      break;
+    case DataType::kFloat64:
+      FillTyped<double>(buf, count, static_cast<double>(fval));
+      break;
+    case DataType::kFloat16:
+      FillTyped<uint16_t>(buf, count, FloatToHalf(fval));
+      break;
+    case DataType::kBFloat16:
+      FillTyped<uint16_t>(buf, count, FloatToBF16(fval));
+      break;
+    case DataType::kInt32:
+      FillTyped<int32_t>(buf, count,
+                         op == RedOp::kProd ? 1
+                         : op == RedOp::kMin
+                             ? std::numeric_limits<int32_t>::max()
+                             : std::numeric_limits<int32_t>::lowest());
+      break;
+    case DataType::kInt64:
+      FillTyped<int64_t>(buf, count,
+                         op == RedOp::kProd ? 1
+                         : op == RedOp::kMin
+                             ? std::numeric_limits<int64_t>::max()
+                             : std::numeric_limits<int64_t>::lowest());
+      break;
+    case DataType::kUint8:
+      FillTyped<uint8_t>(buf, count,
+                         op == RedOp::kProd ? 1
+                         : op == RedOp::kMin
+                             ? std::numeric_limits<uint8_t>::max()
+                             : std::numeric_limits<uint8_t>::lowest());
+      break;
+    case DataType::kInt8:
+      FillTyped<int8_t>(buf, count,
+                        op == RedOp::kProd ? 1
+                        : op == RedOp::kMin ? std::numeric_limits<int8_t>::max()
+                                            : std::numeric_limits<int8_t>::lowest());
+      break;
+    case DataType::kBool:
+      // min/prod identity = 1 (true), max identity = 0 (false)
+      FillTyped<uint8_t>(buf, count, op == RedOp::kMax ? 0 : 1);
+      break;
   }
 }
 

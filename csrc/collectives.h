@@ -55,6 +55,12 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
 // reference: ScaleBufferCPUImpl, horovod/common/ops/collective_operations.h:91).
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
 
+// Fill `count` elements with the identity of `op` (0 for sum, +max for min,
+// lowest for max, 1 for prod) — the contribution of a joined/entry-less rank
+// to a fused reduction (the reference restricts Join to sum, where zero is
+// the identity; using the true identity extends it to min/max/prod).
+void FillReduceIdentity(void* buf, int64_t count, DataType dtype, RedOp op);
+
 }  // namespace hvdcore
 
 #endif  // HVDCORE_COLLECTIVES_H_

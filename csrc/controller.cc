@@ -409,6 +409,7 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
   std::map<size_t, Request> hit_candidates;
   for (Request& req : pending) {
     if (req.type == ReqType::kBarrier || req.type == ReqType::kJoin) {
+      if (req.type == ReqType::kJoin) local_joined_ = true;
       uncached.push_back(std::move(req));
       continue;
     }
@@ -430,6 +431,20 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
       case ResponseCache::CacheState::kMiss:
         uncached.push_back(std::move(req));
         break;
+    }
+  }
+
+  if (local_joined_) {
+    // A joined rank submits nothing; report every cache bit as a hit so the
+    // training ranks' AND-agreement still succeeds. Cached non-allreduce
+    // responses carry per-rank sizes that are stale once this rank joins —
+    // invalidate them everywhere so they renegotiate join-aware.
+    hit_bits.clear();
+    for (size_t bit : cache_.BitsInInsertionOrder()) {  // live slots only
+      if (cache_.Get(bit).type == ReqType::kAllreduce)
+        hit_bits.push_back(bit);
+      else
+        invalid_bits.push_back(bit);
     }
   }
 
@@ -471,7 +486,10 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
   for (size_t bit : order) {
     if (!agreed.count(bit)) continue;
     cache_.Touch(bit);
-    if (std::binary_search(my_agreed.begin(), my_agreed.end(), bit))
+    // A joined rank executes every agreed cached response entry-less (ring
+    // collectives need all ranks); others execute only what they requested.
+    if (local_joined_ ||
+        std::binary_search(my_agreed.begin(), my_agreed.end(), bit))
       ready_responses.push_back(cache_.Get(bit));
   }
 
@@ -552,6 +570,7 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
     // Every rank caches the negotiated responses in identical order so
     // cache-bit layouts agree next cycle.
     for (const Response& r : negotiated.responses) {
+      if (r.type == ReqType::kJoin) local_joined_ = false;  // all joined
       if (!Cacheable(r) || r.names.size() != 1) {
         ready_responses.push_back(r);
         continue;

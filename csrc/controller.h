@@ -110,6 +110,13 @@ class Controller {
   };
   std::map<std::string, TableEntry> message_table_;
   std::set<int> joined_ranks_;
+  // True between this rank submitting a Join and the all-joined response.
+  // A joined rank submits nothing, so it must (a) report every cache bit as
+  // a hit so the bitwise-AND agreement can still succeed for the training
+  // ranks (reference: joined ranks record all cache bits,
+  // horovod/common/controller.cc:129-133), and (b) execute agreed cached
+  // responses entry-less so ring collectives do not hang on it.
+  bool local_joined_ = false;
   double last_stall_check_ = 0.0;
 };
 

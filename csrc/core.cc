@@ -333,12 +333,18 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
       }
       handles[i] = it->second;
       entries[i] = hit->second.get();
+      executing_handles_.insert(it->second);
     }
   }
   auto finish = [&](size_t i, HandleState state, const std::string& err) {
     if (handles[i] < 0) return;
     std::lock_guard<std::mutex> g(mu_);
-    ps.inflight.erase(resp.names[i]);
+    // Only drop the inflight mapping if it still points at the handle we
+    // resolved — a Release + same-name resubmit mid-flight installs a new
+    // handle that must keep its mapping.
+    auto it = ps.inflight.find(resp.names[i]);
+    if (it != ps.inflight.end() && it->second == handles[i])
+      ps.inflight.erase(it);
     CompleteHandle(handles[i], state, err);
     ++*completed;
   };
@@ -346,9 +352,16 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
     for (size_t i = 0; i < resp.names.size(); ++i)
       finish(i, HandleState::kError, err);
   };
+  auto unpin = [&] {
+    std::lock_guard<std::mutex> g(mu_);
+    for (int64_t h : handles)
+      if (h >= 0) executing_handles_.erase(h);
+    if (executing_handles_.empty()) zombies_.clear();
+  };
 
   if (!resp.error.empty()) {
     fail_all(resp.error);
+    unpin();
     return;
   }
   if (timeline_ && !resp.names.empty())
@@ -372,7 +385,10 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
             std::memcpy(ps.fusion_buffer.data() + off,
                         entries[i]->input.data(), n);
           else
-            std::memset(ps.fusion_buffer.data() + off, 0, n);  // joined rank
+            // Joined/entry-less rank: contribute the op's identity element
+            // (zeros would corrupt min/max/prod results).
+            FillReduceIdentity(ps.fusion_buffer.data() + off, resp.sizes[i],
+                               resp.dtype, resp.op);
           off += n;
         }
         buf = ps.fusion_buffer.data();
@@ -550,6 +566,7 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
   }
   if (!st.ok()) fail_all(st.reason);
   if (timeline_ && !resp.names.empty()) timeline_->OpEnd(resp.names[0]);
+  unpin();
 }
 
 HandleState Core::Poll(int64_t handle, std::string* error) {
@@ -594,7 +611,14 @@ void Core::Release(int64_t handle) {
         ++it;
     }
   }
-  handles_.erase(handle);
+  auto h = handles_.find(handle);
+  if (h != handles_.end()) {
+    // The cycle thread may hold a raw Entry* for this handle mid-collective
+    // (mu_ is dropped during network execution) — defer destruction until
+    // the response finishes instead of freeing under its feet.
+    if (executing_handles_.count(handle)) zombies_.push_back(std::move(h->second));
+    handles_.erase(h);
+  }
 }
 
 }  // namespace hvdcore

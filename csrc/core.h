@@ -21,6 +21,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -172,6 +173,11 @@ class Core {
   int next_ps_id_ = 1;
   uint32_t next_channel_ = 1;
   std::map<int64_t, std::unique_ptr<Entry>> handles_;
+  // Entries pinned by an in-flight ExecuteResponse (raw Entry* held without
+  // mu_ during network execution). Release() defers destruction of pinned
+  // entries into zombies_, freed when the response finishes.
+  std::set<int64_t> executing_handles_;
+  std::vector<std::unique_ptr<Entry>> zombies_;
   int64_t next_handle_ = 0;
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> shutdown_complete_{false};

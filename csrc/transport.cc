@@ -368,8 +368,12 @@ Status TcpTransport::SendRecv(int to, const void* sdata, size_t slen, int from,
     if (pr == 0)
       return Status::Error(StatusCode::kUnknownError, "sendrecv timeout");
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
+      // MSG_DONTWAIT: a blocking send() would sleep until the peer drains
+      // its receive buffer — with every rank in the ring sending at once
+      // that deadlocks as soon as the payload exceeds sndbuf+rcvbuf. A
+      // partial nonblocking write keeps the recv direction serviced.
       ssize_t w = ::send(sfd, sbuf.data() + sent, sbuf.size() - sent,
-                         MSG_NOSIGNAL);
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EINTR && errno != EAGAIN)
         return Status::Error(StatusCode::kUnknownError,
                              std::string("send: ") + std::strerror(errno));

@@ -105,6 +105,17 @@ def main():
     np.testing.assert_allclose(np.asarray(rs_out),
                                sum(r + 1 for r in range(size)))
 
+    # -- large payload -------------------------------------------------------
+    # Per-step SendRecv payloads here far exceed kernel socket buffers; a
+    # blocking send in the duplex exchange would deadlock the ring
+    # (regression: transport.cc SendRecv must use nonblocking partial writes).
+    big = jnp.ones((4 * 1024 * 1024,), jnp.float32) * (rank + 1)
+    big_sum = hvd.allreduce(big, name="big", op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(big_sum[:4]),
+                               sum(r + 1 for r in range(size)))
+    np.testing.assert_allclose(np.asarray(big_sum[-4:]),
+                               sum(r + 1 for r in range(size)))
+
     # -- barrier ------------------------------------------------------------
     hvd.barrier()
 
@@ -154,6 +165,23 @@ def main():
                               name="tail")
         evens = len([r for r in range(size) if r % 2 == 0])
         np.testing.assert_allclose(np.asarray(extra), float(evens))
+        # Cached tensor with peers joined: rides the cache fast path, which
+        # requires joined ranks to report all-hit bitvectors and execute the
+        # agreed responses entry-less (regression: joined-rank cache
+        # livelock, controller.cc local_joined_). "ar.avg" was cached by the
+        # steady-state loop above (same params: Average); joined ranks
+        # contribute the sum identity, the average still divides by size.
+        again = hvd.allreduce(x, name="ar.avg")
+        np.testing.assert_allclose(
+            np.asarray(again),
+            np.arange(8, dtype=np.float32) * sum(
+                r + 1 for r in range(size) if r % 2 == 0) / size,
+            rtol=1e-5)
+        # Min with peers joined: joined ranks must contribute the op's
+        # identity (+inf), not zeros (regression: core.cc joined-rank fill).
+        mn = hvd.allreduce(jnp.full((4,), float(rank + 7), jnp.float32),
+                           name="tail.min", op=hvd.Min)
+        np.testing.assert_allclose(np.asarray(mn), 7.0)
         hvd.join()
 
     hvd.shutdown()
