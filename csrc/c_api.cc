@@ -56,6 +56,10 @@ void* hvd_core_create(int rank, int size, const char* transport,
 
 void hvd_core_destroy(void* h) { delete static_cast<Ctx*>(h); }
 
+// Rendezvous bootstrap: reserve (bind+listen) an ephemeral port that a
+// later hvd_core_create consumes, closing the publish-then-rebind race.
+int hvd_reserve_listen_port() { return ReserveListenPort(); }
+
 int hvd_core_rank(void* h) { return static_cast<Ctx*>(h)->core->rank(); }
 int hvd_core_size(void* h) { return static_cast<Ctx*>(h)->core->size(); }
 

@@ -83,6 +83,11 @@ float BF16ToFloat(uint16_t b) {
 uint16_t FloatToBF16(float f) {
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
+  // NaN must stay NaN: round-to-nearest-even below can carry a NaN mantissa
+  // into the exponent (0x7FFFFFFF -> -0.0, sNaN -> Inf), silently masking
+  // upstream numerical errors — same guard as FloatToHalf above.
+  if ((bits & 0x7F800000u) == 0x7F800000u && (bits & 0x7FFFFFu) != 0)
+    return static_cast<uint16_t>(((bits >> 16) & 0x8000u) | 0x7FC0u);
   // round-to-nearest-even on the truncated 16 bits
   uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
   return static_cast<uint16_t>((bits + rounding) >> 16);

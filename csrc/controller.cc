@@ -438,14 +438,19 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
     // A joined rank submits nothing; report every cache bit as a hit so the
     // training ranks' AND-agreement still succeeds. Cached non-allreduce
     // responses carry per-rank sizes that are stale once this rank joins —
-    // invalidate them everywhere so they renegotiate join-aware.
+    // invalidate them ONCE at the join transition so they renegotiate
+    // join-aware; anything re-cached after that is already join-aware, and
+    // re-invalidating every cycle would force slow-path negotiation for the
+    // whole joined period.
     hit_bits.clear();
     for (size_t bit : cache_.BitsInInsertionOrder()) {  // live slots only
-      if (cache_.Get(bit).type == ReqType::kAllreduce)
+      if (joined_cache_flushed_ ||
+          cache_.Get(bit).type == ReqType::kAllreduce)
         hit_bits.push_back(bit);
       else
         invalid_bits.push_back(bit);
     }
+    joined_cache_flushed_ = true;
   }
 
   if (timeline_)
@@ -570,7 +575,10 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
     // Every rank caches the negotiated responses in identical order so
     // cache-bit layouts agree next cycle.
     for (const Response& r : negotiated.responses) {
-      if (r.type == ReqType::kJoin) local_joined_ = false;  // all joined
+      if (r.type == ReqType::kJoin) {
+        local_joined_ = false;  // all joined
+        joined_cache_flushed_ = false;
+      }
       if (!Cacheable(r) || r.names.size() != 1) {
         ready_responses.push_back(r);
         continue;

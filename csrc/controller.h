@@ -117,6 +117,9 @@ class Controller {
   // horovod/common/controller.cc:129-133), and (b) execute agreed cached
   // responses entry-less so ring collectives do not hang on it.
   bool local_joined_ = false;
+  // Whether the one-time join-transition cache invalidation already ran
+  // (stale non-allreduce sizes renegotiate once, then cache hits resume).
+  bool joined_cache_flushed_ = false;
   double last_stall_check_ = 0.0;
 };
 

@@ -381,14 +381,20 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
         size_t off = 0;
         for (size_t i = 0; i < resp.names.size(); ++i) {
           size_t n = static_cast<size_t>(resp.sizes[i]) * esize;
-          if (entries[i])
+          if (entries[i]) {
             std::memcpy(ps.fusion_buffer.data() + off,
                         entries[i]->input.data(), n);
-          else
+            // Prescale applies to contributed data only; the identity
+            // slots below must stay exact (0.5 * 1.0 would corrupt prod).
+            if (resp.prescale != 1.0)
+              ScaleBuffer(ps.fusion_buffer.data() + off, resp.sizes[i],
+                          resp.dtype, resp.prescale);
+          } else {
             // Joined/entry-less rank: contribute the op's identity element
             // (zeros would corrupt min/max/prod results).
             FillReduceIdentity(ps.fusion_buffer.data() + off, resp.sizes[i],
                                resp.dtype, resp.op);
+          }
           off += n;
         }
         buf = ps.fusion_buffer.data();
@@ -396,7 +402,7 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
       } else {
         buf = entries[0]->input.data();
       }
-      if (resp.prescale != 1.0)
+      if (!fused && resp.prescale != 1.0)
         ScaleBuffer(buf, total, resp.dtype, resp.prescale);
       if (timeline_) timeline_->ActivityStart(resp.names[0], "RING_ALLREDUCE");
       st = RingAllreduce(view, buf, total, resp.dtype, resp.op);

@@ -180,6 +180,51 @@ Status ReadAll(int fd, void* data, size_t len) {
 
 }  // namespace
 
+// Ports reserved by ReserveListenPort(), keyed by port number. The fd stays
+// bound+listening from reservation until TcpTransport::Create consumes it.
+namespace {
+std::mutex g_reserved_mu;
+std::map<int, int> g_reserved_listeners;  // port -> listening fd
+}  // namespace
+
+int ReserveListenPort() {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = 0;  // ephemeral
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int port = ntohs(addr.sin_port);
+  std::lock_guard<std::mutex> lock(g_reserved_mu);
+  auto it = g_reserved_listeners.find(port);
+  if (it != g_reserved_listeners.end()) ::close(it->second);
+  g_reserved_listeners[port] = fd;
+  return port;
+}
+
+namespace {
+int TakeReservedListenFd(int port) {
+  std::lock_guard<std::mutex> lock(g_reserved_mu);
+  auto it = g_reserved_listeners.find(port);
+  if (it == g_reserved_listeners.end()) return -1;
+  int fd = it->second;
+  g_reserved_listeners.erase(it);
+  return fd;
+}
+}  // namespace
+
 Status TcpTransport::Create(int rank, const std::vector<std::string>& peers,
                             double timeout_s,
                             std::unique_ptr<TcpTransport>* out) {
@@ -191,24 +236,31 @@ Status TcpTransport::Create(int rank, const std::vector<std::string>& peers,
   Status st = ParseHostPort(peers[rank], &host, &port);
   if (!st.ok()) return st;
 
-  // Listen socket for this rank — bind to all interfaces at our port.
-  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (listen_fd < 0)
-    return Status::Error(StatusCode::kUnknownError, "socket() failed");
-  int one = 1;
-  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = INADDR_ANY;
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
-    ::close(listen_fd);
-    return Status::Error(StatusCode::kUnknownError,
-                         "bind " + peers[rank] + ": " + std::strerror(errno));
-  }
-  if (::listen(listen_fd, size) < 0) {
-    ::close(listen_fd);
-    return Status::Error(StatusCode::kUnknownError, "listen failed");
+  // Listen socket for this rank: prefer a socket reserved at rendezvous
+  // time (already bound + listening, no steal window); otherwise bind to
+  // all interfaces at our assigned port.
+  int listen_fd = TakeReservedListenFd(port);
+  if (listen_fd < 0) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0)
+      return Status::Error(StatusCode::kUnknownError, "socket() failed");
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) < 0) {
+      ::close(listen_fd);
+      return Status::Error(
+          StatusCode::kUnknownError,
+          "bind " + peers[rank] + ": " + std::strerror(errno));
+    }
+    if (::listen(listen_fd, size) < 0) {
+      ::close(listen_fd);
+      return Status::Error(StatusCode::kUnknownError, "listen failed");
+    }
   }
 
   // Connector thread: dial every lower rank (with retries — peers may not

@@ -44,6 +44,13 @@ class Transport {
   virtual void Close() = 0;
 };
 
+// Rendezvous bootstrap support: bind + listen an ephemeral port NOW and
+// keep the socket open so the address a worker publishes to the KV store
+// cannot be stolen before TcpTransport::Create runs (a close-then-rebind
+// dance would be a TOCTOU race). Create() consumes the reserved fd when
+// peers[rank] names a reserved port. Returns -1 on failure.
+int ReserveListenPort();
+
 // --- LocalTransport --------------------------------------------------------
 
 class LocalHub;  // shared mailbox registry for one in-process "job"

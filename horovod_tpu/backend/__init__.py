@@ -112,6 +112,14 @@ def make_spmd_backend(topology):
     """
     if topology.size == 1:
         return LoopbackBackend()
+    from ..utils import envparse
+    if not envparse.get_str(envparse.PEERS, ""):
+        # Launcher-spawned worker: discover peers through the driver's KV
+        # rendezvous (reference: gloo_context.cc:150-228 bootstrapping from
+        # the driver's HTTP store) instead of a hand-built peer list.
+        from ..runner import rendezvous
+        if rendezvous.rendezvous_config() is not None:
+            rendezvous.bootstrap_peers(topology)
     try:
         from .tcp_backend import TcpBackend
     except ImportError as e:

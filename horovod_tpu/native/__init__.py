@@ -93,6 +93,8 @@ def load_library():
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_double, ctypes.c_char_p]
         lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_reserve_listen_port.restype = ctypes.c_int
+        lib.hvd_reserve_listen_port.argtypes = []
         lib.hvd_core_rank.argtypes = [ctypes.c_void_p]
         lib.hvd_core_size.argtypes = [ctypes.c_void_p]
         lib.hvd_core_add_process_set.argtypes = [
@@ -131,6 +133,16 @@ def load_library():
         lib.hvd_core_bytes_processed.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+def reserve_listen_port():
+    """Bind + listen an ephemeral TCP port inside the native core and keep
+    it open; the next NativeCore whose peers entry names this port adopts
+    the socket. Closes the publish-then-rebind rendezvous race."""
+    port = load_library().hvd_reserve_listen_port()
+    if port <= 0:
+        raise OSError("could not reserve a listen port")
+    return port
 
 
 class NativeError(RuntimeError):

@@ -1,0 +1,60 @@
+"""Job launcher: ``hvdrun`` CLI and the programmatic ``run()`` API.
+
+The analog of the reference's runner package (reference:
+horovod/runner/__init__.py:92 ``horovod.run``, launch.py:763
+``run_commandline``). Launch is rendezvous-based: the driver starts an
+HTTP KV store, publishes slot assignments, and workers discover each
+other's native-core listeners through it — no hand-built peer lists.
+"""
+
+import os
+import pickle
+import sys
+import tempfile
+
+from .job import Settings, launch_job
+
+
+def run(func, args=(), kwargs=None, num_proc=1, hosts=None, hostfile=None,
+        env=None, start_timeout=120, verbose=False):
+    """Run ``func(*args, **kwargs)`` on ``num_proc`` workers; returns the
+    list of per-rank return values ordered by rank.
+
+    The function is pickled to a spill directory that must be visible on
+    every host (always true on localhost; use a shared filesystem for
+    multi-host jobs) — the reference ships pickled functions over its
+    task services instead (horovod/runner/__init__.py:92).
+    """
+    if kwargs is None:
+        kwargs = {}
+    with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            pickle.dump((func, args, kwargs), f)
+        settings = Settings(num_proc=num_proc, hosts=hosts,
+                            hostfile=hostfile, start_timeout=start_timeout,
+                            verbose=verbose, env=env)
+        command = [sys.executable, "-m", "horovod_tpu.runner.task_exec",
+                   payload, tmp]
+        rc = launch_job(settings, command)
+        if rc != 0:
+            raise RuntimeError(f"hvdrun job failed with exit code {rc}")
+        results = []
+        for rank in range(num_proc):
+            path = os.path.join(tmp, f"result_{rank}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"rank {rank} produced no result file (crashed after "
+                    "collectives completed?)")
+            with open(path, "rb") as f:
+                results.append(pickle.load(f))
+        return results
+
+
+def run_command(command, num_proc=1, hosts=None, hostfile=None, env=None,
+                start_timeout=120, verbose=False):
+    """Launch an argv list across workers; returns the job exit code."""
+    settings = Settings(num_proc=num_proc, hosts=hosts, hostfile=hostfile,
+                        start_timeout=start_timeout, verbose=verbose,
+                        env=env)
+    return launch_job(settings, command)

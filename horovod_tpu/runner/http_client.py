@@ -1,0 +1,62 @@
+"""Tiny HTTP KV client used by workers to talk to the launcher's
+rendezvous store (reference: horovod/runner/http/http_client.py)."""
+
+import time
+import urllib.error
+import urllib.request
+
+from .http_server import AUTH_HEADER
+
+
+def _url(addr, port, scope, key):
+    return f"http://{addr}:{port}/{scope}/{key}"
+
+
+def _request(method, url, data=None, token="", timeout=10):
+    req = urllib.request.Request(url, data=data, method=method)
+    if token:
+        req.add_header(AUTH_HEADER, token)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def put_kv(addr, port, scope, key, value, token="", timeout=10):
+    if isinstance(value, str):
+        value = value.encode()
+    with _request("PUT", _url(addr, port, scope, key), data=value,
+                  token=token, timeout=timeout) as resp:
+        if resp.status != 200:
+            raise RuntimeError(
+                f"KV PUT {scope}/{key} failed: HTTP {resp.status}")
+
+
+def get_kv(addr, port, scope, key, token="", timeout=10):
+    """Returns bytes, or None when the key does not exist yet."""
+    try:
+        with _request("GET", _url(addr, port, scope, key), token=token,
+                      timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def delete_kv(addr, port, scope, key, token="", timeout=10):
+    with _request("DELETE", _url(addr, port, scope, key), token=token,
+                  timeout=timeout):
+        pass
+
+
+def wait_for_kv(addr, port, scope, key, token="", deadline_s=120,
+                poll_s=0.05):
+    """Poll GET until the key appears; raises TimeoutError."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        value = get_kv(addr, port, scope, key, token=token)
+        if value is not None:
+            return value
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rendezvous key {scope}/{key} not published within "
+                f"{deadline_s}s")
+        time.sleep(poll_s)

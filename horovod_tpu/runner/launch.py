@@ -1,0 +1,100 @@
+"""``hvdrun`` command line (reference: horovod/runner/launch.py:763
+``run_commandline``).
+
+Usage mirrors horovodrun:
+
+    hvdrun -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh python train.py   (elastic)
+
+Runtime knobs are argparse flags that become HVDTPU_* env for the workers
+(the reference's config_parser pattern,
+horovod/runner/common/util/config_parser.py).
+"""
+
+import argparse
+import sys
+
+from .job import Settings, launch_job
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch an SPMD horovod_tpu job.",
+        usage="hvdrun -np N [options] <command> [args...]")
+    parser.add_argument("-np", "--num-proc", type=int, default=1,
+                        dest="num_proc", help="number of worker processes")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="comma-separated host:slots list")
+    parser.add_argument("--hostfile", default=None,
+                        help="file with one 'host slots=N' per line")
+    parser.add_argument("--start-timeout", type=int, default=120,
+                        help="seconds workers may take to rendezvous")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--disable-prefix-output", action="store_true",
+                        help="do not prefix worker output with [rank]")
+    # Runtime knobs -> env.
+    parser.add_argument("--fusion-threshold-mb", type=float, default=None)
+    parser.add_argument("--cycle-time-ms", type=float, default=None)
+    parser.add_argument("--cache-capacity", type=int, default=None)
+    parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--autotune", action="store_true")
+    parser.add_argument("--autotune-log-file", default=None)
+    parser.add_argument("--log-level", default=None)
+    parser.add_argument("--stall-check-disable", action="store_true")
+    parser.add_argument("--stall-check-time-seconds", type=float,
+                        default=None)
+    parser.add_argument("--stall-shutdown-time-seconds", type=float,
+                        default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training command to run on every slot")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _knob_env(args):
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HVDTPU_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HVDTPU_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HVDTPU_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HVDTPU_TIMELINE"] = args.timeline_filename
+    if args.autotune:
+        env["HVDTPU_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HVDTPU_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.log_level:
+        env["HVDTPU_LOG_LEVEL"] = args.log_level
+    if args.stall_check_disable:
+        env["HVDTPU_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_time_seconds is not None:
+        env["HVDTPU_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_time_seconds)
+    if args.stall_shutdown_time_seconds is not None:
+        env["HVDTPU_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_shutdown_time_seconds)
+    return env
+
+
+def run_commandline(argv=None):
+    args = parse_args(argv)
+    settings = Settings(
+        num_proc=args.num_proc, hosts=args.hosts, hostfile=args.hostfile,
+        start_timeout=args.start_timeout, verbose=args.verbose,
+        prefix_output=not args.disable_prefix_output, env=_knob_env(args))
+    rc = launch_job(settings, args.command)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    run_commandline()

@@ -1,0 +1,74 @@
+"""Worker-side rendezvous: discover peers through the launcher's KV store.
+
+The reference's gloo ranks bootstrap by connecting back to the driver's
+HTTP store and exchanging addresses (reference:
+horovod/common/gloo/gloo_context.cc:150-228 + http_store.cc). Here each
+worker picks a free TCP port for its native-core listener, publishes
+``rank -> ip:port``, then polls until every peer in its process set has
+published, yielding the ``HVDTPU_PEERS`` list the TCP data plane consumes.
+"""
+
+import os
+import socket
+
+from . import http_client
+from ..utils import envparse
+
+PEER_SCOPE = "peers"
+
+
+def _local_ip_towards(addr, port):
+    """The local IP the rendezvous server sees us from — a UDP connect
+    performs routing without sending packets (NIC selection, the analog of
+    HOROVOD_GLOO_IFACE, reference: gloo_context.cc:163)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((addr, port))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def _reserve_port():
+    """Reserve the native core's listen port with the socket kept open
+    (no close-then-rebind TOCTOU window): the native transport adopts the
+    bound fd when it starts (csrc/transport.cc ReserveListenPort)."""
+    from .. import native
+    return native.reserve_listen_port()
+
+
+def rendezvous_config():
+    """(addr, port, token) of the launcher's KV store, or None."""
+    addr = envparse.get_str(envparse.RENDEZVOUS_ADDR, "")
+    port = envparse.get_int(envparse.RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        return None
+    token = os.environ.get("HVDTPU_JOB_TOKEN", "")
+    return addr, port, token
+
+
+def bootstrap_peers(topology, deadline_s=None):
+    """Publish our listener address, gather everyone's, return the peers
+    csv ordered by rank (and export it as HVDTPU_PEERS)."""
+    cfg = rendezvous_config()
+    if cfg is None:
+        raise RuntimeError(
+            "no rendezvous configured: set HVDTPU_RENDEZVOUS_ADDR/PORT "
+            "(the hvdrun launcher does this) or provide HVDTPU_PEERS")
+    addr, port, token = cfg
+    if deadline_s is None:
+        deadline_s = float(os.environ.get("HVDTPU_START_TIMEOUT", "120"))
+
+    my_ip = _local_ip_towards(addr, port)
+    my_port = _reserve_port()
+    http_client.put_kv(addr, port, PEER_SCOPE, str(topology.rank),
+                       f"{my_ip}:{my_port}", token=token)
+
+    peers = []
+    for r in range(topology.size):
+        value = http_client.wait_for_kv(addr, port, PEER_SCOPE, str(r),
+                                        token=token, deadline_s=deadline_s)
+        peers.append(value.decode())
+    peers_csv = ",".join(peers)
+    os.environ["HVDTPU_PEERS"] = peers_csv
+    return peers_csv

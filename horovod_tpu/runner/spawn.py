@@ -1,0 +1,101 @@
+"""Process spawn + output streaming for the launcher.
+
+The reference execs per-slot commands over ssh threads with a
+process-group-safe shell wrapper (reference:
+horovod/runner/common/util/safe_shell_exec.py:270, gloo_run.py exec).
+Localhost slots run as direct child process groups; remote hosts go
+through ``ssh`` with the slot env inlined. Output is streamed line by
+line with a ``[rank]<stream>`` prefix exactly like horovodrun.
+"""
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local(hostname):
+    import socket
+    if hostname in _LOCAL_NAMES:
+        return True
+    try:
+        return hostname in (socket.gethostname(), socket.getfqdn())
+    except OSError:
+        return False
+
+
+def _stream(pipe, sink, prefix):
+    """Forward lines from pipe to sink with the rank prefix."""
+    try:
+        for raw in iter(pipe.readline, b""):
+            line = raw.decode(errors="replace")
+            sink.write(f"{prefix}{line}")
+            sink.flush()
+    finally:
+        pipe.close()
+
+
+class SlotProcess:
+    """One spawned worker with its output pumps."""
+
+    def __init__(self, slot, command, env, prefix_output=True):
+        self.slot = slot
+        if is_local(slot.hostname):
+            full_env = dict(os.environ)
+            full_env.update(env)
+            self.proc = subprocess.Popen(
+                command, env=full_env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True)
+        else:
+            # Remote exec: inline the env into the remote shell line. The
+            # worker's login shell provides PATH/python.
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+                " ".join(shlex.quote(c) for c in command)
+            self.proc = subprocess.Popen(
+                ["ssh", "-o", "BatchMode=yes", slot.hostname, remote],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True)
+        rank = slot.rank
+        out_prefix = f"[{rank}]<stdout> " if prefix_output else ""
+        err_prefix = f"[{rank}]<stderr> " if prefix_output else ""
+        self._pumps = [
+            threading.Thread(target=_stream,
+                             args=(self.proc.stdout, sys.stdout, out_prefix),
+                             daemon=True),
+            threading.Thread(target=_stream,
+                             args=(self.proc.stderr, sys.stderr, err_prefix),
+                             daemon=True),
+        ]
+        for t in self._pumps:
+            t.start()
+
+    def poll(self):
+        return self.proc.poll()
+
+    def wait(self, timeout=None):
+        rc = self.proc.wait(timeout)
+        for t in self._pumps:
+            t.join(timeout=5)
+        return rc
+
+    def terminate(self):
+        """Kill the whole process group (children included)."""
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def kill(self):
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass

@@ -1,0 +1,23 @@
+"""Worker entry for the programmatic ``horovod_tpu.run()`` API: load the
+pickled (func, args, kwargs) payload, run it, write this rank's result
+(reference: horovod/runner/task_fn executing the pickled wrapped func)."""
+
+import os
+import pickle
+import sys
+
+
+def main():
+    payload_path, out_dir = sys.argv[1], sys.argv[2]
+    rank = int(os.environ.get("HVDTPU_RANK", "0"))
+    with open(payload_path, "rb") as f:
+        func, args, kwargs = pickle.load(f)
+    result = func(*args, **kwargs)
+    tmp = os.path.join(out_dir, f".result_{rank}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, os.path.join(out_dir, f"result_{rank}.pkl"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,183 @@
+"""Launcher tests: host parsing, slot assignment, KV rendezvous, and
+end-to-end hvdrun launches (the analog of the reference's
+test/single/test_run.py unit tests + running parallel suites under the
+launcher, .buildkite/gen-pipeline.sh:231)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner import http_client
+from horovod_tpu.runner.http_server import KVStoreServer, RendezvousServer
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "spmd_worker.py")
+
+
+# -- hosts / assignments ---------------------------------------------------
+
+def test_parse_hosts():
+    hs = hosts_mod.parse_hosts("a:2,b:4,c")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 2), ("b", 4),
+                                                   ("c", 1)]
+    with pytest.raises(ValueError):
+        hosts_mod.parse_hosts("a:2,a:3")
+    with pytest.raises(ValueError):
+        hosts_mod.parse_hosts("")
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("# comment\nhost1 slots=2\nhost2:3\nhost3\n\n")
+    hs = hosts_mod.parse_hostfile(str(p))
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("host1", 2), ("host2", 3), ("host3", 1)]
+
+
+def test_host_assignments_single_host():
+    slots = hosts_mod.get_host_assignments(
+        hosts_mod.parse_hosts("localhost:4"), 3)
+    assert [s.rank for s in slots] == [0, 1, 2]
+    assert all(s.size == 3 for s in slots)
+    assert [s.local_rank for s in slots] == [0, 1, 2]
+    assert all(s.local_size == 3 for s in slots)
+    assert all(s.cross_rank == 0 and s.cross_size == 1 for s in slots)
+
+
+def test_host_assignments_multi_host():
+    slots = hosts_mod.get_host_assignments(
+        hosts_mod.parse_hosts("a:2,b:2,c:1"), 5)
+    assert [(s.hostname, s.rank, s.local_rank) for s in slots] == [
+        ("a", 0, 0), ("a", 1, 1), ("b", 2, 0), ("b", 3, 1), ("c", 4, 0)]
+    # local_rank 0 exists on a,b,c; local_rank 1 only on a,b.
+    assert [(s.cross_rank, s.cross_size) for s in slots] == [
+        (0, 3), (0, 2), (1, 3), (1, 2), (2, 3)]
+
+
+def test_host_assignments_overflow():
+    with pytest.raises(ValueError):
+        hosts_mod.get_host_assignments(hosts_mod.parse_hosts("a:1"), 2)
+
+
+# -- KV store --------------------------------------------------------------
+
+def test_kvstore_roundtrip():
+    server = KVStoreServer()
+    port = server.start()
+    try:
+        assert http_client.get_kv("127.0.0.1", port, "s", "k") is None
+        http_client.put_kv("127.0.0.1", port, "s", "k", "hello")
+        assert http_client.get_kv("127.0.0.1", port, "s", "k") == b"hello"
+        http_client.delete_kv("127.0.0.1", port, "s", "k")
+        assert http_client.get_kv("127.0.0.1", port, "s", "k") is None
+        http_client.put_kv("127.0.0.1", port, "s", "a", "1")
+        http_client.put_kv("127.0.0.1", port, "s", "b", "2")
+        http_client.delete_kv("127.0.0.1", port, "s", "_all")
+        assert http_client.get_kv("127.0.0.1", port, "s", "a") is None
+    finally:
+        server.stop()
+
+
+def test_kvstore_auth():
+    server = KVStoreServer(job_token="sekrit")
+    port = server.start()
+    try:
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_client.put_kv("127.0.0.1", port, "s", "k", "v",
+                               token="wrong")
+        assert ei.value.code == 403
+        http_client.put_kv("127.0.0.1", port, "s", "k", "v", token="sekrit")
+        assert http_client.get_kv("127.0.0.1", port, "s", "k",
+                                  token="sekrit") == b"v"
+    finally:
+        server.stop()
+
+
+def test_rendezvous_publishes_slots():
+    slots = hosts_mod.get_host_assignments(
+        hosts_mod.parse_hosts("localhost:2"), 2)
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        server.publish_assignments(slots)
+        line = http_client.get_kv("127.0.0.1", port, "slots", "1")
+        assert line == b"localhost,1,2,1,2,0,1"
+        assert http_client.get_kv("127.0.0.1", port, "slots",
+                                  "size") == b"2"
+    finally:
+        server.stop()
+
+
+# -- end-to-end launches ---------------------------------------------------
+
+def _worker_env():
+    # Workers must not inherit the test session's 8-device virtual flags.
+    # PYTHONPATH carries the repo and tests dir so pickled test functions
+    # resolve in the worker interpreter.
+    pythonpath = os.pathsep.join(
+        [REPO, HERE, os.environ.get("PYTHONPATH", "")])
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+            "PYTHONPATH": pythonpath}
+
+
+def test_run_command_spmd_worker():
+    """The full SPMD suite launched through the runner: peers come from
+    rendezvous, not HVDTPU_PEERS."""
+    from horovod_tpu.runner import run_command
+    rc = run_command([sys.executable, WORKER], num_proc=2,
+                     env=_worker_env())
+    assert rc == 0
+
+
+def test_hvdrun_console_entry():
+    """`python -m horovod_tpu.runner.launch -np 2 python -c ...` — the
+    declared console script must import and run a trivial job."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    script = ("import horovod_tpu as hvd, jax.numpy as jnp, numpy as np; "
+              "hvd.init(); "
+              "out = hvd.allreduce(jnp.ones(4) * (hvd.rank() + 1), "
+              "op=hvd.Sum, name='t'); "
+              "np.testing.assert_allclose(np.asarray(out), 3.0); "
+              "print('LAUNCHED-OK', hvd.rank())")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, "-c", script],
+        env=env, capture_output=True, timeout=180)
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, out + proc.stderr.decode()
+    assert "LAUNCHED-OK 0" in out
+    assert "LAUNCHED-OK 1" in out
+
+
+def test_run_programmatic():
+    """horovod_tpu.runner.run(): pickled function, per-rank results."""
+    from horovod_tpu.runner import run
+    results = run(_prog_fn, num_proc=2, env=_worker_env())
+    assert results == [[0, 2, 10.0], [1, 2, 10.0]]
+
+
+def _prog_fn():
+    import horovod_tpu as hvd
+    import jax.numpy as jnp
+    hvd.init()
+    out = hvd.allreduce(jnp.full((4,), float(hvd.rank() + 1)), op=hvd.Sum,
+                        name="p")
+    return [hvd.rank(), hvd.size(), float(out[0]) + 7.0]
+
+
+def test_failed_rank_fails_job():
+    from horovod_tpu.runner import run_command
+    rc = run_command(
+        [sys.executable, "-c",
+         "import os, sys; sys.exit(3 if os.environ['HVDTPU_RANK'] == '1' "
+         "else 0)"],
+        num_proc=2, env=_worker_env())
+    assert rc == 3
