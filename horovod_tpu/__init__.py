@@ -44,6 +44,7 @@ from .functions import (  # noqa: F401
     broadcast_object, broadcast_parameters, broadcast_optimizer_state,
     broadcast_variables, allgather_object,
 )
+from . import elastic  # noqa: F401  (hvd.elastic.run / State / ObjectState)
 
 
 def start_timeline(file_path, mark_cycles=False):

@@ -71,6 +71,7 @@ class TcpBackend(Backend):
             else "")
         self.topology = topology
         self._pending = []
+        self._transport_dead = False
         self._ps_map = {0: 0}  # python process-set id -> native id
         self._log = get_logger()
         # Set by the coordinator so in-flight tensor names release when the
@@ -216,6 +217,7 @@ class TcpBackend(Backend):
         number of TensorEntries completed."""
         rc = self.core.run_cycle()
         if rc == -2:
+            self._transport_dead = True
             self._fail_all(HorovodInternalError(
                 "native core transport failure (peer died?)"))
             return 0
@@ -322,6 +324,13 @@ class TcpBackend(Backend):
 
     def close(self):
         try:
+            if self._transport_dead:
+                # A dead peer can never agree to the consensus shutdown;
+                # draining would spin (elastic resets hit this path after
+                # a rank is killed). Fail fast instead.
+                self._fail_all(HorovodInternalError(
+                    "runtime shut down after transport failure"))
+                return
             self.core.request_shutdown()
             # Bounded drain through the FULL cycle (completion sweep
             # included) so waiters on in-flight entries resolve; peers must
@@ -330,6 +339,8 @@ class TcpBackend(Backend):
                 if self.core.shutdown_complete():
                     break
                 self.run_cycle()
+                if self._transport_dead:
+                    break
             self._fail_all(HorovodInternalError(
                 "runtime shut down with operations in flight"))
         finally:

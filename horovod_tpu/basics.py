@@ -126,6 +126,14 @@ def init(comm=None, process_sets=None):
             return _runtime
 
         log = get_logger()
+        if envparse.get_bool(envparse.ELASTIC):
+            # Elastic workers are spawned WITHOUT rank env: ranks come from
+            # the driver's latest membership version via the rendezvous
+            # store, so a re-init after a reset lands in the new cohort
+            # (reference: horovod/runner/elastic/rendezvous.py:28-60).
+            from .runner import rendezvous as rdv
+            if rdv.rendezvous_config() is not None:
+                rdv.elastic_bootstrap()
         topology = Topology.from_env()
         spmd = (envparse.get_env(envparse.SIZE) is not None
                 and envparse.get_env(envparse.RANK) is not None)

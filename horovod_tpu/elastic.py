@@ -1,0 +1,185 @@
+"""Elastic fault tolerance: worker-side state machine + retry loop.
+
+The reference's signature capability (reference:
+horovod/common/elastic.py:26-176): training state is committed in memory,
+collective failures (``HorovodInternalError``) restore it, membership
+changes (``HostsUpdatedInterrupt``) re-rendezvous, and in both cases the
+runtime resets (``shutdown(); init()``) with new ranks served by the
+driver's rendezvous, then ``state.sync()`` re-broadcasts from a surviving
+rank. On TPU this is the preemptible-slice story: a preempted host drops
+out, the remaining hosts shrink the job, and training resumes from the
+last commit without restarting the process tree.
+
+Membership-change notification is poll-based: the driver bumps an
+``elastic/version`` counter in its KV store; ``state.check_host_updates``
+compares it against the version this worker joined at (the reference pushes
+notifications into an in-worker TCP service instead,
+horovod/runner/elastic/worker.py:46 — a KV poll at commit granularity is
+simpler and costs one HTTP GET per commit).
+"""
+
+import functools
+import os
+import time
+
+from . import basics
+from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .utils.logging_util import get_logger
+
+
+class State:
+    """Base elastic state: commit/restore/sync + host-update checks
+    (reference: horovod/common/elastic.py:26 ``State``)."""
+
+    def __init__(self):
+        self._reset_callbacks = []
+        self._last_check = 0.0
+        self._check_interval = float(
+            os.environ.get("HVDTPU_ELASTIC_CHECK_INTERVAL", "0.2"))
+
+    def register_reset_callbacks(self, callbacks):
+        """Callbacks run after a reset (new world size), e.g. to rescale
+        the learning rate (reference: elastic.py:44)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def reset(self):
+        """Hook for subclasses (re-build data loaders, etc.)."""
+
+    def commit(self):
+        """Snapshot state in memory and check for membership changes
+        (reference: elastic.py:70 — commit marks a restore point; raising
+        here, between steps, is what keeps restore consistent)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt when the driver published a newer
+        membership version than the one this worker joined at."""
+        now = time.monotonic()
+        if now - self._last_check < self._check_interval:
+            return
+        self._last_check = now
+        from .runner import rendezvous as rdv
+        cfg = rdv.rendezvous_config()
+        if cfg is None:
+            return
+        current = rdv.current_elastic_version(*cfg)
+        if current > _joined_version():
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """State holding arbitrary picklable attributes — params/opt-state
+    pytrees, epoch counters, RNG keys (reference:
+    horovod/common/elastic.py:116 ``ObjectState``). JAX arrays are
+    immutable, so save/restore are shallow snapshots; sync broadcasts the
+    whole attribute dict from the new rank 0 (always a survivor: the
+    driver assigns surviving workers the lowest ranks)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved_state = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def _public_state(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def save(self):
+        self._saved_state = self._public_state()
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            setattr(self, k, v)
+
+    def sync(self):
+        from .functions import broadcast_object
+        synced = broadcast_object(self._saved_state, root_rank=0,
+                                  name="elastic.state")
+        self._saved_state = synced
+        self.restore()
+
+
+# TPU-flavored alias: the natural JAX elastic state is "a dict of pytrees".
+TpuState = ObjectState
+
+
+def _joined_version():
+    return int(os.environ.get("HVDTPU_ELASTIC_VERSION", "-1"))
+
+
+def _reset():
+    """shutdown(); init() — re-rendezvous with new ranks from the driver
+    (reference: horovod/torch/elastic/__init__.py:46-48)."""
+    basics.shutdown()
+    basics.init()
+
+
+def run_fn(func, reset=_reset):
+    """Wrap a training function for elastic execution (reference:
+    horovod/common/elastic.py:151 ``run_fn``). The wrapped function takes
+    the State first; on HorovodInternalError the last commit is restored,
+    on HostsUpdatedInterrupt state is kept; both paths reset the runtime
+    and re-sync before retrying."""
+    log = get_logger()
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        skip_sync = False
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                log.info("elastic: collective failure (%s); restoring "
+                         "last commit", e)
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                log.info("elastic: hosts updated; re-rendezvousing")
+                skip_sync = e.skip_sync
+            _retry_reset(reset, log)
+            state.on_reset()
+
+    return wrapper
+
+
+def _retry_reset(reset, log, attempts=3):
+    """Re-init can itself hit a dying cohort (a peer drops while the new
+    mesh forms); retry a few times before giving up — each attempt
+    re-fetches the newest membership version."""
+    for attempt in range(attempts):
+        try:
+            reset()
+            return
+        except (HorovodInternalError, TimeoutError, OSError) as e:
+            log.warning("elastic: reset attempt %d failed (%s)",
+                        attempt + 1, e)
+            try:
+                basics.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            if attempt == attempts - 1:
+                raise
+
+
+def run(func):
+    """Decorator form (reference: horovod/torch/elastic/__init__.py
+    ``hvd.elastic.run``)."""
+    return run_fn(func)

@@ -1,0 +1,401 @@
+"""Driver-side elasticity: discovery, stable rank assignment, blacklist,
+re-rendezvous, worker respawn.
+
+The analog of the reference's ElasticDriver + WorkerStateRegistry +
+HostDiscoveryScript (reference: horovod/runner/elastic/driver.py:68-314,
+registration.py:28-150, discovery.py:80-185): a discovery script is polled
+every second for the current ``host:slots`` membership; on change (or on a
+worker failure) the driver bumps the membership **version**, publishes new
+rank assignments to its KV store, spawns workers for new slots, and lets
+surviving workers re-rendezvous under the new version. Failed hosts are
+blacklisted after repeated worker failures. On TPU the discovery script is
+where slice preemption signals surface (a preempted TPU-VM host simply
+drops out of the script's output).
+"""
+
+import subprocess
+import time
+from types import SimpleNamespace
+
+from . import spawn
+from .hosts import HostInfo
+from .http_server import RendezvousServer, new_job_token
+from .job import _rendezvous_ip
+from .rendezvous import ASSIGN_SCOPE, ELASTIC_SCOPE, PEER_SCOPE, VERSION_KEY
+from ..utils.logging_util import get_logger
+
+RUNNING, SUCCEEDED, FAILED = "running", "succeeded", "failed"
+
+
+class ElasticSettings:
+    def __init__(self, settings, discovery_script=None, min_np=1,
+                 max_np=None, reset_limit=None, host_fail_limit=3,
+                 discovery_interval=1.0):
+        self.base = settings
+        self.discovery_script = discovery_script
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.host_fail_limit = host_fail_limit
+        self.discovery_interval = discovery_interval
+
+
+class HostDiscovery:
+    """Poll the user's discovery script for the current host set
+    (reference: discovery.py:152-175 ``HostDiscoveryScript``). Fixed-host
+    fallback uses the static -H/--hostfile list."""
+
+    def __init__(self, elastic_settings):
+        self._settings = elastic_settings
+
+    def find_available_hosts(self):
+        script = self._settings.discovery_script
+        if not script:
+            return self._settings.base.resolve_hosts()
+        try:
+            proc = subprocess.run(script, shell=True, capture_output=True,
+                                  timeout=30)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError("host discovery script timed out (30s)")
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"host discovery script failed (exit {proc.returncode}): "
+                f"{proc.stderr.decode(errors='replace')[:500]}")
+        hosts = []
+        for line in proc.stdout.decode().splitlines():
+            line = line.strip()
+            if line:
+                hosts.append(HostInfo.from_string(line))
+        return hosts
+
+
+class _Worker:
+    __slots__ = ("worker_id", "host", "slot_index", "proc", "state")
+
+    def __init__(self, worker_id, host, slot_index, proc):
+        self.worker_id = worker_id
+        self.host = host
+        self.slot_index = slot_index
+        self.proc = proc
+        self.state = RUNNING
+
+
+class ElasticDriver:
+    """Owns the rendezvous server and the worker fleet for one job."""
+
+    def __init__(self, elastic, command):
+        self.elastic = elastic
+        self.command = command
+        self.discovery = HostDiscovery(elastic)
+        self.token = new_job_token()
+        self.server = RendezvousServer(job_token=self.token,
+                                       verbose=elastic.base.verbose)
+        self.port = self.server.start()
+        self.addr = None
+        self.version = -1
+        self.workers = {}        # worker_id -> _Worker (running only)
+        self.stopping = []       # (worker, sigkill_deadline) being reaped
+        self.rank_order = []     # worker_ids in rank order
+        self.blacklist = set()
+        self.fail_counts = {}
+        self.resets = 0
+        self.completing = False
+        self.succeeded = []
+        self.log = get_logger()
+        self._last_targets = []
+        self._discovery_failures = 0
+
+    DISCOVERY_FAIL_LIMIT = 30  # consecutive failures before aborting
+
+    # -- membership ------------------------------------------------------
+    def _discover_targets(self):
+        """(worker_id, host, slot_index) for every slot in the current
+        discovery output, minus blacklisted hosts, capped at max_np. A
+        transient discovery failure keeps the last known membership —
+        flaky cloud APIs are exactly what elastic mode exists for."""
+        try:
+            hosts = [h for h in self.discovery.find_available_hosts()
+                     if h.hostname not in self.blacklist]
+            self._discovery_failures = 0
+        except RuntimeError as e:
+            self._discovery_failures += 1
+            self.log.warning(
+                "elastic driver: discovery failed (%d consecutive): %s",
+                self._discovery_failures, e)
+            if self._discovery_failures >= self.DISCOVERY_FAIL_LIMIT:
+                raise
+            return self._last_targets
+        slots = []
+        cap = self.elastic.max_np or float("inf")
+        for h in hosts:
+            for idx in range(h.slots):
+                if len(slots) >= cap:
+                    break
+                slots.append((f"{h.hostname}:{idx}", h.hostname, idx))
+            if len(slots) >= cap:
+                break
+        self._last_targets = slots
+        return slots
+
+    def _publish(self):
+        """Compute stable rank order and publish assignment version N.
+        Surviving workers keep their relative order (and therefore the
+        lowest ranks — rank 0 is always a survivor, which is what makes
+        ``state.sync()`` broadcast-from-0 correct); new workers append
+        (reference: driver.py:232-276 stable host ordering)."""
+        alive = [wid for wid in self.rank_order if wid in self.workers]
+        alive += [wid for wid in self.workers if wid not in alive]
+        self.rank_order = alive
+        size = len(alive)
+
+        # Host-level grouping for local/cross ranks.
+        host_of = {wid: self.workers[wid].host for wid in alive}
+        local_rank = {}
+        local_counts = {}
+        for wid in alive:
+            h = host_of[wid]
+            local_rank[wid] = local_counts.get(h, 0)
+            local_counts[h] = local_rank[wid] + 1
+        host_order = list(dict.fromkeys(host_of[wid] for wid in alive))
+
+        scope = f"{ASSIGN_SCOPE}.{self.version}"
+        for rank, wid in enumerate(alive):
+            h = host_of[wid]
+            lr = local_rank[wid]
+            hosts_at_lr = [x for x in host_order if local_counts[x] > lr]
+            line = (f"{rank},{size},{lr},{local_counts[h]},"
+                    f"{hosts_at_lr.index(h)},{len(hosts_at_lr)}")
+            self.server.put(scope, wid, line)
+        self.server.put(ELASTIC_SCOPE, VERSION_KEY, str(self.version))
+        self.log.info("elastic driver: published version %d with %d "
+                      "workers", self.version, size)
+
+    def _spawn(self, worker_id, host, slot_index):
+        env = dict(self.elastic.base.env)
+        env.update({
+            "HVDTPU_ELASTIC": "1",
+            "HVDTPU_WORKER_ID": worker_id,
+            "HVDTPU_RENDEZVOUS_ADDR": self.addr,
+            "HVDTPU_RENDEZVOUS_PORT": str(self.port),
+            "HVDTPU_JOB_TOKEN": self.token,
+            "HVDTPU_START_TIMEOUT": str(self.elastic.base.start_timeout),
+        })
+        slot = SimpleNamespace(hostname=host, rank=worker_id)
+        proc = spawn.SlotProcess(
+            slot, self.command, env,
+            prefix_output=self.elastic.base.prefix_output)
+        self.workers[worker_id] = _Worker(worker_id, host, slot_index, proc)
+
+    def _reconcile(self, targets):
+        """Diff targets vs running workers; returns True when membership
+        changed (spawn/kill happened)."""
+        target_ids = {t[0] for t in targets}
+        changed = False
+        for wid in list(self.workers):
+            if wid not in target_ids:
+                w = self.workers.pop(wid)
+                if wid in self.rank_order:
+                    self.rank_order.remove(wid)
+                w.proc.terminate()
+                self.stopping.append((w, time.monotonic() + 10))
+                self.log.info("elastic driver: host removed, stopping %s",
+                              wid)
+                changed = True
+        for wid, host, idx in targets:
+            if wid not in self.workers:
+                self._spawn(wid, host, idx)
+                self.log.info("elastic driver: spawned worker %s", wid)
+                changed = True
+        return changed
+
+    def _reap_stopping(self):
+        """Reap scale-down terminations (no zombies) and escalate to
+        SIGKILL for workers that ignore SIGTERM."""
+        still = []
+        now = time.monotonic()
+        for w, kill_at in self.stopping:
+            if w.proc.poll() is not None:
+                w.proc.wait()
+                continue
+            if now > kill_at:
+                w.proc.kill()
+            still.append((w, kill_at))
+        self.stopping = still
+
+    def _rereq_pending(self):
+        """True when a live worker asked for a re-rendezvous at a version
+        beyond the current one (transport failure with no process death)."""
+        for key in self.server.scope_keys(ELASTIC_SCOPE):
+            if not key.startswith("rereq."):
+                continue
+            try:
+                want = int(self.server.get(ELASTIC_SCOPE, key))
+            except (TypeError, ValueError):
+                continue
+            if want > self.version:
+                return True
+        return False
+
+    def _clear_stale_rereqs(self):
+        for key in self.server.scope_keys(ELASTIC_SCOPE):
+            if not key.startswith("rereq."):
+                continue
+            try:
+                want = int(self.server.get(ELASTIC_SCOPE, key))
+            except (TypeError, ValueError):
+                want = -1
+            if want <= self.version:
+                self.server.delete(ELASTIC_SCOPE, key)
+
+    def _sweep_exits(self):
+        """Returns True when a failure changed membership."""
+        changed = False
+        for wid in list(self.workers):
+            w = self.workers[wid]
+            rc = w.proc.poll()
+            if rc is None:
+                continue
+            w.proc.wait()
+            del self.workers[wid]
+            # Drop the dead worker's rank slot NOW: if the same worker id
+            # is respawned it must re-enter at the END of the order — a
+            # fresh-state replacement taking rank 0 would make
+            # state.sync() broadcast empty state over the survivors.
+            if wid in self.rank_order:
+                self.rank_order.remove(wid)
+            if rc == 0:
+                w.state = SUCCEEDED
+                self.succeeded.append(wid)
+                self.completing = True
+                self.log.info("elastic driver: worker %s finished", wid)
+            else:
+                w.state = FAILED
+                self.fail_counts[w.host] = self.fail_counts.get(w.host,
+                                                                0) + 1
+                if self.fail_counts[w.host] >= self.elastic.host_fail_limit:
+                    self.blacklist.add(w.host)
+                    self.log.warning(
+                        "elastic driver: blacklisting host %s after %d "
+                        "failures", w.host, self.fail_counts[w.host])
+                self.log.warning(
+                    "elastic driver: worker %s failed (exit %d)", wid, rc)
+                changed = True
+        return changed
+
+    # -- main loop -------------------------------------------------------
+    def run(self):
+        deadline = time.monotonic() + self.elastic.base.start_timeout
+        while True:
+            targets = self._discover_targets()
+            if len(targets) >= self.elastic.min_np:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"discovery produced only {len(targets)} slots within "
+                    f"the start timeout; min_np={self.elastic.min_np}")
+            time.sleep(self.elastic.discovery_interval)
+
+        self.addr = self.elastic.base.rendezvous_addr or _rendezvous_ip(
+            [SimpleNamespace(hostname=t[1]) for t in targets])
+        self.version = 0
+        self._reconcile(targets)
+        self._publish()
+
+        last_discovery = time.monotonic()
+        finish_deadline = None
+        try:
+            while self.workers:
+                changed = self._sweep_exits()
+                self._reap_stopping()
+                now = time.monotonic()
+                targets = None
+                if (not self.completing
+                        and now - last_discovery
+                        >= self.elastic.discovery_interval):
+                    last_discovery = now
+                    targets = self._discover_targets()
+                    changed |= ({t[0] for t in targets}
+                                != set(self.workers))
+                if not self.completing and self._rereq_pending():
+                    changed = True
+                if changed and not self.completing:
+                    self.resets += 1
+                    if (self.elastic.reset_limit is not None
+                            and self.resets > self.elastic.reset_limit):
+                        raise RuntimeError(
+                            f"elastic reset count {self.resets} exceeded "
+                            f"--reset-limit {self.elastic.reset_limit}")
+                    # Retire the old version's keys BEFORE spawning
+                    # replacements: a respawned worker must never pick up
+                    # the dead cohort's assignment and try to dial stale
+                    # listeners. The version key itself is published last,
+                    # after the new assignment is complete.
+                    old = self.version
+                    self.version += 1
+                    self.server.clear_scope(f"{ASSIGN_SCOPE}.{old}")
+                    self.server.clear_scope(f"{PEER_SCOPE}.{old}")
+                    if targets is None:
+                        targets = self._discover_targets()
+                    self._reconcile(targets)
+                    if len(self.workers) < self.elastic.min_np:
+                        # Below quorum: keep polling discovery for
+                        # replacement hosts until the start timeout.
+                        wait_until = now + self.elastic.base.start_timeout
+                        while (len(self.workers) < self.elastic.min_np
+                               and time.monotonic() < wait_until):
+                            self._sweep_exits()
+                            self._reap_stopping()
+                            self._reconcile(self._discover_targets())
+                            time.sleep(self.elastic.discovery_interval)
+                        if len(self.workers) < self.elastic.min_np:
+                            raise RuntimeError(
+                                f"{len(self.workers)} workers alive < "
+                                f"min_np={self.elastic.min_np}; aborting")
+                    self._publish()
+                    self._clear_stale_rereqs()
+                if self.completing and finish_deadline is None:
+                    finish_deadline = now + 60
+                if finish_deadline is not None and now > finish_deadline:
+                    self.log.warning(
+                        "elastic driver: stragglers after success; killing")
+                    for w in self.workers.values():
+                        w.proc.terminate()
+                    finish_deadline = now + 1e9
+                time.sleep(0.05)
+        except Exception:
+            for w in self.workers.values():
+                w.proc.terminate()
+            raise
+        finally:
+            deadline = time.monotonic() + 5
+            leftovers = list(self.workers.values()) + [w for w, _ in
+                                                       self.stopping]
+            for w in leftovers:
+                if w.proc.poll() is None and time.monotonic() < deadline:
+                    try:
+                        w.proc.proc.wait(
+                            max(0.1, deadline - time.monotonic()))
+                    except Exception:  # noqa: BLE001
+                        pass
+                w.proc.kill()
+            self.server.stop()
+
+        return 0 if self.succeeded else 1
+
+
+def launch_elastic_job(elastic, command):
+    """Entry used by hvdrun for elastic flags; returns the exit code."""
+    driver = ElasticDriver(elastic, command)
+    try:
+        return driver.run()
+    except RuntimeError as e:
+        get_logger().error("elastic job failed: %s", e)
+        return 1
+
+
+def run_elastic(elastic, command):  # API-parity alias
+    return launch_elastic_job(elastic, command)
+
+
+__all__ = ["ElasticSettings", "ElasticDriver", "HostDiscovery",
+           "launch_elastic_job", "run_elastic"]

@@ -126,6 +126,10 @@ class KVStoreServer:
         with self._httpd.store_lock:
             self._httpd.store.setdefault(scope, {})[key] = value
 
+    def delete(self, scope, key):
+        with self._httpd.store_lock:
+            self._httpd.store.get(scope, {}).pop(key, None)
+
     def scope_keys(self, scope):
         with self._httpd.store_lock:
             return sorted(self._httpd.store.get(scope, {}).keys())

@@ -35,6 +35,17 @@ def parse_args(argv=None):
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--disable-prefix-output", action="store_true",
                         help="do not prefix worker output with [rank]")
+    # Elastic flags (reference: launch.py --min-np/--max-np/
+    # --host-discovery-script routed to _run_elastic).
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="minimum workers to keep an elastic job alive")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="maximum workers an elastic job may use")
+    parser.add_argument("--host-discovery-script", default=None,
+                        help="script printing current 'host:slots' lines; "
+                             "enables elastic mode")
+    parser.add_argument("--reset-limit", type=int, default=None,
+                        help="max elastic resets before the job aborts")
     # Runtime knobs -> env.
     parser.add_argument("--fusion-threshold-mb", type=float, default=None)
     parser.add_argument("--cycle-time-ms", type=float, default=None)
@@ -92,7 +103,19 @@ def run_commandline(argv=None):
         num_proc=args.num_proc, hosts=args.hosts, hostfile=args.hostfile,
         start_timeout=args.start_timeout, verbose=args.verbose,
         prefix_output=not args.disable_prefix_output, env=_knob_env(args))
-    rc = launch_job(settings, args.command)
+    if args.host_discovery_script or args.min_np or args.max_np:
+        from .elastic_driver import ElasticSettings, launch_elastic_job
+        elastic = ElasticSettings(
+            settings,
+            discovery_script=args.host_discovery_script,
+            min_np=args.min_np or 1,
+            # None = uncapped: -np is the *starting* size, not a growth
+            # limit (matching horovodrun, where --max-np is optional).
+            max_np=args.max_np,
+            reset_limit=args.reset_limit)
+        rc = launch_elastic_job(elastic, args.command)
+    else:
+        rc = launch_job(settings, args.command)
     sys.exit(rc)
 
 

@@ -1,0 +1,70 @@
+"""Elastic integration worker (launched by test_elastic.py).
+
+The analog of the reference's per-framework elastic train scripts
+(reference: test/integration/data/elastic_torch_main.py): train EPOCHS
+epochs, commit state each epoch, append ``worker_id epoch rank size`` lines
+to a shared log so the test can assert rank reassignment and recovery.
+Optionally hard-exits once at a configured (worker, epoch) to simulate a
+preempted host.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic  # noqa: E402
+
+LOG = os.environ["ELASTIC_TEST_LOG"]
+EPOCHS = int(os.environ.get("ELASTIC_TEST_EPOCHS", "6"))
+EPOCH_SLEEP = float(os.environ.get("ELASTIC_TEST_EPOCH_SLEEP", "0.3"))
+KILL_WORKER = os.environ.get("ELASTIC_TEST_KILL_WORKER", "")
+KILL_EPOCH = int(os.environ.get("ELASTIC_TEST_KILL_EPOCH", "-1"))
+
+WID = os.environ.get("HVDTPU_WORKER_ID", "static:?")
+KILL_MARKER = LOG + ".killed"
+
+
+def log_line(msg):
+    with open(LOG, "a") as f:
+        f.write(f"{WID} {msg}\n")
+
+
+@elastic.run
+def train(state):
+    while state.epoch < EPOCHS:
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum,
+                            name=f"step{state.epoch}")
+        np.testing.assert_allclose(np.asarray(out), float(hvd.size()))
+        state.total = state.total + float(np.asarray(out)[0])
+
+        if (WID == KILL_WORKER and state.epoch == KILL_EPOCH
+                and not os.path.exists(KILL_MARKER)):
+            open(KILL_MARKER, "w").close()
+            log_line(f"KILLED epoch={state.epoch}")
+            os._exit(17)
+
+        log_line(f"epoch={state.epoch} rank={hvd.rank()} "
+                 f"size={hvd.size()}")
+        state.epoch += 1
+        state.commit()
+        time.sleep(EPOCH_SLEEP)
+    return state.epoch
+
+
+def main():
+    hvd.init()
+    state = elastic.ObjectState(epoch=0, total=0.0)
+    final_epoch = train(state)
+    log_line(f"DONE epoch={final_epoch} rank={hvd.rank()} "
+             f"size={hvd.size()}")
+
+
+if __name__ == "__main__":
+    main()

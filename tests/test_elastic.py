@@ -1,0 +1,296 @@
+"""Elastic tests: driver-side unit tests with fake workers (the
+reference's pattern — test/single/test_elastic_driver.py drives
+ElasticDriver with mocks) plus whole-job integration runs with a scripted
+discovery file and killed ranks (reference:
+test/integration/elastic_common.py:34-108)."""
+
+import os
+import re
+import stat
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                               ElasticSettings, _Worker)
+from horovod_tpu.runner.job import Settings
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "elastic_worker.py")
+
+
+class _FakeProc:
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return None
+
+    def wait(self, *a):
+        return 0
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        pass
+
+
+def _fake_spawn(driver):
+    def spawn(worker_id, host, idx):
+        driver.workers[worker_id] = _Worker(worker_id, host, idx,
+                                            _FakeProc())
+    return spawn
+
+
+# -- driver unit tests -----------------------------------------------------
+
+def test_driver_stable_rank_assignment(monkeypatch):
+    es = ElasticSettings(Settings(num_proc=3), min_np=1)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+        driver.version = 0
+        driver._reconcile(driver._discover_targets())
+        driver._publish()
+        assert driver.rank_order == ["localhost:0", "localhost:1",
+                                     "localhost:2"]
+        line = driver.server.get("assign.0", "localhost:1").decode()
+        assert line == "1,3,1,3,0,1"
+
+        # Worker 0 dies: survivors must keep relative order and take the
+        # lowest ranks; the respawned worker appends at the end.
+        driver.workers["localhost:0"].proc.poll = lambda: 17
+        assert driver._sweep_exits()
+        driver._reconcile(driver._discover_targets())  # respawns localhost:0
+        driver.version = 1
+        driver._publish()
+        assert driver.rank_order == ["localhost:1", "localhost:2",
+                                     "localhost:0"]
+        line = driver.server.get("assign.1", "localhost:1").decode()
+        assert line.startswith("0,3,")
+        assert driver.server.get("elastic", "version") == b"1"
+    finally:
+        driver.server.stop()
+
+
+def test_driver_blacklist(monkeypatch):
+    es = ElasticSettings(Settings(num_proc=2), min_np=1, host_fail_limit=2)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+        driver._reconcile(driver._discover_targets())
+        assert len(driver.workers) == 2
+        driver.fail_counts["localhost"] = 1
+        # Second failure crosses host_fail_limit.
+        w = driver.workers["localhost:0"]
+        w.proc.poll = lambda: 17
+        assert driver._sweep_exits()
+        assert "localhost" in driver.blacklist
+        # Blacklisted host contributes no target slots.
+        assert driver._discover_targets() == []
+    finally:
+        driver.server.stop()
+
+
+def test_driver_max_np_cap():
+    es = ElasticSettings(Settings(num_proc=2, hosts="a:4,b:4"), min_np=1,
+                         max_np=3)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        slots = driver._discover_targets()
+        assert [s[0] for s in slots] == ["a:0", "a:1", "a:2"]
+    finally:
+        driver.server.stop()
+
+
+# -- worker-side state unit tests -----------------------------------------
+
+def test_object_state_commit_restore():
+    from horovod_tpu.elastic import ObjectState
+    st = ObjectState(epoch=0, w=1.5)
+    st.epoch = 3
+    st.w = 9.0
+    st.save()
+    st.epoch = 4
+    st.w = -1.0
+    st.restore()
+    assert st.epoch == 3 and st.w == 9.0
+
+
+def test_run_fn_retry_loop():
+    from horovod_tpu.elastic import State
+    from horovod_tpu.exceptions import (HorovodInternalError,
+                                        HostsUpdatedInterrupt)
+    events = []
+
+    class FakeState(State):
+        def save(self):
+            events.append("save")
+
+        def restore(self):
+            events.append("restore")
+
+        def sync(self):
+            events.append("sync")
+
+        def check_host_updates(self):
+            pass
+
+    attempts = []
+
+    def func(state):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise HorovodInternalError("boom")
+        if len(attempts) == 2:
+            raise HostsUpdatedInterrupt(skip_sync=False)
+        return "ok"
+
+    from horovod_tpu.elastic import run_fn
+    wrapped = run_fn(func, reset=lambda: events.append("reset"))
+    assert wrapped(FakeState()) == "ok"
+    assert events == ["sync", "restore", "reset", "sync", "reset", "sync"]
+
+
+# -- integration: scripted discovery + killed ranks ------------------------
+
+def _flip_when(log_path, phase_file, new_phase, predicate, timeout=90):
+    """Background thread: flip the discovery phase once the parsed log
+    satisfies ``predicate`` — i.e. after training demonstrably ran at the
+    initial membership (worker init time varies too much for sleeps)."""
+    import threading
+
+    def flip():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(log_path) and predicate(_parse_log(log_path)):
+                break
+            time.sleep(0.1)
+        phase_file.write_text(new_phase)
+
+    t = threading.Thread(target=flip)
+    t.start()
+    return t
+
+def _write_discovery(tmp_path, phase_file, phases):
+    """Discovery script that prints different host sets per phase number
+    (reference: elastic_common.py:34-63 epoch-driven bash discovery)."""
+    lines = ["#!/bin/sh", f'P=$(cat "{phase_file}" 2>/dev/null || echo 0)']
+    for i, hosts in enumerate(phases):
+        cond = "if" if i == 0 else "elif"
+        lines.append(f'{cond} [ "$P" = "{i}" ]; then')
+        for h in hosts:
+            lines.append(f'  echo "{h}"')
+    lines.append("fi")
+    script = tmp_path / "discover.sh"
+    script.write_text("\n".join(lines) + "\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+def _worker_env(log_path, **extra):
+    pythonpath = os.pathsep.join(
+        [os.path.dirname(HERE), HERE, os.environ.get("PYTHONPATH", "")])
+    env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "PYTHONPATH": pythonpath, "ELASTIC_TEST_LOG": str(log_path)}
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _launch_elastic(tmp_path, discovery, log_path, min_np=1, max_np=8,
+                    **worker_extra):
+    es = ElasticSettings(
+        Settings(num_proc=2, start_timeout=60,
+                 env=_worker_env(log_path, **worker_extra)),
+        discovery_script=discovery, min_np=min_np, max_np=max_np,
+        discovery_interval=0.2)
+    from horovod_tpu.runner.elastic_driver import launch_elastic_job
+    return launch_elastic_job(es, [sys.executable, WORKER])
+
+
+def _parse_log(log_path):
+    entries = []
+    for line in open(log_path):
+        m = re.match(r"(\S+) epoch=(\d+) rank=(\d+) size=(\d+)", line)
+        if m:
+            entries.append((m.group(1), int(m.group(2)), int(m.group(3)),
+                            int(m.group(4))))
+    return entries
+
+
+def test_elastic_scale_up(tmp_path):
+    phase_file = tmp_path / "phase"
+    phase_file.write_text("0")
+    log_path = tmp_path / "log"
+    discovery = _write_discovery(
+        tmp_path, phase_file, [["localhost:2"], ["localhost:3"]])
+
+    t = _flip_when(log_path, phase_file, "1",
+                   lambda e: len([x for x in e if x[3] == 2]) >= 2)
+    rc = _launch_elastic(tmp_path, discovery, log_path,
+                         ELASTIC_TEST_EPOCHS=10,
+                         ELASTIC_TEST_EPOCH_SLEEP=0.4)
+    t.join()
+    assert rc == 0, open(log_path).read() if log_path.exists() else "no log"
+    entries = _parse_log(log_path)
+    sizes = {e[3] for e in entries}
+    assert 2 in sizes, entries
+    assert 3 in sizes, entries  # the job grew mid-run
+    done = [line for line in open(log_path) if "DONE" in line]
+    assert len(done) == 3  # all final workers completed
+
+
+def test_elastic_worker_failure_recovers(tmp_path):
+    """Kill one worker mid-training: survivors restore the last commit,
+    the driver respawns a replacement, training completes all epochs."""
+    phase_file = tmp_path / "phase"
+    phase_file.write_text("0")
+    log_path = tmp_path / "log"
+    discovery = _write_discovery(tmp_path, phase_file, [["localhost:2"]])
+
+    rc = _launch_elastic(tmp_path, discovery, log_path,
+                         ELASTIC_TEST_EPOCHS=6,
+                         ELASTIC_TEST_EPOCH_SLEEP=0.3,
+                         ELASTIC_TEST_KILL_WORKER="localhost:1",
+                         ELASTIC_TEST_KILL_EPOCH=2)
+    content = open(log_path).read() if log_path.exists() else "no log"
+    assert rc == 0, content
+    assert "KILLED epoch=2" in content
+    entries = _parse_log(log_path)
+    # Epochs after the kill continue past the last committed epoch — no
+    # restart from zero by the survivor.
+    survivor = [e for e in entries if e[0] == "localhost:0"]
+    epochs = [e[1] for e in survivor]
+    assert epochs == sorted(epochs), survivor
+    assert max(epochs) == 5, survivor
+    done = [line for line in open(log_path) if "DONE" in line]
+    assert len(done) == 2, content
+
+
+def test_elastic_host_exclusion(tmp_path):
+    """A host removed by discovery drops out; the job shrinks and
+    completes on the remaining host (reference:
+    test/integration/test_elastic_torch.py host exclusion)."""
+    phase_file = tmp_path / "phase"
+    phase_file.write_text("0")
+    log_path = tmp_path / "log"
+    # 'localhost' and '127.0.0.1' act as two distinct "hosts" that both
+    # spawn locally.
+    discovery = _write_discovery(
+        tmp_path, phase_file,
+        [["localhost:1", "127.0.0.1:1"], ["localhost:1"]])
+
+    t = _flip_when(log_path, phase_file, "1",
+                   lambda e: len([x for x in e if x[3] == 2]) >= 2)
+    rc = _launch_elastic(tmp_path, discovery, log_path,
+                         ELASTIC_TEST_EPOCHS=10,
+                         ELASTIC_TEST_EPOCH_SLEEP=0.4)
+    t.join()
+    content = open(log_path).read() if log_path.exists() else "no log"
+    assert rc == 0, content
+    entries = _parse_log(log_path)
+    assert {e[3] for e in entries} >= {1, 2}, entries
+    done = [line for line in open(log_path) if "DONE" in line]
+    assert len(done) == 1, content
