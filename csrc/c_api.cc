@@ -37,13 +37,14 @@ extern "C" {
 void* hvd_core_create(int rank, int size, const char* transport,
                       const char* peers, int64_t fusion_threshold,
                       int64_t cache_capacity, double stall_warning_s,
-                      const char* timeline_path) {
+                      const char* timeline_path, int delegate_data_ops) {
   CoreOptions opts;
   if (fusion_threshold > 0) opts.controller.fusion_threshold = fusion_threshold;
   if (cache_capacity > 0)
     opts.controller.cache_capacity = static_cast<size_t>(cache_capacity);
   if (stall_warning_s > 0) opts.controller.stall_warning_s = stall_warning_s;
   if (timeline_path) opts.timeline_path = timeline_path;
+  opts.delegate_data_ops = delegate_data_ops != 0;
   auto ctx = std::make_unique<Ctx>();
   Status st = Core::Create(rank, size, transport ? transport : "tcp",
                            peers ? peers : "", opts, &ctx->core);
@@ -59,6 +60,59 @@ void hvd_core_destroy(void* h) { delete static_cast<Ctx*>(h); }
 // Rendezvous bootstrap: reserve (bind+listen) an ephemeral port that a
 // later hvd_core_create consumes, closing the publish-then-rebind race.
 int hvd_reserve_listen_port() { return ReserveListenPort(); }
+
+// --- delegated execution (external XLA data plane) ------------------------
+
+int64_t hvd_core_next_delegated(void* h) {
+  return static_cast<Ctx*>(h)->core->NextDelegated();
+}
+
+// Fills the fixed-size fields; returns 0 on success, -1 for a bad token.
+// sizes layout depends on type (allreduce: per-tensor flat sizes;
+// allgather: [rows per rank..., row_elems]; broadcast: [count, root]).
+int hvd_core_delegated_info(void* h, int64_t token, int32_t* ps_id,
+                            int32_t* type, int32_t* dtype, int32_t* red_op,
+                            double* prescale, double* postscale,
+                            int32_t* ntensors, int32_t* nsizes) {
+  const Core::Delegated* d =
+      static_cast<Ctx*>(h)->core->GetDelegated(token);
+  if (!d) return -1;
+  *ps_id = d->ps_id;
+  *type = static_cast<int32_t>(d->resp.type);
+  *dtype = static_cast<int32_t>(d->resp.dtype);
+  *red_op = static_cast<int32_t>(d->resp.op);
+  *prescale = d->resp.prescale;
+  *postscale = d->resp.postscale;
+  *ntensors = static_cast<int32_t>(d->resp.names.size());
+  *nsizes = static_cast<int32_t>(d->resp.sizes.size());
+  return 0;
+}
+
+// handles_out: ntensors entries (-1 = entry-less); sizes_out: nsizes.
+int hvd_core_delegated_meta(void* h, int64_t token, int64_t* handles_out,
+                            int64_t* sizes_out) {
+  const Core::Delegated* d =
+      static_cast<Ctx*>(h)->core->GetDelegated(token);
+  if (!d) return -1;
+  for (size_t i = 0; i < d->handles.size(); ++i)
+    handles_out[i] = d->handles[i];
+  for (size_t i = 0; i < d->resp.sizes.size(); ++i)
+    sizes_out[i] = d->resp.sizes[i];
+  return 0;
+}
+
+int hvd_core_delegated_complete(void* h, int64_t handle, const void* data,
+                                int64_t nbytes, const int64_t* shape,
+                                int32_t ndim, const char* error) {
+  return static_cast<Ctx*>(h)->core->CompleteDelegatedEntry(
+             handle, data, static_cast<size_t>(nbytes), shape, ndim, error)
+             ? 0
+             : -1;
+}
+
+void hvd_core_delegated_finish(void* h, int64_t token) {
+  static_cast<Ctx*>(h)->core->FinishDelegated(token);
+}
 
 int hvd_core_rank(void* h) { return static_cast<Ctx*>(h)->core->rank(); }
 int hvd_core_size(void* h) { return static_cast<Ctx*>(h)->core->size(); }

@@ -253,8 +253,21 @@ int Core::RunCycle() {
       for (Request& r : result.requeue)
         ps->queue.emplace_back(r, handles_by_name[r.name]);
     }
-    for (const Response& resp : result.to_execute.responses)
-      ExecuteResponse(*ps, resp, &completed);
+    for (const Response& resp : result.to_execute.responses) {
+      // Data ops can be delegated to the external (XLA) data plane; error
+      // responses, alltoall (uneven splits need the TCP plane), barrier,
+      // and join always run natively.
+      bool delegatable =
+          opts_.delegate_data_ops && resp.error.empty() &&
+          (resp.type == ReqType::kAllreduce ||
+           resp.type == ReqType::kAllgather ||
+           resp.type == ReqType::kBroadcast ||
+           resp.type == ReqType::kReducescatter);
+      if (delegatable)
+        DelegateResponse(ps_id, *ps, resp);
+      else
+        ExecuteResponse(*ps, resp, &completed);
+    }
     if (ps_id == 0 && result.shutdown) all_shutdown = true;
     if (agreed) *agreed = result.agreed_ps;
     ++cycles_;
@@ -573,6 +586,75 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
   if (!st.ok()) fail_all(st.reason);
   if (timeline_ && !resp.names.empty()) timeline_->OpEnd(resp.names[0]);
   unpin();
+}
+
+void Core::DelegateResponse(int ps_id, PsState& ps, const Response& resp) {
+  Delegated d;
+  d.ps_id = ps_id;
+  d.resp = resp;
+  d.handles.assign(resp.names.size(), -1);
+  std::lock_guard<std::mutex> g(mu_);
+  for (size_t i = 0; i < resp.names.size(); ++i) {
+    auto it = ps.inflight.find(resp.names[i]);
+    if (it == ps.inflight.end()) continue;
+    if (handles_.find(it->second) == handles_.end()) {
+      // Released while negotiating: participate entry-less.
+      ps.inflight.erase(it);
+      continue;
+    }
+    d.handles[i] = it->second;
+    // The name frees once execution starts (reference: the entry is popped
+    // from the tensor queue at PerformOperation); completion later is by
+    // handle, not name.
+    ps.inflight.erase(it);
+  }
+  // Queue even with zero local entries: a joined rank is still a member of
+  // the external collective and must contribute identity data.
+  int64_t token = next_token_++;
+  delegated_order_.push_back(token);
+  delegated_.emplace(token, std::move(d));
+}
+
+int64_t Core::NextDelegated() {
+  std::lock_guard<std::mutex> g(mu_);
+  if (delegated_order_.empty()) return 0;
+  int64_t token = delegated_order_.front();
+  delegated_order_.pop_front();
+  return token;
+}
+
+const Core::Delegated* Core::GetDelegated(int64_t token) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = delegated_.find(token);
+  return it == delegated_.end() ? nullptr : &it->second;
+}
+
+void Core::FinishDelegated(int64_t token) {
+  std::lock_guard<std::mutex> g(mu_);
+  delegated_.erase(token);
+}
+
+bool Core::CompleteDelegatedEntry(int64_t handle, const void* data,
+                                  size_t nbytes, const int64_t* shape,
+                                  int ndim, const char* error) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return false;  // released while delegated
+  Entry* e = it->second.get();
+  if (error && error[0]) {
+    e->state = HandleState::kError;
+    e->error = error;
+  } else {
+    e->output.assign(static_cast<const uint8_t*>(data),
+                     static_cast<const uint8_t*>(data) + nbytes);
+    e->out_shape.assign(shape, shape + ndim);
+    e->input.clear();
+    e->input.shrink_to_fit();
+    e->state = HandleState::kDone;
+    bytes_processed_ += nbytes;
+  }
+  cv_.notify_all();
+  return true;
 }
 
 HandleState Core::Poll(int64_t handle, std::string* error) {

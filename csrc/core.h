@@ -18,6 +18,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -100,6 +101,14 @@ struct Entry {
 struct CoreOptions {
   ControllerOptions controller;
   std::string timeline_path;  // empty = disabled
+  // Delegate data-op execution to the embedding runtime: negotiation and
+  // fusion ordering stay native, but agreed allreduce/allgather/broadcast/
+  // reducescatter responses queue for external execution (the XLA/ICI data
+  // plane) instead of running the TCP ring collectives. The analog of the
+  // reference's NCCL-executes/controller-negotiates split
+  // (reference: horovod/common/ops/nccl_operations.cc:80-119 — the NCCL
+  // data plane bootstraps and orders through the MPI/Gloo controller).
+  bool delegate_data_ops = false;
 };
 
 class Core {
@@ -132,6 +141,23 @@ class Core {
   const Entry* Get(int64_t handle);
   void Release(int64_t handle);
 
+  // --- delegated execution (external data plane; delegate_data_ops) ---
+  struct Delegated {
+    int ps_id = 0;
+    Response resp;                 // the negotiated (possibly fused) bucket
+    std::vector<int64_t> handles;  // parallel to resp.names; -1 entry-less
+  };
+  // Pop the next delegated response token (FIFO) or 0 when none pending.
+  int64_t NextDelegated();
+  // Valid until FinishDelegated(token).
+  const Delegated* GetDelegated(int64_t token);
+  void FinishDelegated(int64_t token);
+  // Write the externally computed result into the entry and complete its
+  // handle; empty/NULL error means success. False if the handle is gone.
+  bool CompleteDelegatedEntry(int64_t handle, const void* data,
+                              size_t nbytes, const int64_t* shape, int ndim,
+                              const char* error);
+
   int rank() const { return mux_->rank(); }
   int size() const { return mux_->size(); }
   uint64_t cycles() const { return cycles_; }
@@ -154,6 +180,7 @@ class Core {
   };
 
   void ExecuteResponse(PsState& ps, const Response& resp, int* completed);
+  void DelegateResponse(int ps_id, PsState& ps, const Response& resp);
   void CompleteHandle(int64_t handle, HandleState state,
                       const std::string& error);
 
@@ -179,6 +206,9 @@ class Core {
   std::set<int64_t> executing_handles_;
   std::vector<std::unique_ptr<Entry>> zombies_;
   int64_t next_handle_ = 0;
+  std::map<int64_t, Delegated> delegated_;  // token -> record
+  std::deque<int64_t> delegated_order_;     // unclaimed tokens, FIFO
+  int64_t next_token_ = 1;
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> shutdown_complete_{false};
   uint64_t cycles_ = 0;

@@ -120,6 +120,13 @@ def make_spmd_backend(topology):
         from ..runner import rendezvous
         if rendezvous.rendezvous_config() is not None:
             rendezvous.bootstrap_peers(topology)
+    cpu_ops = envparse.get_str(envparse.CPU_OPERATIONS, "").lower()
+    if cpu_ops in ("xla", "xla-global", "nccl"):
+        # Compiled data plane over the jax.distributed global mesh; the
+        # TCP core stays as control plane ("nccl" accepted for scripts
+        # written against the reference's HOROVOD_CPU_OPERATIONS knob).
+        from .xla_global import XlaGlobalBackend
+        return XlaGlobalBackend(topology)
     try:
         from .tcp_backend import TcpBackend
     except ImportError as e:

@@ -53,6 +53,9 @@ class _Pending:
 class TcpBackend(Backend):
     name = "tcp-native"
     drives_own_cycle = True
+    # Subclasses flip these to run the native core in delegated mode (the
+    # negotiation/fusion stays native; data ops execute externally).
+    delegate_data_ops = False
 
     def __init__(self, topology):
         peers = envparse.get_str(envparse.PEERS, "")
@@ -68,10 +71,14 @@ class TcpBackend(Backend):
             stall_warning_s=envparse.get_float(
                 envparse.STALL_CHECK_TIME_SECONDS, 0.0),
             timeline_path=(timeline + f".rank{topology.rank}") if timeline
-            else "")
+            else "",
+            delegate_data_ops=self.delegate_data_ops)
         self.topology = topology
         self._pending = []
         self._transport_dead = False
+        # handle -> submitted np array (delegated execution needs the
+        # local contribution by handle; only kept in delegated mode).
+        self._handle_arrays = {}
         self._ps_map = {0: 0}  # python process-set id -> native id
         self._log = get_logger()
         # Set by the coordinator so in-flight tensor names release when the
@@ -112,6 +119,15 @@ class TcpBackend(Backend):
                                else HorovodInternalError(str(exc)))
             return False
 
+    def _native_enqueue(self, ps, name, req, array=None, **kw):
+        if array is None:
+            h = self.core.enqueue(ps, name, req, **kw)
+        else:
+            h = self.core.enqueue(ps, name, req, array, **kw)
+        if self.delegate_data_ops and array is not None and h >= 0:
+            self._handle_arrays[h] = array
+        return h
+
     def _red_op(self, entry, n):
         """Map framework reduce op to (native op, extra postscale)."""
         op = entry.op
@@ -138,9 +154,9 @@ class TcpBackend(Backend):
             red, post_extra = self._red_op(entry, n)
             arrays = [np.asarray(a) for a in entry.arrays]
             if len(arrays) == 1:
-                h = core.enqueue(ps, entry.name, native.REQ_ALLREDUCE,
-                                 arrays[0], red_op=red, prescale=pre,
-                                 postscale=post * post_extra)
+                h = self._native_enqueue(
+                    ps, entry.name, native.REQ_ALLREDUCE, arrays[0],
+                    red_op=red, prescale=pre, postscale=post * post_extra)
                 return _Pending(entry, [h],
                                 _unpack_single(arrays[0].dtype,
                                                arrays[0].shape))
@@ -152,9 +168,9 @@ class TcpBackend(Backend):
                 raise HorovodInternalError(
                     "grouped allreduce requires uniform dtype per group")
             flat = np.concatenate([a.reshape(-1) for a in arrays])
-            h = core.enqueue(ps, entry.name, native.REQ_ALLREDUCE, flat,
-                             red_op=red, prescale=pre,
-                             postscale=post * post_extra)
+            h = self._native_enqueue(
+                ps, entry.name, native.REQ_ALLREDUCE, flat, red_op=red,
+                prescale=pre, postscale=post * post_extra)
             return _Pending(entry, [h], _unpack_group(arrays))
 
         if kind == "allgather":
@@ -162,7 +178,8 @@ class TcpBackend(Backend):
             handles = []
             for i, a in enumerate(arrays):
                 nm = entry.name if len(arrays) == 1 else f"{entry.name}.{i}"
-                handles.append(core.enqueue(ps, nm, native.REQ_ALLGATHER, a))
+                handles.append(self._native_enqueue(
+                    ps, nm, native.REQ_ALLGATHER, a))
             return _Pending(entry, handles, _unpack_list(arrays))
 
         if kind == "broadcast":
@@ -172,7 +189,7 @@ class TcpBackend(Backend):
             handles = []
             for i, a in enumerate(arrays):
                 nm = entry.name if len(arrays) == 1 else f"{entry.name}.{i}"
-                handles.append(core.enqueue(
+                handles.append(self._native_enqueue(
                     ps, nm, native.REQ_BROADCAST, a,
                     root_rank=entry.root_rank))
             return _Pending(entry, handles, _unpack_list(arrays))
@@ -186,8 +203,9 @@ class TcpBackend(Backend):
                         f"alltoall without splits requires dim0 divisible "
                         f"by process-set size {n}")
                 splits = np.full(n, a.shape[0] // n, dtype=np.int32)
-            h = core.enqueue(ps, entry.name, native.REQ_ALLTOALL, a,
-                             splits=np.asarray(splits, dtype=np.int32))
+            h = self._native_enqueue(
+                ps, entry.name, native.REQ_ALLTOALL, a,
+                splits=np.asarray(splits, dtype=np.int32))
             return _Pending(entry, [h], _unpack_alltoall(a.dtype, self))
 
         if kind == "reducescatter":
@@ -196,17 +214,17 @@ class TcpBackend(Backend):
             handles = []
             for i, a in enumerate(arrays):
                 nm = entry.name if len(arrays) == 1 else f"{entry.name}.{i}"
-                handles.append(core.enqueue(
+                handles.append(self._native_enqueue(
                     ps, nm, native.REQ_REDUCESCATTER, a, red_op=red,
                     postscale=post * post_extra))
             return _Pending(entry, handles, _unpack_list(arrays))
 
         if kind == "barrier":
-            h = core.enqueue(ps, entry.name, native.REQ_BARRIER)
+            h = self._native_enqueue(ps, entry.name, native.REQ_BARRIER)
             return _Pending(entry, [h], lambda core, hs: None)
 
         if kind == "join":
-            h = core.enqueue(ps, "__join__", native.REQ_JOIN)
+            h = self._native_enqueue(ps, "__join__", native.REQ_JOIN)
             return _Pending(entry, [h], _unpack_join())
 
         raise HorovodInternalError(f"unknown op kind {kind}")
@@ -221,6 +239,13 @@ class TcpBackend(Backend):
             self._fail_all(HorovodInternalError(
                 "native core transport failure (peer died?)"))
             return 0
+        self._drain_delegated()
+        return self._sweep_completions()
+
+    def _drain_delegated(self):
+        """Hook for delegated-execution subclasses (xla_global.py)."""
+
+    def _sweep_completions(self):
         done = 0
         still = []
         for p in self._pending:
@@ -235,6 +260,7 @@ class TcpBackend(Backend):
                         if s == 2]
                 for h in p.handles:
                     self.core.release(h)
+                    self._handle_arrays.pop(h, None)
                 if self.entry_done_cb:
                     self.entry_done_cb(p.entry)
                 p.entry.handle._fail(HorovodInternalError("; ".join(errs)))
@@ -250,6 +276,7 @@ class TcpBackend(Backend):
                 finally:
                     for h in p.handles:
                         self.core.release(h)
+                        self._handle_arrays.pop(h, None)
                 done += 1
         self._pending = still
         return done
@@ -260,6 +287,9 @@ class TcpBackend(Backend):
                 self.entry_done_cb(p.entry)
             p.entry.handle._fail(exc)
         self._pending = []
+        # Every in-flight submission is dead; drop the recorded arrays so
+        # a backend surviving into elastic recovery does not retain them.
+        self._handle_arrays.clear()
 
     def pending_count(self):
         return len(self._pending)

@@ -91,7 +91,8 @@ def load_library():
         lib.hvd_core_create.restype = ctypes.c_void_p
         lib.hvd_core_create.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_double, ctypes.c_char_p]
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_double, ctypes.c_char_p,
+            ctypes.c_int]
         lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
         lib.hvd_reserve_listen_port.restype = ctypes.c_int
         lib.hvd_reserve_listen_port.argtypes = []
@@ -131,6 +132,23 @@ def load_library():
         lib.hvd_core_cycles.argtypes = [ctypes.c_void_p]
         lib.hvd_core_bytes_processed.restype = ctypes.c_uint64
         lib.hvd_core_bytes_processed.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_next_delegated.restype = ctypes.c_int64
+        lib.hvd_core_next_delegated.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_delegated_info.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.hvd_core_delegated_meta.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_core_delegated_complete.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.c_char_p]
+        lib.hvd_core_delegated_finish.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
         _lib = lib
         return lib
 
@@ -166,12 +184,13 @@ class NativeCore:
 
     def __init__(self, rank, size, transport="tcp", peers="",
                  fusion_threshold=0, cache_capacity=0, stall_warning_s=0.0,
-                 timeline_path=""):
+                 timeline_path="", delegate_data_ops=False):
         self._lib = load_library()
         self._ctx = self._lib.hvd_core_create(
             rank, size, transport.encode(), peers.encode(),
             int(fusion_threshold), int(cache_capacity),
-            float(stall_warning_s), timeline_path.encode())
+            float(stall_warning_s), timeline_path.encode(),
+            1 if delegate_data_ops else 0)
         if not self._ctx:
             raise NativeError(
                 f"native core init failed (rank {rank}/{size}, transport "
@@ -284,6 +303,59 @@ class NativeCore:
         self._lib.hvd_core_release(self._ctx, handle)
 
     # -- stats ------------------------------------------------------------
+    # -- delegated execution (external XLA data plane) --------------------
+    def next_delegated(self):
+        """Token of the next negotiated-but-externally-executed response,
+        or 0 when none is pending."""
+        return int(self._lib.hvd_core_next_delegated(self._ctx))
+
+    def delegated(self, token):
+        """Fetch a delegated response descriptor as a dict."""
+        ps_id = ctypes.c_int32()
+        rtype = ctypes.c_int32()
+        dtype = ctypes.c_int32()
+        red_op = ctypes.c_int32()
+        pre = ctypes.c_double()
+        post = ctypes.c_double()
+        nt = ctypes.c_int32()
+        ns = ctypes.c_int32()
+        rc = self._lib.hvd_core_delegated_info(
+            self._ctx, token, ctypes.byref(ps_id), ctypes.byref(rtype),
+            ctypes.byref(dtype), ctypes.byref(red_op), ctypes.byref(pre),
+            ctypes.byref(post), ctypes.byref(nt), ctypes.byref(ns))
+        if rc != 0:
+            raise NativeError(f"bad delegated token {token}")
+        handles = (ctypes.c_int64 * max(1, nt.value))()
+        sizes = (ctypes.c_int64 * max(1, ns.value))()
+        self._lib.hvd_core_delegated_meta(self._ctx, token, handles, sizes)
+        return {
+            "token": token,
+            "ps_id": ps_id.value,
+            "type": rtype.value,
+            "dtype": dtype.value,
+            "red_op": red_op.value,
+            "prescale": pre.value,
+            "postscale": post.value,
+            "handles": list(handles[:nt.value]),
+            "sizes": list(sizes[:ns.value]),
+        }
+
+    def delegated_complete(self, handle, array=None, error=""):
+        """Write the externally computed result (C-contiguous numpy array)
+        into the native entry, or fail it with ``error``."""
+        if error:
+            self._lib.hvd_core_delegated_complete(
+                self._ctx, handle, None, 0, None, 0, error.encode())
+            return
+        arr = np.ascontiguousarray(array)
+        shape = (ctypes.c_int64 * max(1, arr.ndim))(*arr.shape)
+        self._lib.hvd_core_delegated_complete(
+            self._ctx, handle, arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, shape, arr.ndim, b"")
+
+    def delegated_finish(self, token):
+        self._lib.hvd_core_delegated_finish(self._ctx, token)
+
     def cycles(self):
         return self._lib.hvd_core_cycles(self._ctx)
 

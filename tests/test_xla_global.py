@@ -1,0 +1,57 @@
+"""XLA-global data plane tests: 2 processes x 4 virtual devices each, the
+compiled multi-host story the driver's dryrun validates single-process
+(VERDICT round-1 item 4: prove the SPMD data plane is XLA, not sockets)."""
+
+import os
+import socket
+import sys
+
+import pytest
+
+from test_spmd import launch
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+XLA_WORKER = os.path.join(HERE, "xla_global_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("size", [2])
+def test_xla_global_static(size):
+    """Static peers (env-fed) + explicit coordinator address."""
+    extra = {
+        "HVDTPU_CPU_OPERATIONS": "xla",
+        "HVDTPU_XLA_COORD": f"127.0.0.1:{_free_port()}",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XGW_LOCAL_DEVICES": "4",
+    }
+    codes, outs = launch(size, script=XLA_WORKER, extra_env=extra,
+                         timeout=300)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+        assert f"rank {rank}/{size}: XLA-GLOBAL OK" in out
+
+
+def test_xla_global_through_hvdrun():
+    """Launcher-rendezvoused: the JAX coordinator address is brokered
+    through the hvdrun KV store (the NCCL-unique-id-over-controller
+    analog), no hand-fed env at all."""
+    from horovod_tpu.runner import run_command
+    pythonpath = os.pathsep.join(
+        [os.path.dirname(HERE), HERE, os.environ.get("PYTHONPATH", "")])
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": pythonpath,
+        "HVDTPU_CPU_OPERATIONS": "xla",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XGW_LOCAL_DEVICES": "4",
+    }
+    rc = run_command([sys.executable, XLA_WORKER], num_proc=2, env=env,
+                     start_timeout=180)
+    assert rc == 0

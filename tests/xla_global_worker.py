@@ -1,0 +1,135 @@
+"""Worker for the XLA-global data plane tests (HVDTPU_CPU_OPERATIONS=xla).
+
+One rank of an N-process job whose eager collectives execute as jitted XLA
+collectives over the jax.distributed global mesh while the native TCP core
+negotiates (see horovod_tpu/backend/xla_global.py). Also jits a step over
+ALL global devices to prove multi-host compiled SPMD works through the
+same bootstrap — the driver's dryrun_multichip story spanning processes.
+"""
+
+import math
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+# The axon TPU plugin force-selects itself regardless of JAX_PLATFORMS;
+# the test runs on the virtual CPU mesh (must precede backend init AND
+# jax.distributed.initialize).
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import basics  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    rt = basics.runtime()
+    assert rt.backend.name == "xla-global", rt.backend.name
+    assert rt.backend.delegate_data_ops
+
+    local_n = int(os.environ.get("XGW_LOCAL_DEVICES", "4"))
+    assert len(jax.devices()) == size * local_n, (
+        f"global mesh missing: {len(jax.devices())} != {size}x{local_n}")
+    assert len(jax.local_devices()) == local_n
+
+    # -- allreduce sum / average / steady-state cache ----------------------
+    x = jnp.arange(5, dtype=jnp.float32) + rank
+    expect = np.arange(5, dtype=np.float32) * size + sum(range(size))
+    out = hvd.allreduce(x, op=hvd.Sum, name="ar")
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+    avg = hvd.allreduce(x, name="avg")
+    np.testing.assert_allclose(np.asarray(avg), expect / size, rtol=1e-6)
+    for _ in range(3):
+        again = hvd.allreduce(x, op=hvd.Sum, name="ar")
+        np.testing.assert_allclose(np.asarray(again), expect, rtol=1e-6)
+
+    # -- grouped allreduce (one fused XLA call) ----------------------------
+    outs = hvd.grouped_allreduce(
+        [jnp.full((2,), float(rank)), jnp.full((3, 2), 2.0 * rank)],
+        name="gar", op=hvd.Sum)
+    s = sum(range(size))
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((2,), s))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full((3, 2), 2.0 * s))
+
+    # -- min / max / product ----------------------------------------------
+    v = jnp.full((4,), float(rank + 1))
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(v, op=hvd.Min, name="mn")), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(v, op=hvd.Max, name="mx")), float(size))
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(v, op=hvd.Product, name="pr")),
+        float(math.factorial(size)))
+
+    # -- broadcast ---------------------------------------------------------
+    b = hvd.broadcast(jnp.full((3,), float(rank)), root_rank=1, name="bc")
+    np.testing.assert_allclose(np.asarray(b), 1.0)
+
+    # -- allgather with uneven dim0 ---------------------------------------
+    g = hvd.allgather(jnp.full((rank + 1, 2), float(rank)), name="ag")
+    g = np.asarray(g)
+    assert g.shape == (sum(r + 1 for r in range(size)), 2), g.shape
+    off = 0
+    for r in range(size):
+        np.testing.assert_allclose(g[off:off + r + 1], float(r))
+        off += r + 1
+
+    # -- reducescatter (uneven rows: remainder to low ranks) --------------
+    rs = hvd.reducescatter(jnp.ones((size + 1, 3)), op=hvd.Sum, name="rs")
+    rs = np.asarray(rs)
+    base, rem = divmod(size + 1, size)
+    my_rows = base + (1 if rank < rem else 0)
+    assert rs.shape == (my_rows, 3), rs.shape
+    np.testing.assert_allclose(rs, float(size))
+
+    # -- fp16 --------------------------------------------------------------
+    h16 = hvd.allreduce(jnp.ones(3, jnp.float16) * (rank + 1), op=hvd.Sum,
+                        name="h16")
+    np.testing.assert_allclose(np.asarray(h16, dtype=np.float32),
+                               sum(r + 1 for r in range(size)))
+
+    # -- barrier + alltoall still ride the native TCP plane ---------------
+    hvd.barrier()
+    a = jnp.full((size, 2), float(rank), jnp.float32)
+    at = hvd.alltoall(a, name="a2a")
+    np.testing.assert_allclose(
+        np.asarray(at),
+        np.repeat(np.arange(size, dtype=np.float32), 2).reshape(size, 2))
+
+    # -- compiled SPMD over ALL global devices (multi-host pjit) ----------
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    n_global = size * local_n
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    w = jnp.ones((8, 8), jnp.float32)
+
+    @jax.jit
+    def step(batch, w):
+        def inner(b, w):
+            y = b @ w
+            loss_grad = jax.lax.psum(y.sum(0, keepdims=True), "dp")
+            return loss_grad
+        return shard_map(inner, mesh=mesh, in_specs=(P("dp"), P()),
+                         out_specs=P())(batch, w)
+
+    local_batch = np.full((local_n, 8), 1.0 + rank, np.float32)
+    batch = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local_batch)
+    res = np.asarray(step(batch, w).addressable_data(0))
+    expect_sum = 8.0 * sum((1.0 + r) * local_n for r in range(size))
+    np.testing.assert_allclose(res[0], expect_sum, rtol=1e-6)
+
+    print(f"rank {rank}/{size}: XLA-GLOBAL OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
