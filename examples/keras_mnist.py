@@ -1,0 +1,54 @@
+"""Keras 3 MNIST-style training with horovod_tpu (reference:
+examples/keras/keras_mnist.py — same structure; synthetic MNIST-shaped
+data since this environment has no dataset egress). Works on any eager
+Keras backend (torch / tensorflow / jax-eager).
+
+Run:  KERAS_BACKEND=torch hvdrun -np 2 python examples/keras_mnist.py
+"""
+
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    import keras
+
+    hvd.init()
+
+    rng = np.random.RandomState(42 + hvd.rank())
+    x = rng.rand(512, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(512,))
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(16, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # Scale LR by world size; warmup ramps it in (reference pattern).
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    model.fit(
+        x, y, batch_size=64, epochs=3,
+        verbose=1 if hvd.rank() == 0 else 0,
+        callbacks=[
+            hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd.callbacks.MetricAverageCallback(),
+            hvd.callbacks.LearningRateWarmupCallback(
+                initial_lr=0.01 * hvd.size(), warmup_epochs=2),
+        ])
+
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

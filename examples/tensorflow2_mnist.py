@@ -1,0 +1,64 @@
+"""TF2 MNIST-style training with horovod_tpu (reference:
+examples/tensorflow2/tensorflow2_mnist.py — same structure, synthetic
+MNIST-shaped data since this environment has no dataset egress).
+
+Run:  hvdrun -np 2 python examples/tensorflow2_mnist.py
+"""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def synthetic_mnist(rank, samples=512):
+    rng = np.random.RandomState(42 + rank)  # per-rank shard
+    x = rng.rand(samples, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(samples,)).astype(np.int64)
+    return x, y
+
+
+def main():
+    hvd.init()
+
+    x, y = synthetic_mnist(hvd.rank())
+    dataset = tf.data.Dataset.from_tensor_slices((x, y)) \
+        .shuffle(1024, seed=hvd.rank()).batch(64)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_fn = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+    # Scale LR by world size (reference pattern).
+    opt = tf.optimizers.Adam(0.001 * hvd.size())
+
+    @tf.function
+    def train_step(images, labels, first_batch):
+        with tf.GradientTape() as tape:
+            logits = model(images, training=True)
+            loss = loss_fn(labels, logits)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    for step, (images, labels) in enumerate(dataset.take(100)):
+        loss = train_step(images, labels, step == 0)
+        if step == 0:
+            # Sync initial state after the first step builds variables
+            # (reference: broadcast after first gradient application).
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        if step % 20 == 0 and hvd.rank() == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Shared Keras-binding implementation (reference:
+horovod/_keras/__init__.py:207). Keras 3 is multi-backend; gradients are
+synchronized through the process-level SPMD plane regardless of which
+backend (tensorflow / torch / jax-eager) computes them. The jit-compiled
+keras-on-jax path belongs to ``horovod_tpu.jax`` (in-jit psum) instead —
+a host-side eager collective cannot run inside a jitted train step.
+"""
+
+import numpy as np
+
+from .. import basics
+from ..ops import collectives as _c
+from ..ops import reduce_ops
+from ..utils.logging_util import get_logger
+
+
+def spmd_active():
+    rt = basics.runtime()
+    return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
+
+
+def rank():
+    return basics.runtime().topology.rank
+
+
+def size():
+    return basics.runtime().topology.size
+
+
+def _reduce_numpy_grads(grads, op, prescale, postscale, name):
+    """Grouped allreduce over a list of numpy arrays (None passthrough)."""
+    dense_idx = [i for i, g in enumerate(grads) if g is not None]
+    dense = [np.asarray(grads[i]) for i in dense_idx]
+    if not dense:
+        return grads
+    outs = _c.grouped_allreduce(dense, op=op, name=name,
+                                prescale_factor=prescale,
+                                postscale_factor=postscale)
+    result = list(grads)
+    for i, o in zip(dense_idx, outs):
+        result[i] = np.asarray(o)
+    return result
+
+
+def create_distributed_optimizer(keras, optimizer, name=None,
+                                 op=reduce_ops.Average,
+                                 gradient_predivide_factor=1.0,
+                                 backward_passes_per_step=1,
+                                 average_aggregated_gradients=True):
+    """Dynamic subclass of the optimizer whose apply() averages gradients
+    across ranks first (reference: horovod/_keras/__init__.py:36
+    create_distributed_optimizer)."""
+    cls = type(optimizer)
+    backend = keras.backend.backend()
+    log = get_logger()
+
+    def _sync(grads):
+        if not spmd_active():
+            return grads
+        if backend == "tensorflow":
+            # Symbolic under tf.function: route through the TF binding's
+            # py_function bridge. None grads (unused variables) pass
+            # through untouched.
+            from .. import tensorflow as hvd_tf
+            dense_idx = [i for i, g in enumerate(grads) if g is not None]
+            if not dense_idx:
+                return grads
+            outs = hvd_tf.grouped_allreduce(
+                [grads[i] for i in dense_idx], op=op, name="keras_grads",
+                prescale_factor=(1.0 / gradient_predivide_factor
+                                 if gradient_predivide_factor != 1.0
+                                 else 1.0),
+                postscale_factor=(gradient_predivide_factor
+                                  if gradient_predivide_factor != 1.0
+                                  else 1.0))
+            result = list(grads)
+            for i, o in zip(dense_idx, outs):
+                result[i] = o
+            return result
+        np_grads = [None if g is None
+                    else np.asarray(keras.ops.convert_to_numpy(g))
+                    for g in grads]
+        outs = _reduce_numpy_grads(
+            np_grads, op,
+            1.0 / gradient_predivide_factor
+            if gradient_predivide_factor != 1.0 else 1.0,
+            gradient_predivide_factor
+            if gradient_predivide_factor != 1.0 else 1.0,
+            "keras_grads")
+        return [None if o is None else keras.ops.convert_to_tensor(o)
+                for o in outs]
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            grads = _sync(list(grads))
+            return cls.apply(self, grads, trainable_variables, **kwargs)
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            gv = list(grads_and_vars)
+            grads = _sync([g for g, _ in gv])
+            return cls.apply_gradients(
+                self, list(zip(grads, [v for _, v in gv])), **kwargs)
+
+    optimizer.__class__ = _Distributed
+    if spmd_active():
+        log.info("keras DistributedOptimizer (%s backend) wrapping %s "
+                 "over %d ranks", backend, cls.__name__, size())
+    return optimizer

@@ -110,6 +110,7 @@ def init_jax_distributed(topology):
         ip = rdv._local_ip_towards(addr, port)
         errs = []
         thread = None
+        last_err = None
         for _ in range(3):
             coord = f"{ip}:{_free_port()}"
 
@@ -126,7 +127,11 @@ def init_jax_distributed(topology):
             thread.join(timeout=2.0)
             if not errs:
                 break  # bound (blocked in the connect barrier) or done
+            last_err = errs[0]
             errs.clear()
+        else:
+            raise HorovodInternalError(
+                f"could not start the JAX coordinator: {last_err}")
         log.info("xla-global: serving jax.distributed coordinator at %s",
                  coord)
         http_client.put_kv(addr, port, JAXDIST_SCOPE, "coord", coord,
@@ -184,7 +189,15 @@ class XlaGlobalBackend(TcpBackend):
     def remove_process_set(self, ps):
         native_id = self._ps_map.get(ps.process_set_id)
         super().remove_process_set(ps)
-        self._ps_ranks.pop(native_id, None)
+        ranks = self._ps_ranks.pop(native_id, None)
+        if ranks is not None:
+            # Evict the set's mesh AND its jitted collectives (keyed by
+            # id(mesh)) so removed sets don't accumulate executables.
+            mesh = self._mesh_cache.pop(tuple(ranks), None)
+            if mesh is not None:
+                dead = id(mesh)
+                self._fn_cache = {k: v for k, v in self._fn_cache.items()
+                                  if k[0] != dead}
 
     def _mesh_for(self, ranks):
         key = tuple(ranks)
@@ -305,14 +318,16 @@ class XlaGlobalBackend(TcpBackend):
         """Reduce-op identity for entry-less slots (joined ranks or
         handles released mid-negotiation) — zeros would corrupt
         min/max/prod, same guard as the native FillReduceIdentity
-        (csrc/core.cc)."""
+        (csrc/collectives.cc; integer dtypes use type extrema there too:
+        np.inf would OverflowError on int min/max)."""
+        dt = np.dtype(dtype)
         if op == _RED_MIN:
-            return np.dtype(dtype).type(np.inf)
+            return dt.type(np.inf) if dt.kind == "f" else np.iinfo(dt).max
         if op == _RED_MAX:
-            return np.dtype(dtype).type(-np.inf)
+            return dt.type(-np.inf) if dt.kind == "f" else np.iinfo(dt).min
         if op == _RED_PROD:
-            return np.dtype(dtype).type(1)
-        return np.dtype(dtype).type(0)
+            return dt.type(1)
+        return dt.type(0)
 
     def _delegated_allreduce(self, d, mesh, dtype):
         sizes = d["sizes"]  # flat element count per fused tensor
@@ -395,6 +410,10 @@ class XlaGlobalBackend(TcpBackend):
         # psum_scatter; reduce fully, then slice this rank's rows.
         h = d["handles"][0]
         if h < 0:
+            # Unreachable via Join (the controller rejects join +
+            # reducescatter at ConstructResponse, like the reference); only
+            # a handle released mid-negotiation lands here, and the native
+            # path errors identically (csrc/core.cc kReducescatter !e).
             raise HorovodInternalError("reducescatter with no local entry")
         arr = np.ascontiguousarray(self._handle_arrays[h], dtype=dtype)
         rows = arr.shape[0] if arr.ndim else 1

@@ -1,0 +1,133 @@
+"""Keras binding (reference: horovod/keras/__init__.py:201 +
+horovod/tensorflow/keras/__init__.py). Works with Keras 3's multi-backend
+model.fit: gradients sync across hvdrun-launched ranks inside
+``optimizer.apply`` regardless of the compute backend (tensorflow eager/
+graph, torch, jax-eager). For jit-compiled keras-on-jax training use
+``horovod_tpu.jax`` (in-jit collectives) instead.
+
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(optimizer=opt, ...)
+    model.fit(..., callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0)])
+"""
+
+from .. import basics
+from ..ops import reduce_ops
+from .._keras import (create_distributed_optimizer, rank, size,
+                      spmd_active)
+
+Average = reduce_ops.Average
+Sum = reduce_ops.Sum
+Adasum = reduce_ops.Adasum
+
+init = basics.init
+shutdown = basics.shutdown
+is_initialized = basics.is_initialized
+local_rank = basics.local_rank
+local_size = basics.local_size
+cross_rank = basics.cross_rank
+cross_size = basics.cross_size
+
+__all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
+           "DistributedOptimizer", "broadcast_global_variables",
+           "allreduce", "allgather", "broadcast", "load_model",
+           "callbacks"]
+
+
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse="", compression=None,
+                         sparse_as_dense=False, gradient_predivide_factor=1.0,
+                         op=Average, backward_passes_per_step=1,
+                         average_aggregated_gradients=True):
+    """Reference: horovod/keras/__init__.py:36 DistributedOptimizer."""
+    import keras
+    return create_distributed_optimizer(
+        keras, optimizer, name=name, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients)
+
+
+def broadcast_global_variables(root_rank=0, model=None):
+    """Broadcast a model's weights from root_rank (reference:
+    horovod/keras/__init__.py broadcast_global_variables)."""
+    if model is None or not spmd_active():
+        return
+    import numpy as np
+    from ..functions import broadcast_variables as _bv
+    synced = _bv(model.get_weights(), root_rank=root_rank)
+    model.set_weights([np.asarray(w) for w in synced])
+
+
+def allreduce(value, name=None, average=True,
+              prescale_factor=1.0, postscale_factor=1.0, op=None):
+    import numpy as np
+    import keras
+    from ..ops import collectives as _c
+    if op is None:
+        op = Average if average else Sum
+    if not spmd_active():
+        return value
+    out = _c.allreduce(np.asarray(keras.ops.convert_to_numpy(value)),
+                       op=op, name=name, prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return keras.ops.convert_to_tensor(np.asarray(out))
+
+
+def allgather(value, name=None):
+    import numpy as np
+    import keras
+    from ..ops import collectives as _c
+    if not spmd_active():
+        return value
+    out = _c.allgather(np.asarray(keras.ops.convert_to_numpy(value)),
+                       name=name)
+    return keras.ops.convert_to_tensor(np.asarray(out))
+
+
+def broadcast(value, root_rank, name=None):
+    import numpy as np
+    import keras
+    from ..ops import collectives as _c
+    if not spmd_active():
+        return value
+    out = _c.broadcast(np.asarray(keras.ops.convert_to_numpy(value)),
+                       root_rank, name=name)
+    return keras.ops.convert_to_tensor(np.asarray(out))
+
+
+def load_model(filepath, custom_objects=None, compile=True,  # noqa: A002
+               **kwargs):
+    """Load a model and wrap its optimizer (reference:
+    horovod/keras/__init__.py:167 load_model)."""
+    import keras
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects,
+                                    compile=compile, **kwargs)
+    if compile and getattr(model, "optimizer", None) is not None:
+        model.optimizer = DistributedOptimizer(model.optimizer)
+    return model
+
+
+class _Callbacks:
+    """Lazy namespace: hvd.callbacks.BroadcastGlobalVariablesCallback etc.
+    (reference: horovod/_keras/callbacks.py)."""
+
+    def __getattr__(self, item):
+        from .._keras.callbacks import make_callbacks
+        (bgv, ma, warmup, sched) = make_callbacks()
+        mapping = {
+            "BroadcastGlobalVariablesCallback": bgv,
+            "MetricAverageCallback": ma,
+            "LearningRateWarmupCallback": warmup,
+            "LearningRateScheduleCallback": sched,
+        }
+        try:
+            return mapping[item]
+        except KeyError:
+            raise AttributeError(item)
+
+
+callbacks = _Callbacks()

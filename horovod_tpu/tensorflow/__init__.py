@@ -1,0 +1,346 @@
+"""TensorFlow 2 binding: drop-in surface of the reference's
+``horovod.tensorflow`` (reference: horovod/tensorflow/__init__.py:29-43,
+mpi_ops.py) on the horovod_tpu runtime.
+
+Process-level semantics, exactly like the reference: one process per
+accelerator (launched by ``hvdrun``), ``rank()/size()`` come from the
+launcher topology, and collectives ride the SPMD data plane (TCP fallback
+or the XLA global mesh, backend/xla_global.py). Inside ``tf.function``
+graphs the ops run through ``tf.py_function`` — the host-side enqueue is
+the same boundary the reference crosses with its custom-op kernels
+(reference: horovod/tensorflow/mpi_ops.cc:431 ComputeAsync).
+"""
+
+import numpy as np
+
+from .. import basics
+from ..ops import reduce_ops
+from ..ops import collectives as _c
+from ..process_sets import global_process_set
+from ..utils.logging_util import get_logger
+
+Average = reduce_ops.Average
+Sum = reduce_ops.Sum
+Adasum = reduce_ops.Adasum
+Min = reduce_ops.Min
+Max = reduce_ops.Max
+Product = reduce_ops.Product
+
+init = basics.init
+shutdown = basics.shutdown
+is_initialized = basics.is_initialized
+local_rank = basics.local_rank
+local_size = basics.local_size
+cross_rank = basics.cross_rank
+cross_size = basics.cross_size
+is_homogeneous = basics.is_homogeneous
+mpi_enabled = basics.mpi_enabled
+gloo_enabled = basics.gloo_enabled
+nccl_built = basics.nccl_built
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def rank():
+    """Process-level rank (launcher topology, not virtual devices)."""
+    return basics.runtime().topology.rank
+
+
+def size():
+    return basics.runtime().topology.size
+
+
+def _spmd():
+    """True when collectives actually span processes. In single-controller
+    mode this binding behaves as world size 1 — per-process drop-in
+    scripts use hvdrun (the compiled per-device path lives in
+    horovod_tpu.jax instead)."""
+    rt = basics.runtime()
+    return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
+
+
+def _np_of(tensor):
+    tf = _tf()
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(
+        tf.convert_to_tensor(tensor))
+
+
+def _eager(fn, tensors, out_dtypes, name):
+    """Run fn (numpy -> list[numpy]) now if eager, else via py_function so
+    it works inside tf.function graphs."""
+    tf = _tf()
+    if tf.executing_eagerly():
+        outs = fn([_np_of(t) for t in tensors])
+        return [tf.convert_to_tensor(o) for o in outs]
+
+    def wrapper(*args):
+        outs = fn([a.numpy() for a in args])
+        return [tf.convert_to_tensor(o) for o in outs]
+
+    return tf.py_function(func=wrapper, inp=list(tensors), Tout=out_dtypes)
+
+
+def _result_np(x):
+    return np.asarray(x)
+
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=None, op=None, prescale_factor=1.0,
+              postscale_factor=1.0, name=None,
+              process_set=global_process_set):
+    """Reference: horovod/tensorflow/__init__.py:55-161 ``allreduce``.
+    IndexedSlices are densified (the reference's ``sparse_as_dense``
+    behavior) before reduction."""
+    tf = _tf()
+    if op is None:
+        op = Sum if average is False else Average
+    if isinstance(tensor, tf.IndexedSlices):
+        tensor = tf.convert_to_tensor(tensor)
+    if not _spmd():
+        scale = prescale_factor * postscale_factor
+        return tensor * scale if scale != 1.0 else tf.convert_to_tensor(
+            tensor)
+
+    def fn(arrs):
+        out = _c.allreduce(arrs[0], op=op, name=name,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           process_set=process_set)
+        return [_result_np(out)]
+
+    return _eager(fn, [tensor], [tensor.dtype], name)[0]
+
+
+def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
+                      postscale_factor=1.0, name=None,
+                      process_set=global_process_set):
+    if op is None:
+        op = Sum if average is False else Average
+    if not _spmd():
+        tf = _tf()
+        scale = prescale_factor * postscale_factor
+        return [t * scale if scale != 1.0 else tf.convert_to_tensor(t)
+                for t in tensors]
+
+    def fn(arrs):
+        outs = _c.grouped_allreduce(arrs, op=op, name=name,
+                                    prescale_factor=prescale_factor,
+                                    postscale_factor=postscale_factor,
+                                    process_set=process_set)
+        return [_result_np(o) for o in outs]
+
+    return _eager(fn, tensors, [t.dtype for t in tensors], name)
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    if not _spmd():
+        return _tf().convert_to_tensor(tensor)
+
+    def fn(arrs):
+        return [_result_np(_c.allgather(arrs[0], name=name,
+                                        process_set=process_set))]
+
+    return _eager(fn, [tensor], [tensor.dtype], name)[0]
+
+
+def broadcast(tensor, root_rank, name=None,
+              process_set=global_process_set):
+    if not _spmd():
+        return _tf().convert_to_tensor(tensor)
+
+    def fn(arrs):
+        return [_result_np(_c.broadcast(arrs[0], root_rank, name=name,
+                                        process_set=process_set))]
+
+    return _eager(fn, [tensor], [tensor.dtype], name)[0]
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    tf = _tf()
+    if not _spmd():
+        out = tf.convert_to_tensor(tensor)
+        if splits is None:
+            return out
+        return out, tf.convert_to_tensor(np.asarray(splits))
+
+    if splits is None:
+        def fn(arrs):
+            return [_result_np(_c.alltoall(arrs[0], None, name=name,
+                                           process_set=process_set))]
+        return _eager(fn, [tensor], [tensor.dtype], name)[0]
+
+    def fn(arrs):
+        out, rsplits = _c.alltoall(arrs[0], arrs[1], name=name,
+                                   process_set=process_set)
+        return [_result_np(out), np.asarray(rsplits, np.int32)]
+
+    outs = _eager(fn, [tensor, tf.cast(splits, tf.int32)],
+                  [tensor.dtype, tf.int32], name)
+    return outs[0], outs[1]
+
+
+def reducescatter(tensor, op=None, name=None,
+                  process_set=global_process_set):
+    if not _spmd():
+        return _tf().convert_to_tensor(tensor)
+
+    def fn(arrs):
+        return [_result_np(_c.reducescatter(arrs[0], op=op or Average,
+                                            name=name,
+                                            process_set=process_set))]
+
+    return _eager(fn, [tensor], [tensor.dtype], name)[0]
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    from ..functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj, name=None):
+    from ..functions import allgather_object as _ao
+    return _ao(obj, name=name)
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every variable its root-rank value (fused broadcast;
+    reference: horovod/tensorflow/functions.py:66)."""
+    from ..functions import broadcast_variables as _bv
+    variables = list(variables)
+    if not variables or not _spmd():
+        return
+    outs = _bv([v.numpy() for v in variables], root_rank=root_rank)
+    for v, out in zip(variables, outs):
+        v.assign(np.asarray(out))
+
+
+def join(device=-1):
+    if not _spmd():
+        return -1
+    return _c.join(device)
+
+
+def barrier(process_set=global_process_set):
+    if not _spmd():
+        return
+    return _c.barrier(process_set=process_set)
+
+
+class DistributedGradientTape:
+    """tf.GradientTape wrapper averaging gradients across ranks
+    (reference: horovod/tensorflow/__init__.py:777)."""
+
+    def __init__(self, gradtape, device_dense="", device_sparse="",
+                 compression=None, sparse_as_dense=True, op=Average,
+                 gradient_predivide_factor=1.0,
+                 num_groups=0, process_set=global_process_set):
+        self._tape = gradtape
+        self._op = op
+        self._process_set = process_set
+        self._predivide = gradient_predivide_factor
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        if not _spmd():
+            return grads
+        return _reduce_grads(grads, self._op, self._process_set,
+                             self._predivide)
+
+
+def _reduce_grads(grads, op, process_set, predivide=1.0):
+    tf = _tf()
+    dense_idx, dense = [], []
+    for i, g in enumerate(grads):
+        if g is None:
+            continue
+        if isinstance(g, tf.IndexedSlices):
+            g = tf.convert_to_tensor(g)
+        dense_idx.append(i)
+        dense.append(g)
+    if not dense:
+        return grads
+    pre = 1.0 / predivide if predivide != 1.0 else 1.0
+    post = predivide / 1.0 if predivide != 1.0 else 1.0
+    outs = grouped_allreduce(dense, op=op, prescale_factor=pre,
+                             postscale_factor=post,
+                             name="grad_reduce", process_set=process_set)
+    result = list(grads)
+    for i, o in zip(dense_idx, outs):
+        result[i] = o
+    return result
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=None, sparse_as_dense=True,
+                         backward_passes_per_step=1, op=Average,
+                         gradient_predivide_factor=1.0,
+                         average_aggregated_gradients=True,
+                         num_groups=0, groups=None,
+                         process_set=global_process_set):
+    """Wrap a tf.keras optimizer so apply_gradients() averages gradients
+    across ranks first, with optional local aggregation over
+    ``backward_passes_per_step`` (reference:
+    horovod/tensorflow/__init__.py:627)."""
+    cls = type(optimizer)
+    log = get_logger()
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+
+        def __init__(self):  # pragma: no cover — state is copied below
+            pass
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = [g for g, _ in gv]
+            tvars = [v for _, v in gv]
+            self._hvd_counter += 1
+            if backward_passes_per_step > 1:
+                if self._hvd_agg is None:
+                    self._hvd_agg = [None] * len(grads)
+                for i, g in enumerate(grads):
+                    if g is None:
+                        continue
+                    self._hvd_agg[i] = g if self._hvd_agg[i] is None \
+                        else self._hvd_agg[i] + g
+                if self._hvd_counter % backward_passes_per_step != 0:
+                    return None
+                grads = self._hvd_agg
+                self._hvd_agg = None
+                if average_aggregated_gradients:
+                    grads = [None if g is None
+                             else g / backward_passes_per_step
+                             for g in grads]
+            if _spmd():
+                grads = _reduce_grads(grads, op, process_set,
+                                      gradient_predivide_factor)
+            return cls.apply_gradients(self, list(zip(grads, tvars)),
+                                       *args, **kwargs)
+
+    # Rebrand the instance in place (the reference builds a dynamic
+    # subclass the same way, horovod/_keras/__init__.py:36).
+    opt = optimizer
+    opt.__class__ = _Distributed
+    opt._hvd_counter = 0
+    opt._hvd_agg = None
+    if _spmd():
+        log.info("tensorflow DistributedOptimizer wrapping %s over %d "
+                 "ranks", cls.__name__, size())
+    return opt

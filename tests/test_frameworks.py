@@ -1,0 +1,31 @@
+"""Framework-binding tests run under the launcher at np=2 (the
+reference's CI pattern: every parallel framework suite under horovodrun,
+.buildkite/gen-pipeline.sh:231)."""
+
+import os
+
+import pytest
+
+from test_spmd import launch
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _run(worker, extra_env=None, timeout=420):
+    codes, outs = launch(2, script=os.path.join(HERE, worker),
+                         extra_env=extra_env or {}, timeout=timeout)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+    return outs
+
+
+def test_tensorflow_binding():
+    pytest.importorskip("tensorflow")
+    outs = _run("tf_worker.py")
+    assert all("TF-BINDING OK" in o for o in outs)
+
+
+def test_keras_binding_torch_backend():
+    pytest.importorskip("keras")
+    outs = _run("keras_worker.py", {"KERAS_BACKEND": "torch"})
+    assert all("KERAS-BINDING OK" in o for o in outs)

@@ -1,0 +1,107 @@
+"""TensorFlow-binding worker (one rank under hvdrun / test_spmd.launch).
+
+Mirrors the reference's parallel TF suite shape (reference:
+test/parallel/test_tensorflow.py run at np=2): eager collectives,
+tf.function graph collectives (py_function bridge), broadcast_variables,
+DistributedGradientTape, DistributedOptimizer — asserting rank-locally.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2
+
+    # -- eager collectives -------------------------------------------------
+    x = tf.ones([4], tf.float32) * (r + 1)
+    out = hvd.allreduce(x, op=hvd.Sum, name="ar")
+    np.testing.assert_allclose(out.numpy(), sum(range(1, n + 1)))
+    avg = hvd.allreduce(x, name="avg")
+    np.testing.assert_allclose(avg.numpy(), sum(range(1, n + 1)) / n)
+
+    g = hvd.allgather(tf.fill([r + 1, 2], float(r)), name="ag")
+    assert g.shape == (sum(i + 1 for i in range(n)), 2)
+
+    b = hvd.broadcast(tf.fill([3], float(r)), root_rank=1, name="bc")
+    np.testing.assert_allclose(b.numpy(), 1.0)
+
+    obj = hvd.broadcast_object({"v": r * 10}, root_rank=1)
+    assert obj["v"] == 10
+
+    outs = hvd.grouped_allreduce(
+        [tf.ones([2]) * r, tf.ones([3, 2]) * 2.0 * r], op=hvd.Sum,
+        name="gar")
+    s = sum(range(n))
+    np.testing.assert_allclose(outs[0].numpy(), s)
+    np.testing.assert_allclose(outs[1].numpy(), 2.0 * s)
+
+    # -- collectives inside tf.function (py_function bridge) -------------
+    @tf.function
+    def graph_reduce(t):
+        return hvd.allreduce(t, op=hvd.Sum, name="graph_ar")
+
+    gout = graph_reduce(tf.ones([5], tf.float32) * (r + 1))
+    np.testing.assert_allclose(gout.numpy(), sum(range(1, n + 1)))
+
+    # -- broadcast_variables ----------------------------------------------
+    v = tf.Variable(tf.fill([4], float(r + 7)))
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), 7.0)
+
+    # -- DistributedGradientTape training (linear regression) -------------
+    rng = np.random.RandomState(1234)      # shared truth
+    w_true = rng.randn(4, 1).astype(np.float32)
+    shard_rng = np.random.RandomState(100 + r)   # per-rank shard
+    X = shard_rng.randn(64, 4).astype(np.float32)
+    y = X @ w_true
+
+    init_rng = np.random.RandomState(r)    # deliberately divergent init
+    W = tf.Variable(init_rng.randn(4, 1).astype(np.float32))
+    hvd.broadcast_variables([W], root_rank=0)
+
+    losses = []
+    for _ in range(40):
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_mean(tf.square(tf.matmul(X, W) - y))
+        (grad,) = tape.gradient(loss, [W])
+        W.assign_sub(0.1 * grad)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+    # Weights identical across ranks (averaged grads + same init).
+    from horovod_tpu.functions import allgather_object
+    all_w = allgather_object(W.numpy())
+    for w in all_w[1:]:
+        np.testing.assert_allclose(w, all_w[0], rtol=1e-5)
+
+    # -- DistributedOptimizer ----------------------------------------------
+    W2 = tf.Variable(init_rng.randn(4, 1).astype(np.float32))
+    hvd.broadcast_variables([W2], root_rank=0)
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+    for _ in range(30):
+        with tf.GradientTape() as tape:
+            loss2 = tf.reduce_mean(tf.square(tf.matmul(X, W2) - y))
+        grads = tape.gradient(loss2, [W2])
+        opt.apply_gradients(zip(grads, [W2]))
+    assert float(loss2) < losses[0]
+    all_w2 = allgather_object(W2.numpy())
+    for w in all_w2[1:]:
+        np.testing.assert_allclose(w, all_w2[0], rtol=1e-5)
+
+    print(f"rank {r}/{n}: TF-BINDING OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
