@@ -1,0 +1,61 @@
+"""PyTorch MNIST-style training with horovod_tpu (reference:
+examples/pytorch/pytorch_mnist.py — same structure, synthetic
+MNIST-shaped data since this environment has no dataset egress).
+
+Run:  hvdrun -np 2 python examples/pytorch_mnist.py
+"""
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 16, 3)
+        self.fc1 = torch.nn.Linear(16 * 13 * 13, 64)
+        self.fc2 = torch.nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+        x = torch.flatten(x, 1)
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def main():
+    hvd.init()
+    torch.manual_seed(42)
+
+    rng = np.random.RandomState(42 + hvd.rank())
+    x = torch.from_numpy(rng.rand(512, 1, 28, 28).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, size=(512,)))
+
+    model = Net()
+    # Scale LR by world size (reference pattern).
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=0.01 * hvd.size(), momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    model.train()
+    for epoch in range(2):
+        for i in range(0, len(x), 64):
+            bx, by = x[i:i + 64], y[i:i + 64]
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(bx), by)
+            loss.backward()
+            optimizer.step()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}")
+
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

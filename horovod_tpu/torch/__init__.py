@@ -1,0 +1,436 @@
+"""PyTorch binding: the reference's handle-based async API + grad-hook
+optimizer (reference: horovod/torch/mpi_ops.py:107-976,
+horovod/torch/optimizer.py:36-275) on the horovod_tpu runtime.
+
+Process-level semantics (one process per accelerator under ``hvdrun``).
+Async ops return a handle immediately; ``synchronize(handle)`` blocks and
+writes the result back (in-place for ``*_`` variants) — the same contract
+as the reference's pybind handle manager (mpi_ops_v2.cc:624). bfloat16
+tensors ride the wire as float32 (numpy has no native bf16) and are cast
+back on completion; results always come back in the input tensor's dtype.
+Caveat: the compiled data plane runs with JAX x64 disabled, so int64
+values beyond 2^31 and float64 precision are not preserved end to end.
+"""
+
+import numpy as np
+
+from .. import basics
+from ..ops import collectives as _c
+from ..ops import reduce_ops
+from ..process_sets import global_process_set
+
+Average = reduce_ops.Average
+Sum = reduce_ops.Sum
+Adasum = reduce_ops.Adasum
+Min = reduce_ops.Min
+Max = reduce_ops.Max
+Product = reduce_ops.Product
+
+init = basics.init
+shutdown = basics.shutdown
+is_initialized = basics.is_initialized
+local_rank = basics.local_rank
+local_size = basics.local_size
+cross_rank = basics.cross_rank
+cross_size = basics.cross_size
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def rank():
+    """Process-level rank — deliberately NOT basics.rank()-aliased: in
+    single-controller mode basics.size() counts virtual devices, while
+    this binding's world is launcher processes."""
+    return basics.runtime().topology.rank
+
+
+def size():
+    return basics.runtime().topology.size
+
+
+def _spmd():
+    rt = basics.runtime()
+    return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
+
+
+def _to_np(t):
+    torch = _torch()
+    t = t.detach()
+    if t.dtype == torch.bfloat16:
+        return t.float().cpu().numpy(), torch.bfloat16
+    return t.cpu().numpy(), None
+
+
+def _from_np(arr, like, bf16):
+    torch = _torch()
+    arr = np.ascontiguousarray(arr)
+    if not arr.flags.writeable:
+        # np.asarray(jax_array) is a read-only zero-copy view of the JAX
+        # buffer; torch must not alias it (in-place user ops would write
+        # into backend-owned memory).
+        arr = arr.copy()
+    out = torch.from_numpy(arr)
+    if like is not None:
+        # Restore the input dtype: the data plane may have narrowed
+        # (int64->int32, float64->float32 under JAX x64-off).
+        out = out.to(dtype=like.dtype, device=like.device)
+    elif bf16 is not None:
+        out = out.to(bf16)
+    return out
+
+
+class _Handle:
+    """Torch-side async handle: wraps the framework handle plus the
+    write-back target (reference: handle_manager in mpi_ops_v2.cc)."""
+
+    __slots__ = ("inner", "target", "inplace", "bf16", "done", "result")
+
+    def __init__(self, inner, target, inplace, bf16):
+        self.inner = inner
+        self.target = target
+        self.inplace = inplace
+        self.bf16 = bf16
+        self.done = False
+        self.result = None
+
+
+def _local_handle(value):
+    h = _Handle(None, None, False, None)
+    h.done = True
+    h.result = value
+    return h
+
+
+def synchronize(handle):
+    """Block until the handle's op completes; returns the result tensor
+    (reference: horovod/torch/mpi_ops.py synchronize)."""
+    if handle.done:
+        return handle.result
+    out = _c.synchronize(handle.inner)
+    if isinstance(out, tuple):  # alltoall with splits
+        torch = _torch()
+        result = (_from_np(np.asarray(out[0]), handle.target, handle.bf16),
+                  torch.from_numpy(np.asarray(out[1])))
+    else:
+        result = _from_np(np.asarray(out), handle.target, handle.bf16)
+        if handle.inplace and handle.target is not None:
+            handle.target.copy_(result)
+            result = handle.target
+    handle.done = True
+    handle.result = result
+    return result
+
+
+def poll(handle):
+    if handle.done:
+        return True
+    return _c.poll(handle.inner)
+
+
+def _allreduce_async_impl(tensor, op, name, prescale, postscale,
+                          process_set, inplace):
+    if op is None:
+        op = Average
+    if not _spmd():
+        scale = (prescale or 1.0) * (postscale or 1.0)
+        out = tensor * scale if scale != 1.0 else tensor
+        if inplace and out is not tensor:
+            tensor.copy_(out)
+            out = tensor
+        return _local_handle(out)
+    arr, bf16 = _to_np(tensor)
+    inner = _c.allreduce_async(arr, op=op, name=name,
+                               prescale_factor=prescale or 1.0,
+                               postscale_factor=postscale or 1.0,
+                               process_set=process_set)
+    return _Handle(inner, tensor, inplace, bf16)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=global_process_set):
+    if op is None:
+        op = Sum if average is False else Average
+    return _allreduce_async_impl(tensor, op, name, prescale_factor,
+                                 postscale_factor, process_set, False)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=global_process_set):
+    if op is None:
+        op = Sum if average is False else Average
+    return _allreduce_async_impl(tensor, op, name, prescale_factor,
+                                 postscale_factor, process_set, True)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    return synchronize(allreduce_async(tensor, average, name, op,
+                                       prescale_factor, postscale_factor,
+                                       process_set))
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=global_process_set):
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        prescale_factor, postscale_factor,
+                                        process_set))
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      process_set=global_process_set):
+    if op is None:
+        op = Sum if average is False else Average
+    if not _spmd():
+        return list(tensors)
+    arrs, bf16s = zip(*[_to_np(t) for t in tensors])
+    outs = _c.grouped_allreduce(list(arrs), op=op, name=name,
+                                process_set=process_set)
+    return [_from_np(np.asarray(o), t, b)
+            for o, t, b in zip(outs, tensors, bf16s)]
+
+
+def allgather_async(tensor, name=None, process_set=global_process_set):
+    if not _spmd():
+        return _local_handle(tensor)
+    arr, bf16 = _to_np(tensor)
+    return _Handle(_c.allgather_async(arr, name=name,
+                                      process_set=process_set),
+                   tensor, False, bf16)
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=global_process_set):
+    if not _spmd():
+        return _local_handle(tensor)
+    arr, bf16 = _to_np(tensor)
+    return _Handle(_c.broadcast_async(arr, root_rank, name=name,
+                                      process_set=process_set),
+                   tensor, False, bf16)
+
+
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=global_process_set):
+    if not _spmd():
+        return _local_handle(tensor)
+    arr, bf16 = _to_np(tensor)
+    return _Handle(_c.broadcast_async(arr, root_rank, name=name,
+                                      process_set=process_set),
+                   tensor, True, bf16)
+
+
+def broadcast(tensor, root_rank, name=None,
+              process_set=global_process_set):
+    return synchronize(broadcast_async(tensor, root_rank, name,
+                                       process_set))
+
+
+def broadcast_(tensor, root_rank, name=None,
+               process_set=global_process_set):
+    return synchronize(broadcast_async_(tensor, root_rank, name,
+                                        process_set))
+
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=global_process_set):
+    torch = _torch()
+    if not _spmd():
+        if splits is None:
+            return _local_handle(tensor)
+        return _local_handle((tensor, torch.as_tensor(
+            np.asarray(splits, np.int32))))
+    arr, bf16 = _to_np(tensor)
+    np_splits = None if splits is None else np.asarray(
+        splits.cpu() if hasattr(splits, "cpu") else splits, np.int32)
+    h = _Handle(_c.alltoall_async(arr, np_splits, name=name,
+                                  process_set=process_set),
+                tensor, False, bf16)
+    return h
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    out = synchronize(alltoall_async(tensor, splits, name, process_set))
+    if splits is None and isinstance(out, tuple):
+        return out[0]
+    return out
+
+
+def reducescatter(tensor, op=None, name=None,
+                  process_set=global_process_set):
+    if not _spmd():
+        return tensor
+    arr, bf16 = _to_np(tensor)
+    out = _c.reducescatter(arr, op=op or Average, name=name,
+                           process_set=process_set)
+    return _from_np(np.asarray(out), tensor, bf16)
+
+
+def join(device=-1):
+    if not _spmd():
+        return -1
+    return _c.join(device)
+
+
+def barrier(process_set=global_process_set):
+    if not _spmd():
+        return
+    return _c.barrier(process_set=process_set)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    from ..functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj, name=None):
+    from ..functions import allgather_object as _ao
+    return _ao(obj, name=name)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast a state_dict or named_parameters iterable from root_rank
+    (reference: horovod/torch/functions.py broadcast_parameters)."""
+    if not _spmd():
+        return
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    tensors = [t for _, t in items if hasattr(t, "copy_")]
+    arrs = []
+    bf16s = []
+    for t in tensors:
+        a, b = _to_np(t)
+        arrs.append(a)
+        bf16s.append(b)
+    from ..functions import broadcast_variables as _bv
+    outs = _bv(arrs, root_rank=root_rank)
+    for t, o, b in zip(tensors, outs, bf16s):
+        t.copy_(_from_np(np.asarray(o), t, b))
+    # Non-tensor entries ride the object path, keyed by name.
+    other = {n: v for n, v in items if not hasattr(v, "copy_")}
+    if other:
+        synced = broadcast_object(other, root_rank=root_rank,
+                                  name="broadcast_parameters.obj")
+        if hasattr(params, "items"):
+            for n, v in synced.items():
+                params[n] = v
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast the optimizer state dict from root_rank (reference:
+    horovod/torch/functions.py broadcast_optimizer_state). The whole
+    state dict rides the serialized-object path — simple and correct for
+    the once-at-startup call; per-tensor fused broadcast is what
+    broadcast_parameters does for the (hot) model weights."""
+    if not _spmd():
+        return
+    state = optimizer.state_dict()
+    synced = broadcast_object(state, root_rank=root_rank,
+                              name="broadcast_optimizer_state")
+    optimizer.load_state_dict(synced)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=None, backward_passes_per_step=1,
+                         op=Average, gradient_predivide_factor=1.0,
+                         num_groups=0, groups=None, sparse_as_dense=False,
+                         process_set=global_process_set):
+    """Grad-hook optimizer wrapper (reference:
+    horovod/torch/optimizer.py:36-275): each parameter's
+    post-accumulate-grad hook fires an async allreduce; ``step()``
+    synchronizes every outstanding handle, writes the averaged gradients
+    back, then runs the inner optimizer."""
+    if getattr(optimizer, "_hvd_wrapped", False):
+        raise ValueError(
+            "optimizer is already wrapped by DistributedOptimizer; "
+            "wrapping twice would allreduce every gradient twice")
+    cls = type(optimizer)
+
+    if named_parameters is not None:
+        named = list(named_parameters)
+    else:
+        named = []
+        for gi, group in enumerate(optimizer.param_groups):
+            for pi, p in enumerate(group["params"]):
+                named.append((f"param.{gi}.{pi}", p))
+    name_of = {p: n for n, p in named}
+    covered = set(name_of)
+    for gi, group in enumerate(optimizer.param_groups):
+        for p in group["params"]:
+            if p.requires_grad and p not in covered:
+                raise ValueError(
+                    "named_parameters does not cover all optimizer "
+                    "parameters (reference raises the same; pass "
+                    "model.named_parameters() for the FULL model behind "
+                    "this optimizer)")
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+
+        def _hvd_hook(self, p):
+            def hook(param):
+                if self._hvd_sync_disabled:
+                    return
+                self._hvd_counters[param] = \
+                    self._hvd_counters.get(param, 0) + 1
+                if self._hvd_counters[param] % backward_passes_per_step:
+                    return
+                grad = param.grad
+                if grad is None:
+                    return
+                if grad.is_sparse:
+                    grad = grad.to_dense()
+                    param.grad = grad
+                pre = 1.0
+                post = 1.0
+                if gradient_predivide_factor != 1.0:
+                    pre = 1.0 / gradient_predivide_factor
+                    post = gradient_predivide_factor
+                if backward_passes_per_step > 1:
+                    post /= backward_passes_per_step
+                self._hvd_handles[param] = allreduce_async_(
+                    grad, op=op, name=f"grad.{name_of[param]}",
+                    prescale_factor=pre, postscale_factor=post,
+                    process_set=process_set)
+            return hook
+
+        def synchronize(self):
+            # (module-level synchronize is shadowed by this method name)
+            for handle in list(self._hvd_handles.values()):
+                _module_synchronize(handle)
+            self._hvd_handles.clear()
+            self._hvd_synchronized = True
+
+        def step(self, closure=None):
+            if _spmd():
+                self.synchronize()
+            self._hvd_synchronized = False
+            return cls.step(self, closure)
+
+    _module_synchronize = synchronize
+
+    optimizer.__class__ = _Distributed
+    optimizer._hvd_handles = {}
+    optimizer._hvd_counters = {}
+    optimizer._hvd_sync_disabled = not _spmd()
+    optimizer._hvd_synchronized = False
+    optimizer._hvd_hook_handles = []
+    if _spmd():
+        for _, p in named:
+            if p.requires_grad:
+                optimizer._hvd_hook_handles.append(
+                    p.register_post_accumulate_grad_hook(
+                        optimizer._hvd_hook(p)))
+    return optimizer

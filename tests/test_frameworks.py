@@ -29,3 +29,9 @@ def test_keras_binding_torch_backend():
     pytest.importorskip("keras")
     outs = _run("keras_worker.py", {"KERAS_BACKEND": "torch"})
     assert all("KERAS-BINDING OK" in o for o in outs)
+
+
+def test_torch_binding():
+    pytest.importorskip("torch")
+    outs = _run("torch_worker.py")
+    assert all("TORCH-BINDING OK" in o for o in outs)
