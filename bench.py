@@ -1,14 +1,16 @@
-"""Headline benchmark: ResNet-50 synthetic training throughput.
+"""Headline benchmarks: ResNet-50 img/s + transformer-LM samples/s.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per metric; the LAST line is the headline
+(ResNet-50, kept metric-compatible with round 1). See docs/PERF.md for
+the measured batch sweeps and the MFU ceiling analysis.
 
 Baseline derivation: the reference publishes one absolute throughput —
 ResNet-101 at 1656.82 total img/s on 16 Pascal P100s (reference:
 docs/benchmarks.rst:35-46), i.e. ~103.6 img/s per accelerator.
-``vs_baseline`` is our per-chip ResNet-50 img/s divided by that per-GPU
-figure (ResNet-50 is the lighter model of the family, so this flatters the
-comparison slightly; it is the only published absolute number to anchor on —
-BASELINE.md).
+``vs_baseline`` for ResNet is our per-chip img/s over that per-GPU figure.
+The reference publishes NO absolute transformer number, so the transformer
+line reports model FLOPs utilization (MFU vs the chip's bf16 peak) as
+``vs_baseline`` — the honest scale-free anchor.
 """
 
 import json
@@ -16,29 +18,21 @@ import sys
 import timeit
 
 BASELINE_PER_ACCEL = 1656.82 / 16.0
+V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
 
 
-def main():
-    import os
-
+def _bench_resnet(hvd, hvd_jax, on_tpu):
     import jax
-    # Honor an explicit platform request even when a site plugin (axon)
-    # force-selects itself.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
     import numpy as np
     import optax
 
-    sys.path.insert(0, "/root/repo")
-    import horovod_tpu as hvd
-    import horovod_tpu.jax as hvd_jax
     from horovod_tpu.models import ResNet50
 
-    hvd.init()
     n = hvd.size()
-    on_tpu = jax.default_backend() == "tpu"
-    per_replica = 64 if on_tpu else 2
+    # Batch 256 is the measured throughput peak on v5e (docs/PERF.md:
+    # 64->1482, 128->1977, 256->2149, 512->1102 img/s).
+    per_replica = 256 if on_tpu else 2
     image = 224 if on_tpu else 64
     global_batch = n * per_replica
 
@@ -47,7 +41,6 @@ def main():
                            jnp.zeros((1, image, image, 3)))
     params = variables["params"]
     aux = {k: v for k, v in variables.items() if k != "params"}
-
     opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
 
     def loss_fn(p, aux_state, batch):
@@ -62,10 +55,11 @@ def main():
     opt_state = opt.init(params)
 
     rng = np.random.RandomState(0)
+    # bf16 device-resident input: no per-step host transfer, no fp32
+    # upcast on the wire.
     data = jnp.asarray(rng.uniform(size=(global_batch, image, image, 3)),
-                       dtype=jnp.float32)
+                       dtype=jnp.bfloat16)
     target = jnp.asarray(rng.randint(0, 1000, size=(global_batch,)))
-
     state = [params, aux, opt_state]
 
     chain = 5 if on_tpu else 1
@@ -85,15 +79,106 @@ def main():
     iters = 4 if on_tpu else 2
     timeit.timeit(run_block, number=warmup)
     t = timeit.timeit(run_block, number=iters)
-    img_per_sec = global_batch * chain * iters / t
-    per_chip = img_per_sec / n
-
-    print(json.dumps({
+    per_chip = global_batch * chain * iters / t / n
+    return {
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_ACCEL, 3),
-    }))
+    }
+
+
+def _bench_transformer(hvd, hvd_jax, on_tpu):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import TransformerLM, TransformerConfig
+
+    n = hvd.size()
+    seq = 512 if on_tpu else 64
+    batch = (16 if on_tpu else 2) * n
+    # BERT-large dimensions as a causal decoder LM (the reference's BERT
+    # target, BASELINE.md): 365M params. einsum attention wins at seq 512
+    # (XLA's fused softmax-attention); the pallas flash kernel is the
+    # long-context path — at seq 2048 einsum OOMs 27G>15.75G HBM while
+    # flash runs (docs/PERF.md).
+    if on_tpu:
+        cfg = TransformerConfig(vocab_size=30522, hidden=1024, layers=24,
+                                heads=16, max_len=seq, causal=True,
+                                use_rope=True, attention_impl="einsum")
+    else:
+        cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2,
+                                heads=4, max_len=seq, causal=True,
+                                use_rope=True)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, seq), jnp.int32))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    opt = hvd_jax.DistributedOptimizer(optax.adamw(1e-4))
+
+    def loss_fn(p, b):
+        x, y = b
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    step = hvd_jax.make_train_step(loss_fn, opt)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(batch, seq)))
+    target = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(batch, seq)))
+    state = [params, opt_state]
+
+    chain = 5 if on_tpu else 1
+
+    def run_block():
+        loss = None
+        for _ in range(chain):
+            state[0], state[1], loss = step(state[0], state[1],
+                                            (data, target))
+        float(loss)
+
+    warmup = 2 if on_tpu else 1
+    iters = 4 if on_tpu else 2
+    timeit.timeit(run_block, number=warmup)
+    t = timeit.timeit(run_block, number=iters)
+    per_chip = batch * chain * iters / t / n
+    tok_s = per_chip * seq
+    # 6N per token (fwd+bwd matmuls) + attention's 12*L*s*h quadratic term.
+    flops_per_tok = 6 * n_params + 12 * cfg.layers * seq * cfg.hidden
+    mfu = tok_s * flops_per_tok / V5E_BF16_PEAK
+    return {
+        "metric": "transformer_lm_365m_seq512_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/s/chip",
+        # No published reference absolute exists for transformers; report
+        # MFU against the v5e bf16 peak instead (module docstring).
+        "vs_baseline": round(mfu, 3),
+    }
+
+
+def main():
+    import os
+
+    import jax
+    # Honor an explicit platform request even when a site plugin (axon)
+    # force-selects itself.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    sys.path.insert(0, "/root/repo")
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+
+    hvd.init()
+    on_tpu = jax.default_backend() == "tpu"
+
+    print(json.dumps(_bench_transformer(hvd, hvd_jax, on_tpu)), flush=True)
+    # Headline last (the driver records the final line); metric name kept
+    # compatible with round 1 for cross-round comparison.
+    print(json.dumps(_bench_resnet(hvd, hvd_jax, on_tpu)), flush=True)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,7 @@ class TransformerConfig:
     remat: bool = False
     causal: bool = True
     use_rope: bool = True          # decoder LM; BERT uses learned positions
+    attention_impl: str = "einsum"  # 'einsum' | 'flash' (pallas kernel)
 
 
 # BERT-large hyperparameters (the reference benchmark target).
@@ -75,17 +76,30 @@ class Attention(nn.Module):
             q, k = _rope(q, k)
             q = q.swapaxes(1, 2)
             k = k.swapaxes(1, 2)
-        scale = 1.0 / np.sqrt(head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        seq = x.shape[1]
-        if cfg.causal:
-            causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
-            logits = jnp.where(causal[None, None], logits, -1e30)
-        if mask is not None:
-            logits = jnp.where(mask[:, None, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        probs = probs.astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.attention_impl == "flash":
+            # Pallas kernel path (ops/flash_attention.py): BHSD layout,
+            # causal handled in-kernel. Per-sample padding masks need the
+            # einsum path (the kernel's kv_len is per-call, not per-row).
+            if mask is not None:
+                raise ValueError(
+                    "attention_impl='flash' does not support padding "
+                    "masks; use 'einsum'")
+            from ..ops.flash_attention import flash_attention
+            out = flash_attention(
+                q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+                causal=cfg.causal).swapaxes(1, 2)
+        else:
+            scale = 1.0 / np.sqrt(head_dim)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            seq = x.shape[1]
+            if cfg.causal:
+                causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+                logits = jnp.where(causal[None, None], logits, -1e30)
+            if mask is not None:
+                logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            probs = probs.astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return nn.DenseGeneral(cfg.hidden, axis=(-2, -1), dtype=cfg.dtype,
                                name="proj")(out)
 

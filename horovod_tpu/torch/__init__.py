@@ -86,15 +86,17 @@ class _Handle:
     """Torch-side async handle: wraps the framework handle plus the
     write-back target (reference: handle_manager in mpi_ops_v2.cc)."""
 
-    __slots__ = ("inner", "target", "inplace", "bf16", "done", "result")
+    __slots__ = ("inner", "target", "inplace", "bf16", "done", "result",
+                 "want_splits")
 
-    def __init__(self, inner, target, inplace, bf16):
+    def __init__(self, inner, target, inplace, bf16, want_splits=False):
         self.inner = inner
         self.target = target
         self.inplace = inplace
         self.bf16 = bf16
         self.done = False
         self.result = None
+        self.want_splits = want_splits
 
 
 def _local_handle(value):
@@ -110,10 +112,15 @@ def synchronize(handle):
     if handle.done:
         return handle.result
     out = _c.synchronize(handle.inner)
-    if isinstance(out, tuple):  # alltoall with splits
-        torch = _torch()
-        result = (_from_np(np.asarray(out[0]), handle.target, handle.bf16),
-                  torch.from_numpy(np.asarray(out[1])))
+    if isinstance(out, tuple):  # alltoall resolves to (out, recv_splits)
+        data = _from_np(np.asarray(out[0]), handle.target, handle.bf16)
+        if handle.want_splits:
+            # _from_np(copy) on splits too: np.asarray of a jax array is a
+            # read-only view torch must not alias.
+            result = (data, _from_np(np.asarray(out[1], np.int32),
+                                     None, None))
+        else:
+            result = data
     else:
         result = _from_np(np.asarray(out), handle.target, handle.bf16)
         if handle.inplace and handle.target is not None:
@@ -252,18 +259,14 @@ def alltoall_async(tensor, splits=None, name=None,
     arr, bf16 = _to_np(tensor)
     np_splits = None if splits is None else np.asarray(
         splits.cpu() if hasattr(splits, "cpu") else splits, np.int32)
-    h = _Handle(_c.alltoall_async(arr, np_splits, name=name,
-                                  process_set=process_set),
-                tensor, False, bf16)
-    return h
+    return _Handle(_c.alltoall_async(arr, np_splits, name=name,
+                                     process_set=process_set),
+                   tensor, False, bf16, want_splits=splits is not None)
 
 
 def alltoall(tensor, splits=None, name=None,
              process_set=global_process_set):
-    out = synchronize(alltoall_async(tensor, splits, name, process_set))
-    if splits is None and isinstance(out, tuple):
-        return out[0]
-    return out
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
 
 
 def reducescatter(tensor, op=None, name=None,
@@ -352,6 +355,13 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     post-accumulate-grad hook fires an async allreduce; ``step()``
     synchronizes every outstanding handle, writes the averaged gradients
     back, then runs the inner optimizer."""
+    if compression is not None:
+        from ..ops.compression import Compression
+        if compression is not Compression.none:
+            raise NotImplementedError(
+                "gradient compression is not yet wired into the torch "
+                "binding; pass compression=None (the JAX binding supports "
+                "Compression.fp16/bf16)")
     if getattr(optimizer, "_hvd_wrapped", False):
         raise ValueError(
             "optimizer is already wrapped by DistributedOptimizer; "
@@ -428,8 +438,14 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     optimizer._hvd_synchronized = False
     optimizer._hvd_hook_handles = []
     if _spmd():
+        owned = {p for group in optimizer.param_groups
+                 for p in group["params"]}
         for _, p in named:
-            if p.requires_grad:
+            # Only optimizer-owned params get hooks: named_parameters may
+            # legitimately cover the full model while the optimizer trains
+            # a subset (fine-tuning) — syncing frozen-into-other-optimizers
+            # grads here would be wasted collectives.
+            if p.requires_grad and p in owned:
                 optimizer._hvd_hook_handles.append(
                     p.register_post_accumulate_grad_hook(
                         optimizer._hvd_hook(p)))

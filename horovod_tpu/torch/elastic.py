@@ -35,7 +35,12 @@ class TorchState(State):
         self.save()
 
     def _scalar_state(self):
-        return {k: getattr(self, k) for k in self._scalars}
+        """Every public non-handler attribute — including ones set after
+        construction — so `state.best_loss = x` participates in
+        commit/restore/sync like the reference's ObjectState."""
+        skip = set(self._handlers) | {"sampler"}
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_") and k not in skip}
 
     def save(self):
         self._saved = {

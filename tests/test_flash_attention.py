@@ -114,3 +114,23 @@ def test_lse_cotangent_flows_through_kernel_vjp():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-4, rtol=5e-4)
+
+
+def test_transformer_attention_impl_parity():
+    """TransformerLM(attention_impl='flash') matches the einsum path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models import TransformerLM, TransformerConfig
+
+    kw = dict(vocab_size=128, hidden=64, layers=2, heads=2, max_len=32,
+              causal=True, use_rope=True, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, size=(2, 32)))
+    m_e = TransformerLM(TransformerConfig(**kw, attention_impl="einsum"))
+    m_f = TransformerLM(TransformerConfig(**kw, attention_impl="flash"))
+    params = m_e.init(jax.random.PRNGKey(0), tokens)
+    out_e = m_e.apply(params, tokens)
+    out_f = m_f.apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_f),
+                               rtol=2e-3, atol=2e-3)
