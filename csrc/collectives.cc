@@ -108,6 +108,8 @@ void ReduceTyped(T* dst, const T* src, int64_t n, RedOp op) {
     case RedOp::kProd:
       for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<T>(dst[i] * src[i]);
       break;
+    default:
+      break;  // kAdasum never reaches elementwise reduction (VhddAdasum)
   }
 }
 
@@ -240,7 +242,7 @@ void FillTyped(void* buf, int64_t count, T value) {
 }  // namespace
 
 void FillReduceIdentity(void* buf, int64_t count, DataType dtype, RedOp op) {
-  if (op == RedOp::kSum) {
+  if (op == RedOp::kSum || op == RedOp::kAdasum) {
     std::memset(buf, 0, static_cast<size_t>(count) * DataTypeSize(dtype));
     return;
   }
@@ -494,6 +496,205 @@ Status RingReducescatter(Transport* t, const void* sendbuf, void* recvbuf,
   if (incoming.size() != static_cast<size_t>(recv_counts[rank]) * esize)
     return Status::Error(StatusCode::kUnknownError, "reducescatter mismatch");
   std::memcpy(recvbuf, incoming.data(), incoming.size());
+  return Status::OK();
+}
+
+// --- Adasum (VHDD) ---------------------------------------------------------
+// Vector-halving distance-doubling adaptive summation (reference:
+// adasum/adasum.h:194-343). The whole reduction runs in double precision:
+// the convergence-preserving property rests on the dot-product
+// coefficients, and fp16/bf16 partial dots would defeat it.
+
+namespace {
+
+Status ToDoubleVec(const void* buf, int64_t count, DataType dtype,
+                   std::vector<double>* out) {
+  out->resize(static_cast<size_t>(count));
+  switch (dtype) {
+    case DataType::kFloat32: {
+      const float* p = static_cast<const float*>(buf);
+      for (int64_t i = 0; i < count; ++i) (*out)[i] = p[i];
+      return Status::OK();
+    }
+    case DataType::kFloat64:
+      std::memcpy(out->data(), buf, static_cast<size_t>(count) * 8);
+      return Status::OK();
+    case DataType::kFloat16: {
+      const uint16_t* p = static_cast<const uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i) (*out)[i] = HalfToFloat(p[i]);
+      return Status::OK();
+    }
+    case DataType::kBFloat16: {
+      const uint16_t* p = static_cast<const uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i) (*out)[i] = BF16ToFloat(p[i]);
+      return Status::OK();
+    }
+    default:
+      return Status::Error(StatusCode::kInvalidArgument,
+                           "Adasum requires a floating-point dtype");
+  }
+}
+
+void FromDoubleVec(const std::vector<double>& in, void* buf, DataType dtype) {
+  const int64_t count = static_cast<int64_t>(in.size());
+  switch (dtype) {
+    case DataType::kFloat32: {
+      float* p = static_cast<float*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<float>(in[i]);
+      break;
+    }
+    case DataType::kFloat64:
+      std::memcpy(buf, in.data(), static_cast<size_t>(count) * 8);
+      break;
+    case DataType::kFloat16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToHalf(static_cast<float>(in[i]));
+      break;
+    }
+    case DataType::kBFloat16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBF16(static_cast<float>(in[i]));
+      break;
+    }
+    default:
+      break;  // unreachable: ToDoubleVec validated the dtype
+  }
+}
+
+struct AdasumLevel {
+  int partner;
+  int64_t kept_start, kept_count;   // span kept after halving
+  int64_t sent_start, sent_count;   // span handed to the partner
+};
+
+}  // namespace
+
+Status VhddAdasum(Transport* t, void* vbuf, int64_t count, DataType dtype) {
+  const int size = t->size();
+  const int rank = t->rank();
+  if (size == 1) return Status::OK();
+  if ((size & (size - 1)) != 0)
+    return Status::Error(
+        StatusCode::kInvalidArgument,
+        "Adasum requires a power-of-two rank count (reference restriction, "
+        "horovod/tensorflow/__init__.py:138-154); got " +
+            std::to_string(size));
+  if (dtype != DataType::kFloat32 && dtype != DataType::kFloat64 &&
+      dtype != DataType::kFloat16 && dtype != DataType::kBFloat16)
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "Adasum requires a floating-point dtype");
+
+  // Spans travel in the tensor's NATIVE dtype (the reference exchanges
+  // native buffers too — fp64 on the wire would double/quadruple
+  // traffic); only the dot accumulators and the combine run in double.
+  const size_t esize = DataTypeSize(dtype);
+  uint8_t* buf = static_cast<uint8_t*>(vbuf);
+
+  std::vector<AdasumLevel> levels;
+  std::vector<uint8_t> incoming;
+  std::vector<double> mine_d, theirs_d;
+  int64_t start = 0, seg = count;
+  Status st;
+
+  // Forward: halve the vector, double the distance.
+  for (int d = 1; d < size; d <<= 1) {
+    const int partner = rank ^ d;
+    const bool low = rank < partner;  // low keeps the left half
+    const int64_t left = seg - seg / 2;
+    const int64_t right = seg / 2;
+    AdasumLevel lv;
+    lv.partner = partner;
+    if (low) {
+      lv.kept_start = start;
+      lv.kept_count = left;
+      lv.sent_start = start + left;
+      lv.sent_count = right;
+    } else {
+      lv.kept_start = start + left;
+      lv.kept_count = right;
+      lv.sent_start = start;
+      lv.sent_count = left;
+    }
+    st = t->SendRecv(partner, buf + lv.sent_start * esize,
+                     static_cast<size_t>(lv.sent_count) * esize, partner,
+                     &incoming);
+    if (!st.ok()) return st;
+    if (incoming.size() != static_cast<size_t>(lv.kept_count) * esize)
+      return Status::Error(StatusCode::kUnknownError, "adasum size mismatch");
+
+    st = ToDoubleVec(buf + lv.kept_start * esize, lv.kept_count, dtype,
+                     &mine_d);
+    if (!st.ok()) return st;
+    st = ToDoubleVec(incoming.data(), lv.kept_count, dtype, &theirs_d);
+    if (!st.ok()) return st;
+
+    // Partial dot products over the kept span; `a` is always the lower
+    // sub-block's logical vector so every rank applies the same formula.
+    const bool i_hold_a = (rank & d) == 0;
+    double aa = 0, bb = 0, ab = 0;
+    for (int64_t i = 0; i < lv.kept_count; ++i) {
+      const double m = mine_d[i], th = theirs_d[i];
+      ab += m * th;
+      if (i_hold_a) {
+        aa += m * m;
+        bb += th * th;
+      } else {
+        aa += th * th;
+        bb += m * m;
+      }
+    }
+    // Sum the three scalars over the 2d ranks holding pieces of (a, b):
+    // recursive doubling with strides 1..d (reference: the distributed
+    // dot-product reduction inside FusedAllreduce).
+    double dots[3] = {aa, bb, ab};
+    for (int s = 1; s <= d; s <<= 1) {
+      const int p2 = rank ^ s;
+      st = t->SendRecv(p2, dots, sizeof(dots), p2, &incoming);
+      if (!st.ok()) return st;
+      if (incoming.size() != sizeof(dots))
+        return Status::Error(StatusCode::kUnknownError,
+                             "adasum dot exchange mismatch");
+      const double* other = reinterpret_cast<const double*>(incoming.data());
+      dots[0] += other[0];
+      dots[1] += other[1];
+      dots[2] += other[2];
+    }
+    aa = dots[0];
+    bb = dots[1];
+    ab = dots[2];
+
+    // a <- (1 - dot/2|a|^2) a + (1 - dot/2|b|^2) b; a zero-norm operand is
+    // the Adasum identity (joined ranks contribute zeros), coefficient 1
+    // on the other side (reference: adasum.h:397-407 with norm guards).
+    const double acoef = aa > 0.0 ? 1.0 - ab / (2.0 * aa) : 1.0;
+    const double bcoef = bb > 0.0 ? 1.0 - ab / (2.0 * bb) : 1.0;
+    for (int64_t i = 0; i < lv.kept_count; ++i) {
+      const double m = mine_d[i], th = theirs_d[i];
+      mine_d[i] = i_hold_a ? acoef * m + bcoef * th
+                           : acoef * th + bcoef * m;
+    }
+    FromDoubleVec(mine_d, buf + lv.kept_start * esize, dtype);
+
+    levels.push_back(lv);
+    start = lv.kept_start;
+    seg = lv.kept_count;
+  }
+
+  // Reverse: distance-halving allgather reconstructs the full vector.
+  for (auto it = levels.rbegin(); it != levels.rend(); ++it) {
+    st = t->SendRecv(it->partner, buf + it->kept_start * esize,
+                     static_cast<size_t>(it->kept_count) * esize,
+                     it->partner, &incoming);
+    if (!st.ok()) return st;
+    if (incoming.size() != static_cast<size_t>(it->sent_count) * esize)
+      return Status::Error(StatusCode::kUnknownError,
+                           "adasum reconstruct mismatch");
+    std::memcpy(buf + it->sent_start * esize, incoming.data(),
+                incoming.size());
+  }
   return Status::OK();
 }
 

@@ -26,6 +26,12 @@ Status RingAllreduce(Transport* t, void* buf, int64_t count, DataType dtype,
 Status RingAllgatherv(Transport* t, const void* sendbuf, void* recvbuf,
                       const std::vector<int64_t>& counts, DataType dtype);
 
+// In-place Adasum allreduce via vector-halving distance-doubling
+// (reference spec: adasum/adasum.h:194-343 FusedAllreduce + the pairwise
+// rule a <- (1 - dot/2|a|^2) a + (1 - dot/2|b|^2) b at :397-407).
+// Float dtypes only; transport size must be a power of two.
+Status VhddAdasum(Transport* t, void* buf, int64_t count, DataType dtype);
+
 // Binomial-tree broadcast of `count` elements from `root`.
 Status TreeBroadcast(Transport* t, void* buf, int64_t count, DataType dtype,
                      int root);

@@ -80,6 +80,7 @@ enum class RedOp : uint8_t {
   kMin = 1,
   kMax = 2,
   kProd = 3,
+  kAdasum = 4,  // VHDD adaptive summation (collectives.cc VhddAdasum)
 };
 
 enum class StatusCode : uint8_t {

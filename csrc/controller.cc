@@ -256,6 +256,9 @@ Response Controller::ConstructResponse(const std::string& name) {
     case ReqType::kReducescatter: {
       if (first.type == ReqType::kReducescatter && !joined_ranks_.empty())
         return fail("reducescatter cannot run while ranks have joined");
+      if (first.type == ReqType::kReducescatter &&
+          first.op == RedOp::kAdasum)
+        return fail("Adasum is not defined for reducescatter");
       for (const Request& r : entry.requests) {
         if (r.shape != first.shape) bad.push_back(r.rank);
         if (r.op != first.op || r.prescale != first.prescale ||
@@ -374,7 +377,11 @@ ResponseList Controller::FuseResponses(std::vector<Response> responses) {
     if (used[i]) continue;
     Response& r = responses[i];
     used[i] = true;
-    if (r.type == ReqType::kAllreduce && r.error.empty()) {
+    // kAdasum never fuses: the dot-product coefficients are per-tensor
+    // (a concatenated buffer would couple unrelated layers' scale
+    // adaptation and make results depend on fusion timing).
+    if (r.type == ReqType::kAllreduce && r.error.empty() &&
+        r.op != RedOp::kAdasum) {
       int64_t bytes = 0;
       for (int64_t n : r.sizes) bytes += n * DataTypeSize(r.dtype);
       for (size_t j = i + 1; j < responses.size(); ++j) {

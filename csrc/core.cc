@@ -259,6 +259,7 @@ int Core::RunCycle() {
       // and join always run natively.
       bool delegatable =
           opts_.delegate_data_ops && resp.error.empty() &&
+          resp.op != RedOp::kAdasum &&  // VHDD stays on the host plane
           (resp.type == ReqType::kAllreduce ||
            resp.type == ReqType::kAllgather ||
            resp.type == ReqType::kBroadcast ||
@@ -417,8 +418,14 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
       }
       if (!fused && resp.prescale != 1.0)
         ScaleBuffer(buf, total, resp.dtype, resp.prescale);
-      if (timeline_) timeline_->ActivityStart(resp.names[0], "RING_ALLREDUCE");
-      st = RingAllreduce(view, buf, total, resp.dtype, resp.op);
+      if (timeline_)
+        timeline_->ActivityStart(resp.names[0],
+                                 resp.op == RedOp::kAdasum
+                                     ? "VHDD_ADASUM"
+                                     : "RING_ALLREDUCE");
+      st = resp.op == RedOp::kAdasum
+               ? VhddAdasum(view, buf, total, resp.dtype)
+               : RingAllreduce(view, buf, total, resp.dtype, resp.op);
       if (timeline_) timeline_->ActivityEnd(resp.names[0]);
       if (st.ok() && resp.postscale != 1.0)
         ScaleBuffer(buf, total, resp.dtype, resp.postscale);

@@ -134,9 +134,10 @@ class TcpBackend(Backend):
         if op is None or op == reduce_ops.Average:
             return native.RED_SUM, 1.0 / n
         if op == reduce_ops.Adasum:
-            raise HorovodInternalError(
-                "Adasum over the TCP data plane is not implemented; use the "
-                "compiled XLA path (horovod_tpu.jax) for Adasum reductions")
+            # VHDD on the host data plane (csrc/collectives.cc VhddAdasum;
+            # reference spec adasum/adasum.h:194-343). No postscale: the
+            # adasum combination IS the result.
+            return native.RED_ADASUM, 1.0
         try:
             return _OP_TO_RED[op], 1.0
         except KeyError:
@@ -162,11 +163,20 @@ class TcpBackend(Backend):
                                                arrays[0].shape))
             # Grouped allreduce: concat-flatten so the group is one atomic
             # negotiated tensor (reference: group_table.cc semantics — the
-            # group fuses as a unit).
+            # group fuses as a unit). Adasum groups enqueue per-tensor
+            # instead: its dot-product coefficients are per-tensor, and a
+            # concatenated buffer would couple the layers' scale adaptation.
             dtype = arrays[0].dtype
             if any(a.dtype != dtype for a in arrays):
                 raise HorovodInternalError(
                     "grouped allreduce requires uniform dtype per group")
+            if red == native.RED_ADASUM:
+                handles = [self._native_enqueue(
+                    ps, f"{entry.name}.{i}", native.REQ_ALLREDUCE, a,
+                    red_op=red, prescale=pre,
+                    postscale=post * post_extra)
+                    for i, a in enumerate(arrays)]
+                return _Pending(entry, handles, _unpack_list(arrays))
             flat = np.concatenate([a.reshape(-1) for a in arrays])
             h = self._native_enqueue(
                 ps, entry.name, native.REQ_ALLREDUCE, flat, red_op=red,
@@ -209,6 +219,9 @@ class TcpBackend(Backend):
             return _Pending(entry, [h], _unpack_alltoall(a.dtype, self))
 
         if kind == "reducescatter":
+            if entry.op == reduce_ops.Adasum:
+                raise HorovodInternalError(
+                    "Adasum is not defined for reducescatter")
             red, post_extra = self._red_op(entry, n)
             arrays = [np.asarray(a) for a in entry.arrays]
             handles = []

@@ -29,7 +29,7 @@ _lib_lock = threading.Lock()
 # Enum values must match csrc/common.h.
 REQ_ALLREDUCE, REQ_ALLGATHER, REQ_BROADCAST, REQ_ALLTOALL = 0, 1, 2, 3
 REQ_REDUCESCATTER, REQ_BARRIER, REQ_JOIN = 4, 5, 6
-RED_SUM, RED_MIN, RED_MAX, RED_PROD = 0, 1, 2, 3
+RED_SUM, RED_MIN, RED_MAX, RED_PROD, RED_ADASUM = 0, 1, 2, 3, 4
 
 _DTYPE_TO_ENUM = {}
 

@@ -28,19 +28,29 @@ class TorchState(State):
         self._sampler = sampler
         if sampler is not None:
             self.sampler = sampler
-        self._scalars = dict(kwargs)
         for k, v in kwargs.items():
             setattr(self, k, v)
         self._saved = None
         self.save()
 
+    _TRACKED_TYPES = (int, float, bool, str, bytes, list, tuple, dict,
+                      set, type(None))
+
     def _scalar_state(self):
-        """Every public non-handler attribute — including ones set after
-        construction — so `state.best_loss = x` participates in
-        commit/restore/sync like the reference's ObjectState."""
-        skip = set(self._handlers) | {"sampler"}
+        """Public attributes of plain-value types — including ones set
+        after construction, so `state.best_loss = x` participates in
+        commit/restore/sync. Complex objects (SummaryWriter, DataLoader)
+        attached as conveniences are deliberately NOT swept: they are
+        often non-picklable and would crash commit()/sync()."""
+        import numpy as _np
+        import torch as _torch
+        skip = set(self._handlers)
+        if self._sampler is not None:
+            skip.add("sampler")
+        tracked = self._TRACKED_TYPES + (_np.ndarray, _torch.Tensor)
         return {k: v for k, v in self.__dict__.items()
-                if not k.startswith("_") and k not in skip}
+                if not k.startswith("_") and k not in skip
+                and isinstance(v, tracked)}
 
     def save(self):
         self._saved = {

@@ -119,6 +119,51 @@ def main():
     # -- barrier ------------------------------------------------------------
     hvd.barrier()
 
+    # -- Adasum on the host data plane ---------------------------------------
+    # Oracle: VHDD == the pairwise tree a<-(1-dot/2|a|^2)a+(1-dot/2|b|^2)b
+    # (reference: adasum/adasum.h:397-407); power-of-two sizes only.
+    def np_adasum(a, b):
+        dot = float((a * b).sum())
+        na = float((a * a).sum())
+        nb = float((b * b).sum())
+        ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+        bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+        return ac * a + bc * b
+
+    ada_rng = np.random.RandomState(7)
+    ada_vecs = [ada_rng.randn(33).astype(np.float32)
+                for _ in range(size)]
+    if size & (size - 1) == 0:
+        out = hvd.allreduce(jnp.asarray(ada_vecs[rank]), op=hvd.Adasum,
+                            name="ada")
+        expect = ada_vecs
+        while len(expect) > 1:
+            expect = [np_adasum(expect[i], expect[i + 1])
+                      for i in range(0, len(expect), 2)]
+        np.testing.assert_allclose(np.asarray(out), expect[0], rtol=1e-5,
+                                   atol=1e-6)
+        # Grouped adasum reduces PER TENSOR (never concat-fused: the dot
+        # coefficients are per-tensor).
+        gouts = hvd.grouped_allreduce(
+            [jnp.asarray(ada_vecs[rank]), jnp.asarray(ada_vecs[rank] * 3.0)],
+            op=hvd.Adasum, name="gada")
+        for scale, gout in zip((1.0, 3.0), gouts):
+            ge = [v * scale for v in ada_vecs]
+            while len(ge) > 1:
+                ge = [np_adasum(ge[i], ge[i + 1])
+                      for i in range(0, len(ge), 2)]
+            np.testing.assert_allclose(np.asarray(gout), ge[0], rtol=1e-5,
+                                       atol=1e-6)
+    else:
+        # Non-power-of-two must fail with a clear error, not hang.
+        try:
+            hvd.allreduce(jnp.asarray(ada_vecs[rank]), op=hvd.Adasum,
+                          name="ada.bad")
+            raised = False
+        except hvd.HorovodInternalError as e:
+            raised = "power-of-two" in str(e)
+        assert raised, "adasum at non-power-of-two size must error"
+
     # -- duplicate name rejection -------------------------------------------
     h1 = hvd.allreduce_async(jnp.ones(1024, jnp.float32), name="dup")
     try:

@@ -65,7 +65,7 @@ def launch(size, script=WORKER, extra_env=None, timeout=180):
     return codes, outs
 
 
-@pytest.mark.parametrize("size", [2, 3])
+@pytest.mark.parametrize("size", [2, 3, 4])
 def test_spmd_full_api(size):
     codes, outs = launch(size)
     for rank, (code, out) in enumerate(zip(codes, outs)):

@@ -96,6 +96,16 @@ def main():
     np.testing.assert_allclose(np.asarray(h16, dtype=np.float32),
                                sum(r + 1 for r in range(size)))
 
+    # -- Adasum: excluded from delegation, runs native VHDD ---------------
+    ada = np.random.RandomState(7).randn(2, 17).astype(np.float32)
+    a, b = ada[0], ada[1]
+    out_ada = np.asarray(hvd.allreduce(jnp.asarray(ada[rank]),
+                                       op=hvd.Adasum, name="ada"))
+    dot, na, nb = float((a * b).sum()), float((a * a).sum()), \
+        float((b * b).sum())
+    expect_ada = (1 - dot / (2 * na)) * a + (1 - dot / (2 * nb)) * b
+    np.testing.assert_allclose(out_ada, expect_ada, rtol=1e-5, atol=1e-6)
+
     # -- barrier + alltoall still ride the native TCP plane ---------------
     hvd.barrier()
     a = jnp.full((size, 2), float(rank), jnp.float32)
