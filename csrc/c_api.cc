@@ -57,6 +57,11 @@ void* hvd_core_create(int rank, int size, const char* transport,
 
 void hvd_core_destroy(void* h) { delete static_cast<Ctx*>(h); }
 
+// Autotune: apply an agreed fusion threshold at a cycle boundary.
+void hvd_core_set_fusion_threshold(void* h, int64_t bytes) {
+  static_cast<Ctx*>(h)->core->SetFusionThreshold(bytes);
+}
+
 // Rendezvous bootstrap: reserve (bind+listen) an ephemeral port that a
 // later hvd_core_create consumes, closing the publish-then-rebind race.
 int hvd_reserve_listen_port() { return ReserveListenPort(); }

@@ -76,6 +76,11 @@ class Controller {
   int size() const { return transport_->size(); }
   int joined_size() const { return static_cast<int>(joined_ranks_.size()); }
 
+  // Runtime autotune knob (reference: SynchronizeParameters applies the
+  // parameter manager's winners, controller.cc:39-53). Callers must set
+  // the same value on every rank at the same cycle boundary.
+  void set_fusion_threshold(int64_t bytes) { opts_.fusion_threshold = bytes; }
+
  private:
   bool is_coordinator() const { return transport_->rank() == 0; }
 

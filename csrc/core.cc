@@ -203,6 +203,14 @@ int64_t Core::Enqueue(int ps_id, const Request& req, const void* data,
   return handle;
 }
 
+void Core::SetFusionThreshold(int64_t bytes) {
+  std::lock_guard<std::mutex> g(mu_);
+  opts_.controller.fusion_threshold = bytes;  // future process sets
+  for (auto& kv : process_sets_)
+    if (kv.second->controller)
+      kv.second->controller->set_fusion_threshold(bytes);
+}
+
 void Core::CompleteHandle(int64_t handle, HandleState state,
                           const std::string& error) {
   auto it = handles_.find(handle);

@@ -133,6 +133,9 @@ class Core {
   // belongs to. Returns completed-handle count, or -1 after shutdown.
   int RunCycle();
 
+  // Apply an autotuned fusion threshold to every process-set controller.
+  void SetFusionThreshold(int64_t bytes);
+
   void RequestShutdown() { shutdown_requested_.store(true); }
   bool ShutdownComplete() const { return shutdown_complete_.load(); }
 

@@ -3,90 +3,149 @@
 The reference tunes fusion-threshold / cycle-time / cache knobs with
 Gaussian-process Bayesian optimization (reference:
 horovod/common/parameter_manager.cc, optim/bayesian_optimization.cc),
-scoring each candidate by observed bytes/sec and broadcasting winners.
+scoring each candidate by observed bytes/sec and broadcasting winners
+(reference: controller.cc:39-53 SynchronizeParameters).
 
-On TPU the dominant knobs are the same two — fusion threshold and cycle
-time — but the search space is small, so we use a deterministic
-coordinate-descent sweep over a discrete grid (the reference's categorical
-mode, parameter_manager.h:59-78) scored by coordinator bytes/sec. Results
-can be logged to HVDTPU_AUTOTUNE_LOG like the reference's
-HOROVOD_AUTOTUNE_LOG (reference: operations.cc:588-592).
+TPU-native rethink: the dominant knobs are the same two — fusion threshold
+and cycle time — but the search space is small, so a deterministic
+coordinate sweep over a discrete grid replaces the GP (the reference's
+categorical mode, parameter_manager.h:59-78). Candidate changes are driven
+by the CYCLE COUNTER, which is identical on every rank in SPMD mode (each
+negotiation round is collective), so all ranks apply the same candidate at
+the same cycle without any extra message. Only the final winner needs
+cross-rank agreement (scores are timing-noisy): rank 0's choice broadcasts
+over the data plane, the analog of SynchronizeParameters.
 """
 
 import time
+
+import numpy as np
 
 from .utils import envparse
 from .utils.logging_util import get_logger
 
 # Discrete candidate grids (reference sweeps similar ranges).
-FUSION_CANDIDATES = [0, 1, 2, 4, 8, 16, 32, 64, 128]      # MiB
-CYCLE_CANDIDATES = [0.1, 0.5, 1.0, 2.5, 5.0, 10.0]        # ms
-WARMUP_SAMPLES = 3
-SAMPLES_PER_CANDIDATE = 10
+FUSION_CANDIDATES_MIB = [0, 1, 2, 4, 8, 16, 32, 64, 128]
+CYCLE_CANDIDATES_MS = [0.1, 0.5, 1.0, 2.5, 5.0, 10.0]
+WARMUP_CYCLES = 10
+CYCLES_PER_CANDIDATE = 20
+
+
+def _env_list(name, default, conv):
+    raw = envparse.get_str(name, "")
+    if not raw:
+        return default
+    return [conv(x) for x in raw.split(",") if x.strip()]
 
 
 class ParameterManager:
+    """Cycle-driven knob sweep; see module docstring."""
+
     def __init__(self, runtime):
         self.runtime = runtime
         self.enabled = True
         self._log = get_logger()
         self._log_path = envparse.get_str(envparse.AUTOTUNE_LOG, "")
-        self._samples = 0
-        self._warmup_left = WARMUP_SAMPLES
-        self._grid = [(f * 1024 * 1024, c)
-                      for f in FUSION_CANDIDATES for c in CYCLE_CANDIDATES]
-        self._idx = 0
-        self._scores = {}
+        fusion = _env_list("AUTOTUNE_FUSION_CANDIDATES_MIB",
+                           FUSION_CANDIDATES_MIB, float)
+        cycle = _env_list("AUTOTUNE_CYCLE_CANDIDATES_MS",
+                          CYCLE_CANDIDATES_MS, float)
+        self._warmup = envparse.get_int("AUTOTUNE_WARMUP_CYCLES",
+                                        WARMUP_CYCLES)
+        self._per_candidate = envparse.get_int(
+            "AUTOTUNE_CYCLES_PER_CANDIDATE", CYCLES_PER_CANDIDATE)
+        self._grid = [(int(f * 1024 * 1024), c) for f in fusion
+                      for c in cycle]
+        self._cycle = 0
+        self._window = 0            # scored cycles under current candidate
+        self._idx = -1              # -1 = still warming up
+        self._scores = {}           # candidate index -> [bytes/sec]
         self._last_bytes = 0
         self._last_time = time.monotonic()
-        self._best = None
+        self.best = None            # set at convergence
 
+    # -- called once per coordinator cycle --------------------------------
     def record_cycle(self):
-        """Called by the coordinator once per cycle; measures bytes/sec for
-        the active candidate and advances the sweep."""
         if not self.enabled:
             return
         coord = self.runtime.coordinator
         now = time.monotonic()
+        bytes_now = coord.bytes_processed
+        if bytes_now == self._last_bytes:
+            # Idle cycle: don't advance the sweep (the reference scores
+            # traffic, not wall time). Per-cycle executed-byte totals are
+            # the negotiated response sizes — identical on every rank and
+            # recorded on the cycle thread (delegated completions too:
+            # _drain_delegated runs inside the same run_cycle) — so
+            # "active cycle" counting keeps the cross-rank determinism.
+            self._last_time = now
+            return
+        self._cycle += 1
         elapsed = now - self._last_time
-        if elapsed < 0.05:
-            return
-        score = (coord.bytes_processed - self._last_bytes) / elapsed
-        self._last_bytes = coord.bytes_processed
+        score = (bytes_now - self._last_bytes) / max(elapsed, 1e-9)
+        self._last_bytes = bytes_now
         self._last_time = now
-        if self._warmup_left > 0:
-            self._warmup_left -= 1
-            if self._warmup_left == 0:
-                # Start measuring under the first candidate's actual knobs.
-                self._apply(self._grid[0])
-            return
-        self._samples += 1
-        cand = self._grid[self._idx]
-        self._scores.setdefault(cand, []).append(score)
-        if self._samples >= SAMPLES_PER_CANDIDATE:
-            self._samples = 0
-            self._advance()
 
-    def _advance(self):
-        self._idx += 1
-        if self._idx >= len(self._grid):
-            best = max(self._scores,
-                       key=lambda c: sum(self._scores[c]) / len(self._scores[c]))
-            self._apply(best)
-            self._best = best
-            self.enabled = False
-            self._log.info("autotune converged: fusion=%dB cycle=%.2fms",
-                           best[0], best[1])
-            if self._log_path:
-                with open(self._log_path, "a") as f:
-                    for cand, scores in self._scores.items():
-                        f.write(f"{cand[0]},{cand[1]},"
-                                f"{sum(scores)/len(scores):.1f}\n")
+        if self._idx == -1:
+            # Warming up (warmup=0 => candidate 0 applies on the first
+            # active cycle; scoring starts the cycle after it applied).
+            if self._cycle >= self._warmup:
+                self._set_candidate(0)
             return
-        self._apply(self._grid[self._idx])
+        self._scores.setdefault(self._idx, []).append(score)
+        self._window += 1
+        if self._window >= self._per_candidate:
+            nxt = self._idx + 1
+            if nxt >= len(self._grid):
+                self._converge()
+            else:
+                self._set_candidate(nxt)
+
+    def _set_candidate(self, idx):
+        self._idx = idx
+        self._window = 0
+        self._apply(self._grid[idx])
+
+    def _converge(self):
+        """Rank 0's argmax wins and broadcasts over the data plane (the
+        SynchronizeParameters analog); ranks reach here at the same point
+        in their cycle streams because convergence is cycle-count driven."""
+        local_best = max(
+            self._scores,
+            key=lambda i: sum(self._scores[i]) / len(self._scores[i]))
+        rt = self.runtime
+        winner = local_best
+        from . import basics
+        if rt.mode == basics.MODE_SPMD and rt.topology.size > 1:
+            from .process_sets import global_process_set
+            out = rt.backend.broadcast(
+                [np.asarray([local_best], np.int32)], 0,
+                global_process_set)
+            winner = int(np.asarray(out[0])[0])
+        self.best = self._grid[winner]
+        self._apply(self.best)
+        # Last: observers poll `enabled`, so best/knobs must be in place
+        # before the flag flips (the worker thread races this method).
+        self.enabled = False
+        self._log.info("autotune converged: fusion=%dB cycle=%.2fms",
+                       self.best[0], self.best[1])
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                for idx, scores in sorted(self._scores.items()):
+                    cand = self._grid[idx]
+                    marker = "*" if idx == winner else ""
+                    f.write(f"{cand[0]},{cand[1]},"
+                            f"{sum(scores)/len(scores):.1f}{marker}\n")
 
     def _apply(self, cand):
         fusion, cycle_ms = cand
         coord = self.runtime.coordinator
         coord.fusion_threshold = max(fusion, 1)
         coord.cycle_time_s = cycle_ms / 1000.0
+        backend = self.runtime.backend
+        if hasattr(backend, "core"):
+            # Push the threshold into the native controller (reference:
+            # the parameter manager's winners land in the controller's
+            # fusion logic). Deterministic across ranks: candidate changes
+            # are cycle-count driven.
+            backend.core.set_fusion_threshold(max(fusion, 1))

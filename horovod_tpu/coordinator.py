@@ -199,6 +199,10 @@ class Coordinator:
             self.cycles += 1
             self.tensors_processed += backend.run_cycle()
             self.bytes_processed = backend.core.bytes_processed()
+            if self.runtime.autotuner is not None:
+                # Candidate switches are cycle-count driven so every rank
+                # applies the same knob at the same negotiation round.
+                self.runtime.autotuner.record_cycle()
 
     def _run_cycle(self):
         with self._lock:

@@ -132,6 +132,8 @@ def load_library():
         lib.hvd_core_cycles.argtypes = [ctypes.c_void_p]
         lib.hvd_core_bytes_processed.restype = ctypes.c_uint64
         lib.hvd_core_bytes_processed.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_set_fusion_threshold.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
         lib.hvd_core_next_delegated.restype = ctypes.c_int64
         lib.hvd_core_next_delegated.argtypes = [ctypes.c_void_p]
         lib.hvd_core_delegated_info.argtypes = [
@@ -303,6 +305,11 @@ class NativeCore:
         self._lib.hvd_core_release(self._ctx, handle)
 
     # -- stats ------------------------------------------------------------
+    def set_fusion_threshold(self, nbytes):
+        """Apply an autotuned fusion threshold (all ranks must call with
+        the same value at the same cycle boundary)."""
+        self._lib.hvd_core_set_fusion_threshold(self._ctx, int(nbytes))
+
     # -- delegated execution (external XLA data plane) --------------------
     def next_delegated(self):
         """Token of the next negotiated-but-externally-executed response,

@@ -47,7 +47,8 @@ class TorchState(State):
         skip = set(self._handlers)
         if self._sampler is not None:
             skip.add("sampler")
-        tracked = self._TRACKED_TYPES + (_np.ndarray, _torch.Tensor)
+        tracked = self._TRACKED_TYPES + (_np.ndarray, _np.generic,
+                                         _torch.Tensor)
         return {k: v for k, v in self.__dict__.items()
                 if not k.startswith("_") and k not in skip
                 and isinstance(v, tracked)}
