@@ -37,12 +37,14 @@ extern "C" {
 void* hvd_core_create(int rank, int size, const char* transport,
                       const char* peers, int64_t fusion_threshold,
                       int64_t cache_capacity, double stall_warning_s,
-                      const char* timeline_path, int delegate_data_ops) {
+                      const char* timeline_path, int delegate_data_ops,
+                      double stall_shutdown_s) {
   CoreOptions opts;
   if (fusion_threshold > 0) opts.controller.fusion_threshold = fusion_threshold;
   if (cache_capacity > 0)
     opts.controller.cache_capacity = static_cast<size_t>(cache_capacity);
   if (stall_warning_s > 0) opts.controller.stall_warning_s = stall_warning_s;
+  if (stall_shutdown_s > 0) opts.controller.stall_shutdown_s = stall_shutdown_s;
   if (timeline_path) opts.timeline_path = timeline_path;
   opts.delegate_data_ops = delegate_data_ops != 0;
   auto ctx = std::make_unique<Ctx>();

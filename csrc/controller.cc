@@ -355,16 +355,25 @@ void Controller::CheckForStalledTensors() {
   last_stall_check_ = now;
   for (auto& kv : message_table_) {
     double age = now - kv.second.first_seen;
+    // The shutdown threshold stands on its own: a user may set it below
+    // the (default 60s) warning threshold.
+    if (opts_.stall_shutdown_s > 0 && age >= opts_.stall_shutdown_s)
+      stalled_fatal_.insert(kv.first);
     if (age < opts_.stall_warning_s) continue;
-    std::vector<int> missing;
-    for (int r = 0; r < transport_->size(); ++r)
-      if (!kv.second.ranks.count(r) && !joined_ranks_.count(r))
-        missing.push_back(r);
     LogMsg(LogLevel::kWarn, transport_->rank(),
            "Tensor '" + kv.first + "' stalled for " +
                std::to_string(static_cast<int>(age)) +
-               "s; waiting on ranks [" + RanksToString(missing) + "]");
+               "s; waiting on ranks [" +
+               RanksToString(MissingRanks(kv.second)) + "]");
   }
+}
+
+std::vector<int> Controller::MissingRanks(const TableEntry& entry) const {
+  std::vector<int> missing;
+  for (int r = 0; r < transport_->size(); ++r)
+    if (!entry.ranks.count(r) && !joined_ranks_.count(r))
+      missing.push_back(r);
+  return missing;
 }
 
 ResponseList Controller::FuseResponses(std::vector<Response> responses) {
@@ -422,6 +431,19 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
     }
     switch (cache_.Lookup(req)) {
       case ResponseCache::CacheState::kHit: {
+        // A hit that keeps failing cross-rank agreement (some rank has
+        // stopped submitting) is invisible to the stall inspector: it
+        // loops through the requeue path and never reaches the
+        // coordinator's message table. Past the warning threshold,
+        // escalate it to the slow path so stall warning/shutdown apply
+        // to cached steady-state tensors too.
+        const double now_hit = NowSeconds();
+        auto emplaced = hit_pending_since_.try_emplace(req.name, now_hit);
+        if (now_hit - emplaced.first->second >= opts_.stall_warning_s) {
+          hit_pending_since_.erase(emplaced.first);
+          uncached.push_back(std::move(req));
+          break;
+        }
         size_t bit = 0;
         cache_.BitFor(req.name, &bit);
         hit_bits.push_back(bit);
@@ -469,7 +491,11 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
 
   std::vector<size_t> agreed_bits;
   bool any_uncached = false, all_shutdown = false;
-  Status st = CoordinateCache(hit_bits, invalid_bits, !uncached.empty(),
+  // Pending stall-shutdown errors must reach every rank; forcing the slow
+  // path gives the coordinator a response broadcast to carry them.
+  bool has_uncached_local =
+      !uncached.empty() || (is_coordinator() && !stalled_fatal_.empty());
+  Status st = CoordinateCache(hit_bits, invalid_bits, has_uncached_local,
                               request_shutdown, staged, &agreed_bits,
                               &any_uncached, &all_shutdown, &out->agreed_ps);
   if (!st.ok()) return st;
@@ -482,6 +508,7 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
   for (auto& kv : hit_candidates) {
     if (agreed.count(kv.first)) {
       my_agreed.push_back(kv.first);
+      hit_pending_since_.erase(kv.second.name);
     } else if (cache_.Lookup(kv.second) ==
                ResponseCache::CacheState::kMiss) {
       // Invalidated cross-rank during coordination: renegotiate.
@@ -548,6 +575,27 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
         negotiated.responses.push_back(ConstructResponse(name));
       }
       (void)barrier_ready;
+      // Stall shutdown: fail tensors past the threshold with an error
+      // response naming the missing ranks.
+      for (auto it = stalled_fatal_.begin(); it != stalled_fatal_.end();) {
+        auto te = message_table_.find(*it);
+        if (te == message_table_.end()) {  // became ready in the meantime
+          it = stalled_fatal_.erase(it);
+          continue;
+        }
+        Response err;
+        err.type = te->second.requests.front().type;
+        err.names.push_back(*it);
+        // "STALLED:" is a stable machine-readable marker (the Python layer
+        // classifies the exception type by it; wording after it is free).
+        err.error = "STALLED: tensor '" + *it +
+                    "' stalled beyond the stall-shutdown threshold; "
+                    "missing ranks [" +
+                    RanksToString(MissingRanks(te->second)) + "]";
+        negotiated.responses.push_back(std::move(err));
+        message_table_.erase(te);
+        it = stalled_fatal_.erase(it);
+      }
       // All ranks joined => emit the join-done response and reset.
       if (!joined_ranks_.empty() &&
           static_cast<int>(joined_ranks_.size()) == transport_->size()) {

@@ -101,6 +101,9 @@ class Controller {
 
   ResponseList FuseResponses(std::vector<Response> responses);
 
+  struct TableEntry;
+  std::vector<int> MissingRanks(const TableEntry& entry) const;
+
   Transport* transport_;
   ControllerOptions opts_;
   Timeline* timeline_;
@@ -114,6 +117,14 @@ class Controller {
     double first_seen;  // monotonic seconds, for the stall inspector
   };
   std::map<std::string, TableEntry> message_table_;
+  // Names past the stall-shutdown threshold: the next slow-path round
+  // broadcasts an error response for them (reference: the stall
+  // inspector's optional shutdown, stall_inspector.h:78-83 — failing the
+  // stalled tensor with a rank-naming error beats killing the job).
+  std::set<std::string> stalled_fatal_;
+  // First time a cache-hit failed cross-rank agreement, per name (stall
+  // escalation for cached steady-state tensors).
+  std::map<std::string, double> hit_pending_since_;
   std::set<int> joined_ranks_;
   // True between this rank submitting a Join and the all-joined response.
   // A joined rank submits nothing, so it must (a) report every cache bit as

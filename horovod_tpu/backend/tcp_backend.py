@@ -16,7 +16,7 @@ import numpy as np
 
 from . import Backend
 from .. import native
-from ..exceptions import HorovodInternalError
+from ..exceptions import HorovodInternalError, StalledTensorError
 from ..ops import reduce_ops
 from ..utils import envparse
 from ..utils.logging_util import get_logger
@@ -70,6 +70,8 @@ class TcpBackend(Backend):
             cache_capacity=envparse.get_int(envparse.CACHE_CAPACITY, 0),
             stall_warning_s=envparse.get_float(
                 envparse.STALL_CHECK_TIME_SECONDS, 0.0),
+            stall_shutdown_s=envparse.get_float(
+                envparse.STALL_SHUTDOWN_TIME_SECONDS, 0.0),
             timeline_path=(timeline + f".rank{topology.rank}") if timeline
             else "",
             delegate_data_ops=self.delegate_data_ops)
@@ -276,7 +278,15 @@ class TcpBackend(Backend):
                     self._handle_arrays.pop(h, None)
                 if self.entry_done_cb:
                     self.entry_done_cb(p.entry)
-                p.entry.handle._fail(HorovodInternalError("; ".join(errs)))
+                msg = "; ".join(errs)
+                # "STALLED:" is the native layer's stable marker; a mixed
+                # multi-handle failure (stall + transport) classifies as
+                # internal so elastic recovery still catches it.
+                exc = (StalledTensorError(msg)
+                       if errs and all(e.startswith("STALLED:")
+                                       for e in errs)
+                       else HorovodInternalError(msg))
+                p.entry.handle._fail(exc)
                 done += 1
             else:  # all handles done
                 try:

@@ -92,7 +92,7 @@ def load_library():
         lib.hvd_core_create.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_double, ctypes.c_char_p,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_double]
         lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
         lib.hvd_reserve_listen_port.restype = ctypes.c_int
         lib.hvd_reserve_listen_port.argtypes = []
@@ -186,13 +186,14 @@ class NativeCore:
 
     def __init__(self, rank, size, transport="tcp", peers="",
                  fusion_threshold=0, cache_capacity=0, stall_warning_s=0.0,
-                 timeline_path="", delegate_data_ops=False):
+                 timeline_path="", delegate_data_ops=False,
+                 stall_shutdown_s=0.0):
         self._lib = load_library()
         self._ctx = self._lib.hvd_core_create(
             rank, size, transport.encode(), peers.encode(),
             int(fusion_threshold), int(cache_capacity),
             float(stall_warning_s), timeline_path.encode(),
-            1 if delegate_data_ops else 0)
+            1 if delegate_data_ops else 0, float(stall_shutdown_s))
         if not self._ctx:
             raise NativeError(
                 f"native core init failed (rank {rank}/{size}, transport "

@@ -1,0 +1,128 @@
+"""Adversity scenarios for the native core (launched by test_spmd.py).
+
+The reference exercises failure paths through integration scripts that
+kill ranks and let tensors stall (reference: test/integration/test_stall.py,
+elastic integration kill tests). Scenario selected via ADVERSITY_MODE:
+
+- stall:    rank 0 submits a tensor nobody else ever submits; with the
+            stall-shutdown knob set the coordinator must fail it with a
+            rank-naming StalledTensorError while healthy traffic continues.
+- kill:     the highest rank dies abruptly mid-stream; survivors must get
+            HorovodInternalError (not hang) from in-flight or subsequent
+            collectives.
+- inflight: rank 0 holds unmatched async operations while every rank
+            shuts down; the handles must fail cleanly, no hang, no crash.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+MODE = os.environ["ADVERSITY_MODE"]
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    # Healthy traffic first so the mesh is known-good.
+    out = hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="warm")
+    np.testing.assert_allclose(np.asarray(out), float(size))
+
+    if MODE == "stall":
+        sync = os.environ["ADVERSITY_SYNC"]
+        if rank == 0:
+            try:
+                hvd.allreduce(jnp.ones(8), name="lonely")
+                raise SystemExit("stalled tensor did not fail")
+            except hvd.StalledTensorError as e:
+                msg = str(e)
+                assert "lonely" in msg and "missing ranks" in msg, msg
+                # Every non-submitting rank is named.
+                for r in range(1, size):
+                    assert str(r) in msg, msg
+            open(sync, "w").close()
+        else:
+            # Submit "post" only once rank 0's stall resolved: with the
+            # tiny shutdown threshold, a tensor one rank submits seconds
+            # before the others would itself be declared stalled.
+            deadline = time.monotonic() + 60
+            while not os.path.exists(sync):
+                assert time.monotonic() < deadline, "no stall signal"
+                time.sleep(0.05)
+        # Post-stall: the job still works.
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="post")
+        np.testing.assert_allclose(np.asarray(out), float(size))
+
+    elif MODE == "stall_cached":
+        # Steady-state stall: the tensor is CACHED on every rank, then one
+        # rank stops submitting. The cache-hit requeue loop is invisible to
+        # the coordinator's message table, so the controller must escalate
+        # long-unagreed hits to the slow path for the stall machinery to
+        # fire (regression: controller.cc hit_pending_since_).
+        sync = os.environ["ADVERSITY_SYNC"]
+        for i in range(3):
+            hvd.allreduce(jnp.ones(8), name="steady")
+        if rank == 0:
+            try:
+                hvd.allreduce(jnp.ones(8), name="steady")
+                raise SystemExit("cached stalled tensor did not fail")
+            except hvd.StalledTensorError as e:
+                assert "steady" in str(e), str(e)
+            open(sync, "w").close()
+        else:
+            deadline = time.monotonic() + 60
+            while not os.path.exists(sync):
+                assert time.monotonic() < deadline, "no stall signal"
+                time.sleep(0.05)
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="post2")
+        np.testing.assert_allclose(np.asarray(out), float(size))
+
+    elif MODE == "kill":
+        if rank == size - 1:
+            # Die abruptly mid-stream: no shutdown, no consensus.
+            os._exit(17)
+        # Survivors: collectives involving the dead rank must error, not
+        # hang (transport failure fails all in-flight handles).
+        try:
+            for i in range(50):
+                hvd.allreduce(jnp.ones(1024), op=hvd.Sum, name=f"k{i}")
+            raise SystemExit("collectives kept succeeding with a dead peer")
+        except hvd.HorovodInternalError:
+            pass
+
+    elif MODE == "inflight":
+        if rank == 0:
+            handles = [hvd.allreduce_async(jnp.ones(16), name=f"orphan{i}")
+                       for i in range(5)]
+            hvd.shutdown()
+            failed = 0
+            for h in handles:
+                try:
+                    hvd.synchronize(h)
+                except hvd.HorovodInternalError:
+                    failed += 1
+            assert failed == 5, f"only {failed}/5 orphans failed"
+        else:
+            time.sleep(0.5)  # let rank 0's orphans enter negotiation
+            hvd.shutdown()
+        print(f"rank {rank}/{size}: ADVERSITY-{MODE} OK", flush=True)
+        return
+
+    else:
+        raise SystemExit(f"unknown mode {MODE}")
+
+    hvd.shutdown()
+    print(f"rank {rank}/{size}: ADVERSITY-{MODE} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,3 +71,66 @@ def test_spmd_full_api(size):
     for rank, (code, out) in enumerate(zip(codes, outs)):
         assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
         assert f"rank {rank}/{size}: OK" in out
+
+
+# -- adversity: the failure paths the reference only exercises in
+#    integration scripts (test/integration/test_stall.py, elastic kills) --
+
+ADVERSITY = os.path.join(HERE, "adversity_worker.py")
+
+
+def test_stall_warning_and_shutdown(tmp_path):
+    """A tensor missing on one rank must produce a rank-naming warning and
+    then a StalledTensorError once past the shutdown knob — while healthy
+    traffic keeps flowing (reference: stall_inspector.h:78-83)."""
+    codes, outs = launch(2, script=ADVERSITY, extra_env={
+        "ADVERSITY_MODE": "stall",
+        "ADVERSITY_SYNC": str(tmp_path / "stall.sync"),
+        "HVDTPU_STALL_CHECK_TIME_SECONDS": "0.5",
+        "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS": "1.5",
+    }, timeout=240)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+        assert "ADVERSITY-stall OK" in out
+    # The coordinator (rank 0) logged the warn-path message too ("stalled
+    # for Ns" is the warning's wording; the error says "stalled beyond").
+    assert "stalled for" in outs[0], outs[0][-2000:]
+
+
+def test_stall_shutdown_on_cached_tensor(tmp_path):
+    """A CACHED tensor one rank stops submitting must also hit the stall
+    machinery (the hit-requeue loop never reaches the coordinator's
+    message table without escalation)."""
+    codes, outs = launch(2, script=ADVERSITY, extra_env={
+        "ADVERSITY_MODE": "stall_cached",
+        "ADVERSITY_SYNC": str(tmp_path / "stall.sync"),
+        "HVDTPU_STALL_CHECK_TIME_SECONDS": "0.5",
+        "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS": "1.5",
+    }, timeout=240)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+        assert "ADVERSITY-stall_cached OK" in out
+
+
+@pytest.mark.parametrize("size", [3, 4])
+def test_kill_rank_mid_allreduce(size):
+    """Abrupt death of a rank mid-stream: survivors error, never hang."""
+    codes, outs = launch(size, script=ADVERSITY, extra_env={
+        "ADVERSITY_MODE": "kill",
+    }, timeout=240)
+    assert codes[size - 1] == 17, codes
+    for rank in range(size - 1):
+        assert codes[rank] == 0, \
+            f"survivor {rank} failed (exit {codes[rank]}):\n" \
+            f"{outs[rank][-4000:]}"
+        assert "ADVERSITY-kill OK" in outs[rank]
+
+
+def test_shutdown_with_inflight_ops():
+    """Unmatched async handles at shutdown fail cleanly on every rank."""
+    codes, outs = launch(2, script=ADVERSITY, extra_env={
+        "ADVERSITY_MODE": "inflight",
+    }, timeout=240)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+        assert "ADVERSITY-inflight OK" in out
