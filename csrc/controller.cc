@@ -197,7 +197,9 @@ void Controller::AddRequestToTable(const Request& req, int from_rank) {
     return;
   }
   auto& entry = message_table_[req.name];
-  if (entry.ranks.empty()) entry.first_seen = NowSeconds();
+  const double now = NowSeconds();
+  if (entry.ranks.empty()) entry.first_seen = now;
+  entry.last_update = now;
   if (entry.ranks.insert(from_rank).second)
     entry.requests.push_back(req);
 }
@@ -356,8 +358,16 @@ void Controller::CheckForStalledTensors() {
   for (auto& kv : message_table_) {
     double age = now - kv.second.first_seen;
     // The shutdown threshold stands on its own: a user may set it below
-    // the (default 60s) warning threshold.
-    if (opts_.stall_shutdown_s > 0 && age >= opts_.stall_shutdown_s)
+    // the (default 60s) warning threshold. Quiescence guard: a healthy
+    // rank whose cache-hit submissions are still escalating refreshes
+    // last_update when its request lands, deferring the fatal verdict —
+    // without it a transiently-slow but alive rank could be declared
+    // missing in the escalation window.
+    double quiesce = opts_.stall_warning_s;
+    if (opts_.stall_shutdown_s > 0)
+      quiesce = std::min(quiesce, opts_.stall_shutdown_s);
+    if (opts_.stall_shutdown_s > 0 && age >= opts_.stall_shutdown_s &&
+        now - kv.second.last_update >= quiesce)
       stalled_fatal_.insert(kv.first);
     if (age < opts_.stall_warning_s) continue;
     LogMsg(LogLevel::kWarn, transport_->rank(),
@@ -439,7 +449,10 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
         // to cached steady-state tensors too.
         const double now_hit = NowSeconds();
         auto emplaced = hit_pending_since_.try_emplace(req.name, now_hit);
-        if (now_hit - emplaced.first->second >= opts_.stall_warning_s) {
+        double escalate_after = opts_.stall_warning_s;
+        if (opts_.stall_shutdown_s > 0)
+          escalate_after = std::min(escalate_after, opts_.stall_shutdown_s);
+        if (now_hit - emplaced.first->second >= escalate_after) {
           hit_pending_since_.erase(emplaced.first);
           uncached.push_back(std::move(req));
           break;
@@ -454,10 +467,12 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
         size_t bit = 0;
         cache_.BitFor(req.name, &bit);
         invalid_bits.push_back(bit);
+        hit_pending_since_.erase(req.name);
         uncached.push_back(std::move(req));
         break;
       }
       case ResponseCache::CacheState::kMiss:
+        hit_pending_since_.erase(req.name);
         uncached.push_back(std::move(req));
         break;
     }
@@ -512,6 +527,7 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
     } else if (cache_.Lookup(kv.second) ==
                ResponseCache::CacheState::kMiss) {
       // Invalidated cross-rank during coordination: renegotiate.
+      hit_pending_since_.erase(kv.second.name);
       uncached.push_back(std::move(kv.second));
     } else {
       out->requeue.push_back(std::move(kv.second));

@@ -114,7 +114,8 @@ class Controller {
   struct TableEntry {
     std::vector<Request> requests;
     std::set<int> ranks;
-    double first_seen;  // monotonic seconds, for the stall inspector
+    double first_seen;   // monotonic seconds, for the stall inspector
+    double last_update;  // refreshed per insert; fatal needs quiescence
   };
   std::map<std::string, TableEntry> message_table_;
   // Names past the stall-shutdown threshold: the next slow-path round

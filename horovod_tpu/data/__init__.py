@@ -1,0 +1,4 @@
+"""Data-loading utilities (reference: horovod/data/__init__.py)."""
+
+from .data_loader_base import (AsyncDataLoaderMixin,  # noqa: F401
+                               BaseDataLoader, prefetch_to_device)

@@ -1,0 +1,135 @@
+"""Unit tests: async data loader mixin + keras callback set
+(reference test shape: test/single unit tests, no processes)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data import (AsyncDataLoaderMixin, BaseDataLoader,
+                              prefetch_to_device)
+
+
+class _ListLoader(BaseDataLoader):
+    def __init__(self, items, delay=0.0, fail_at=None):
+        self.items = items
+        self.delay = delay
+        self.fail_at = fail_at
+
+    def __len__(self):
+        return len(self.items)
+
+    def __iter__(self):
+        for i, x in enumerate(self.items):
+            if self.fail_at is not None and i == self.fail_at:
+                raise ValueError("loader exploded")
+            if self.delay:
+                time.sleep(self.delay)
+            yield x
+
+
+class _AsyncListLoader(AsyncDataLoaderMixin, _ListLoader):
+    pass
+
+
+def test_async_loader_order_and_epochs():
+    loader = _AsyncListLoader(items=list(range(20)),
+                              async_loader_queue_size=4)
+    assert list(loader) == list(range(20))
+    # Re-iterable: a fresh epoch restarts the background thread.
+    assert list(loader) == list(range(20))
+    loader.close()
+
+
+def test_async_loader_overlaps():
+    """Producer thread runs while the consumer is mid-iteration."""
+    loader = _AsyncListLoader(items=list(range(8)), delay=0.02,
+                              async_loader_queue_size=4)
+    it = iter(loader)
+    first = next(it)
+    assert first == 0
+    # The background thread exists and is distinct from this thread.
+    assert loader._async_thread is not None
+    assert loader._async_thread is not threading.current_thread()
+    assert list(it) == list(range(1, 8))
+    loader.close()
+
+
+def test_async_loader_propagates_exceptions():
+    loader = _AsyncListLoader(items=list(range(10)), fail_at=3,
+                              async_loader_queue_size=2)
+    out = []
+    with pytest.raises(ValueError, match="loader exploded"):
+        for x in loader:
+            out.append(x)
+    assert out == [0, 1, 2]
+    loader.close()
+
+
+def test_async_loader_close_mid_epoch():
+    loader = _AsyncListLoader(items=list(range(1000)), delay=0.001,
+                              async_loader_queue_size=2)
+    it = iter(loader)
+    next(it)
+    loader.close()
+    assert loader._async_thread is None
+
+
+def test_async_disabled_passthrough():
+    loader = _AsyncListLoader(items=[1, 2, 3], async_loader_queue_size=0)
+    assert list(loader) == [1, 2, 3]
+
+
+def test_prefetch_to_device():
+    batches = [{"x": np.full((4,), i, np.float32)} for i in range(6)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 6
+    for i, b in enumerate(out):
+        np.testing.assert_allclose(np.asarray(b["x"]), float(i))
+
+
+# -- keras callbacks (no processes: single-mode behavior + LR math) -------
+
+def _keras():
+    return pytest.importorskip("keras")
+
+
+def test_lr_warmup_callback_math(hvd):
+    keras = _keras()
+    from horovod_tpu._keras.callbacks import make_callbacks
+    _, _, LearningRateWarmupCallback, LearningRateScheduleCallback = \
+        make_callbacks()
+
+    model = keras.Sequential([keras.layers.Input((2,)),
+                              keras.layers.Dense(1)])
+    model.compile(optimizer=keras.optimizers.SGD(0.4), loss="mse")
+
+    cb = LearningRateWarmupCallback(initial_lr=0.4, warmup_epochs=4)
+    cb.set_model(model)
+    lrs = []
+    for epoch in range(6):
+        cb.on_epoch_begin(epoch)
+        lrs.append(float(np.asarray(model.optimizer.learning_rate)))
+    # Monotonic ramp to initial_lr by the end of warmup; untouched after.
+    assert lrs[:4] == sorted(lrs[:4]), lrs
+    np.testing.assert_allclose(lrs[3], 0.4, rtol=1e-6)
+
+    sched = LearningRateScheduleCallback(initial_lr=0.4, multiplier=0.1,
+                                         start_epoch=2)
+    sched.set_model(model)
+    sched.on_epoch_begin(0)
+    np.testing.assert_allclose(
+        float(np.asarray(model.optimizer.learning_rate)), 0.4, rtol=1e-6)
+    sched.on_epoch_begin(3)
+    np.testing.assert_allclose(
+        float(np.asarray(model.optimizer.learning_rate)), 0.04, rtol=1e-6)
+
+
+def test_metric_average_single_mode_noop(hvd):
+    from horovod_tpu._keras.callbacks import make_callbacks
+    _, MetricAverageCallback, _, _ = make_callbacks()
+    cb = MetricAverageCallback()
+    logs = {"loss": 1.5, "acc": 0.5}
+    cb.on_epoch_end(0, logs)  # single-controller mode: no processes
+    assert logs == {"loss": 1.5, "acc": 0.5}
