@@ -363,11 +363,8 @@ void Controller::CheckForStalledTensors() {
     // last_update when its request lands, deferring the fatal verdict —
     // without it a transiently-slow but alive rank could be declared
     // missing in the escalation window.
-    double quiesce = opts_.stall_warning_s;
-    if (opts_.stall_shutdown_s > 0)
-      quiesce = std::min(quiesce, opts_.stall_shutdown_s);
     if (opts_.stall_shutdown_s > 0 && age >= opts_.stall_shutdown_s &&
-        now - kv.second.last_update >= quiesce)
+        now - kv.second.last_update >= EffectiveStallThreshold())
       stalled_fatal_.insert(kv.first);
     if (age < opts_.stall_warning_s) continue;
     LogMsg(LogLevel::kWarn, transport_->rank(),
@@ -376,6 +373,14 @@ void Controller::CheckForStalledTensors() {
                "s; waiting on ranks [" +
                RanksToString(MissingRanks(kv.second)) + "]");
   }
+}
+
+double Controller::EffectiveStallThreshold() const {
+  // Escalation and the fatal quiescence window MUST use the same value:
+  // the quiescence guard assumes a healthy rank escalates within it.
+  double t = opts_.stall_warning_s;
+  if (opts_.stall_shutdown_s > 0) t = std::min(t, opts_.stall_shutdown_s);
+  return t;
 }
 
 std::vector<int> Controller::MissingRanks(const TableEntry& entry) const {
@@ -449,10 +454,7 @@ Status Controller::ComputeResponseList(std::vector<Request> pending,
         // to cached steady-state tensors too.
         const double now_hit = NowSeconds();
         auto emplaced = hit_pending_since_.try_emplace(req.name, now_hit);
-        double escalate_after = opts_.stall_warning_s;
-        if (opts_.stall_shutdown_s > 0)
-          escalate_after = std::min(escalate_after, opts_.stall_shutdown_s);
-        if (now_hit - emplaced.first->second >= escalate_after) {
+        if (now_hit - emplaced.first->second >= EffectiveStallThreshold()) {
           hit_pending_since_.erase(emplaced.first);
           uncached.push_back(std::move(req));
           break;

@@ -103,6 +103,7 @@ class Controller {
 
   struct TableEntry;
   std::vector<int> MissingRanks(const TableEntry& entry) const;
+  double EffectiveStallThreshold() const;
 
   Transport* transport_;
   ControllerOptions opts_;

@@ -74,6 +74,11 @@ class AsyncDataLoaderMixin:
         if self.async_loader_queue_size <= 0:
             yield from super().__iter__()
             return
+        if self._async_thread is not None and self._async_thread.is_alive():
+            # Previous epoch abandoned mid-iteration (consumer broke out):
+            # tear its producer down before starting a new one, or the old
+            # thread leaks blocked on the abandoned queue.
+            self.close_async_loader()
         self._async_stop.clear()
         q = queue.Queue(maxsize=self.async_loader_queue_size)
         self._async_queue = q

@@ -1,0 +1,67 @@
+"""Spark integration: run horovod_tpu training inside Spark executors
+(reference: horovod/spark/runner.py:197 ``horovod.spark.run``).
+
+Thin by design: Spark provides placement and the barrier stage; the
+rendezvous and topology machinery is the shared cluster core
+(runner/cluster.py). Requires pyspark (not bundled in TPU images — the
+adapter gates with a clear error).
+
+    import horovod_tpu.spark as hvd_spark
+    results = hvd_spark.run(train_fn, args=(lr,), num_proc=4)
+"""
+
+from ..runner.cluster import ClusterJob, cluster_task_bootstrap
+
+
+def _pyspark():
+    try:
+        import pyspark
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark, which is not installed "
+            "in this environment (TPU images ship without Spark). "
+            "`pip install pyspark` on a Spark cluster to use this "
+            "integration.") from e
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
+        extra_env=None, verbose=True):
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark executors as one
+    horovod_tpu job; returns per-rank results ordered by rank
+    (reference: horovod/spark/runner.py:197 ``run``)."""
+    pyspark = _pyspark()
+    from pyspark import BarrierTaskContext, SparkContext
+
+    kwargs = kwargs or {}
+    sc = SparkContext.getOrCreate()
+    if num_proc is None:
+        num_proc = sc.defaultParallelism
+    if verbose:
+        from ..utils.logging_util import get_logger
+        get_logger().info("spark: launching %d-task barrier job", num_proc)
+    job = ClusterJob(num_proc, start_timeout=start_timeout)
+    task_args = job.task_args()
+    env = dict(extra_env or {})
+
+    def _task(_):
+        import os
+        os.environ.update(env)
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        n, addr, port, token, timeout = task_args
+        cluster_task_bootstrap(rank, n, addr, port, token, timeout)
+        result = fn(*args, **kwargs)
+        return [(rank, result)]
+
+    try:
+        pairs = (sc.parallelize(range(num_proc), num_proc)
+                 .barrier()
+                 .mapPartitions(_task)
+                 .collect())
+    finally:
+        job.shutdown()
+    return [r for _, r in sorted(pairs)]
+
+
+__all__ = ["run", "ClusterJob", "cluster_task_bootstrap"]

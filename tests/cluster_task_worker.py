@@ -1,0 +1,38 @@
+"""Simulated cluster-framework task: exactly what a Spark barrier task or
+Ray actor runs — cluster_task_bootstrap then hvd.init() then training
+(launched by test_cluster.py with only (rank, task_args), no topology
+env, like a real placed task)."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    rank = int(sys.argv[1])
+    n, addr, port, token, timeout = json.loads(sys.argv[2])
+
+    from horovod_tpu.runner.cluster import cluster_task_bootstrap
+    cluster_task_bootstrap(rank, n, addr, int(port), token, timeout)
+
+    import horovod_tpu as hvd
+    hvd.init()
+    assert hvd.rank() == rank and hvd.size() == n
+    # All simulated tasks share this host, so local == global topology.
+    assert hvd.local_size() == n and hvd.cross_size() == 1
+
+    out = hvd.allreduce(jnp.ones(4) * (rank + 1), op=hvd.Sum, name="c")
+    np.testing.assert_allclose(np.asarray(out),
+                               sum(range(1, n + 1)))
+    print(f"rank {rank}/{n}: CLUSTER-TASK OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
