@@ -1,0 +1,46 @@
+"""Elastic training example (reference: examples/elastic/* — same shape:
+commit state each epoch, survive membership changes and preemptions).
+
+Run:  hvdrun -np 2 --min-np 1 --host-discovery-script ./discover.sh \\
+          python examples/elastic_jax_train.py
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+
+def main():
+    hvd.init()
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    state = elastic.ObjectState(epoch=0, w=jnp.zeros((8, 1)))
+
+    @elastic.run
+    def train(state):
+        while state.epoch < 20:
+            shard = np.random.RandomState(100 + hvd.rank() + state.epoch)
+            x = jnp.asarray(shard.randn(32, 8).astype(np.float32))
+            y = x @ jnp.asarray(w_true)
+            grad = 2.0 * x.T @ (x @ state.w - y) / x.shape[0]
+            grad = hvd.allreduce(grad, name=f"g{state.epoch}")
+            state.w = state.w - 0.05 * grad
+            if hvd.rank() == 0:
+                loss = float(jnp.mean((x @ state.w - y) ** 2))
+                print(f"epoch {state.epoch} size={hvd.size()} "
+                      f"loss={loss:.5f}", flush=True)
+            state.epoch += 1
+            state.commit()
+        return state.w
+
+    w = train(state)
+    if hvd.rank() == 0:
+        err = float(jnp.max(jnp.abs(w - jnp.asarray(w_true))))
+        print(f"done: max |w - w_true| = {err:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,15 +47,17 @@ from .functions import (  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ObjectState)
 
 
-def start_timeline(file_path, mark_cycles=False):
+def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
     """Start recording a Chrome-trace timeline at runtime (reference:
-    horovod/common/basics.py:156 start_timeline)."""
+    horovod/common/basics.py:156 start_timeline). ``jax_profiler_dir``
+    additionally captures a jax.profiler device trace alongside the host
+    timeline (the TPU analog of the reference's NVTX ranges)."""
     from . import basics
     from .timeline import Timeline
     rt = basics.runtime()
     if rt.timeline is not None:
         rt.timeline.stop()
-    rt.timeline = Timeline(file_path)
+    rt.timeline = Timeline(file_path, jax_profiler_dir=jax_profiler_dir)
     rt.timeline.start()
 
 

@@ -1,0 +1,122 @@
+"""MXNet binding (reference: horovod/mxnet/__init__.py:42
+``DistributedOptimizer``, ``broadcast_parameters``).
+
+MXNet is deprecated upstream (archived by Apache) and is not shipped in
+TPU images; this adapter gates with a clear error. The surface mirrors
+the reference so legacy scripts fail with guidance rather than
+AttributeError, and runs if a user installs mxnet themselves: gradients
+ride the same process-level collectives as the torch binding.
+"""
+
+from .. import basics
+from ..ops import reduce_ops
+
+Average = reduce_ops.Average
+Sum = reduce_ops.Sum
+
+init = basics.init
+shutdown = basics.shutdown
+is_initialized = basics.is_initialized
+local_rank = basics.local_rank
+local_size = basics.local_size
+
+
+def rank():
+    return basics.runtime().topology.rank
+
+
+def size():
+    return basics.runtime().topology.size
+
+
+def _mxnet():
+    try:
+        import mxnet
+        return mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet requires mxnet, which is not installed "
+            "(MXNet is archived upstream and not shipped in TPU images; "
+            "`pip install mxnet` to use this legacy binding, or port the "
+            "script to horovod_tpu.torch / horovod_tpu.jax).") from e
+
+
+def _np_collective(fn):
+    """Run an eager collective over an NDArray via numpy."""
+    import numpy as np
+
+    def wrapped(nd, *args, **kwargs):
+        mx = _mxnet()
+        out = fn(nd.asnumpy(), *args, **kwargs)
+        return mx.nd.array(np.asarray(out), ctx=nd.context,
+                           dtype=nd.dtype)
+    return wrapped
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    """Reference: horovod/mxnet/mpi_ops.py allreduce."""
+    _mxnet()
+    from ..ops import collectives as _c
+    op = Average if average else Sum
+    return _np_collective(
+        lambda a: _c.allreduce(a, op=op, name=name))(tensor)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Reference: horovod/mxnet/__init__.py:226 broadcast_parameters.
+    Accepts NDArray dicts AND gluon ParameterDicts (Block.collect_params()
+    values are Parameter objects read via .data() / written via
+    .set_data(), reference :255)."""
+    mx = _mxnet()
+    import numpy as np
+    from ..functions import broadcast_variables
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        items = sorted(params)
+
+    def read(v):
+        return v.data().asnumpy() if hasattr(v, "set_data") else v.asnumpy()
+
+    arrays = [read(v) for _, v in items]
+    outs = broadcast_variables(arrays, root_rank=root_rank)
+    for (name, v), out in zip(items, outs):
+        out = np.asarray(out)
+        if hasattr(v, "set_data"):
+            v.set_data(mx.nd.array(out, dtype=out.dtype))
+        else:
+            v[:] = out
+
+
+def DistributedOptimizer(optimizer):
+    """Wrap an mxnet optimizer so update() allreduces gradients first
+    (reference: horovod/mxnet/__init__.py:42)."""
+    mx = _mxnet()
+    import numpy as np
+    from ..ops import collectives as _c
+
+    class _Distributed(mx.optimizer.Optimizer):
+        def __init__(self, opt):
+            self._opt = opt
+
+        def __getattr__(self, item):
+            return getattr(self._opt, item)
+
+        def _reduce(self, index, grad):
+            reduced = _c.allreduce(grad.asnumpy(), op=Average,
+                                   name=f"grad.{index}")
+            grad[:] = np.asarray(reduced)
+
+        def update(self, index, weight, grad, state):
+            self._reduce(index, grad)
+            return self._opt.update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            # The gluon Trainer path calls this, not update(); without the
+            # override gradients would silently skip the allreduce
+            # (reference: horovod/mxnet/__init__.py:92).
+            self._reduce(index, grad)
+            return self._opt.update_multi_precision(index, weight, grad,
+                                                    state)
+
+    return _Distributed(optimizer)

@@ -3,9 +3,9 @@
 Megatron-style tensor parallelism expressed as PartitionSpecs: annotate the
 parameter tree + batch, jit the step, and XLA's SPMD partitioner inserts the
 tp collectives (the scaling-book recipe: pick a mesh, annotate shardings,
-let XLA insert collectives). The manual shard_map composition lives in
-composed.py; this module is the annotation route, which is what most users
-want for tp/fsdp.
+let XLA insert collectives). The manual shard_map compositions live in
+ring_attention.py / ulysses.py / pipeline.py / moe.py; this module is the
+annotation route, which is what most users want for tp/fsdp.
 
 Rules are (path-regex → PartitionSpec) pairs matched against the flax param
 path joined with '/'. First match wins; unmatched params replicate.

@@ -15,7 +15,7 @@ import time
 
 
 class Timeline:
-    def __init__(self, path):
+    def __init__(self, path, jax_profiler_dir=None):
         self.path = path
         self._queue = queue.Queue()
         self._thread = None
@@ -23,6 +23,12 @@ class Timeline:
         self._file = None
         self._first = True
         self._pids = {}
+        # Optional device-side story: a jax.profiler trace alongside the
+        # host timeline (the SURVEY-stated TPU equivalent of NVTX ranges,
+        # reference: nvtx_op_range.cc — on TPU the profiler's TraceMe/xplane
+        # capture is the per-op device view).
+        self._jax_profiler_dir = jax_profiler_dir
+        self._jax_profiling = False
 
     # -- producer side (coordinator) --------------------------------------
     def begin(self, names, activity):
@@ -51,11 +57,25 @@ class Timeline:
         self._thread = threading.Thread(target=self._writer,
                                         name="hvd-tpu-timeline", daemon=True)
         self._thread.start()
+        if self._jax_profiler_dir:
+            try:
+                import jax
+                jax.profiler.start_trace(self._jax_profiler_dir)
+                self._jax_profiling = True
+            except Exception:  # noqa: BLE001 — host timeline still works
+                self._jax_profiling = False
 
     def stop(self):
         if not self._running:
             return
         self._running = False
+        if self._jax_profiling:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            self._jax_profiling = False
         self._queue.put(None)
         self._thread.join(timeout=5)
         try:

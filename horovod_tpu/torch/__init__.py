@@ -17,6 +17,7 @@ import numpy as np
 from .. import basics
 from ..ops import collectives as _c
 from ..ops import reduce_ops
+from ..ops.compression import Compression
 from ..process_sets import global_process_set
 
 Average = reduce_ops.Average
@@ -87,9 +88,10 @@ class _Handle:
     write-back target (reference: handle_manager in mpi_ops_v2.cc)."""
 
     __slots__ = ("inner", "target", "inplace", "bf16", "done", "result",
-                 "want_splits")
+                 "want_splits", "compression", "comp_ctx")
 
-    def __init__(self, inner, target, inplace, bf16, want_splits=False):
+    def __init__(self, inner, target, inplace, bf16, want_splits=False,
+                 compression=None, comp_ctx=None):
         self.inner = inner
         self.target = target
         self.inplace = inplace
@@ -97,6 +99,8 @@ class _Handle:
         self.done = False
         self.result = None
         self.want_splits = want_splits
+        self.compression = compression
+        self.comp_ctx = comp_ctx
 
 
 def _local_handle(value):
@@ -112,6 +116,8 @@ def synchronize(handle):
     if handle.done:
         return handle.result
     out = _c.synchronize(handle.inner)
+    if handle.compression is not None:
+        out = handle.compression.decompress(out, handle.comp_ctx)
     if isinstance(out, tuple):  # alltoall resolves to (out, recv_splits)
         data = _from_np(np.asarray(out[0]), handle.target, handle.bf16)
         if handle.want_splits:
@@ -138,9 +144,11 @@ def poll(handle):
 
 
 def _allreduce_async_impl(tensor, op, name, prescale, postscale,
-                          process_set, inplace):
+                          process_set, inplace, compression=None):
     if op is None:
         op = Average
+    if compression is Compression.none:
+        compression = None
     if not _spmd():
         scale = (prescale or 1.0) * (postscale or 1.0)
         out = tensor * scale if scale != 1.0 else tensor
@@ -149,45 +157,57 @@ def _allreduce_async_impl(tensor, op, name, prescale, postscale,
             out = tensor
         return _local_handle(out)
     arr, bf16 = _to_np(tensor)
+    comp_ctx = None
+    if compression is not None:
+        # Compressor classes operate fine on numpy (astype/issubdtype):
+        # no device round-trip on the hot gradient path.
+        carr, comp_ctx = compression.compress(arr)
+        arr = np.ascontiguousarray(carr)
     inner = _c.allreduce_async(arr, op=op, name=name,
                                prescale_factor=prescale or 1.0,
                                postscale_factor=postscale or 1.0,
                                process_set=process_set)
-    return _Handle(inner, tensor, inplace, bf16)
+    return _Handle(inner, tensor, inplace, bf16, compression=compression,
+                   comp_ctx=comp_ctx)
 
 
-def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0,
+def allreduce_async(tensor, average=None, name=None, compression=None,
+                    op=None, prescale_factor=1.0, postscale_factor=1.0,
                     process_set=global_process_set):
+    """Argument order follows the reference (horovod/torch/mpi_ops.py:211:
+    tensor, average, name, compression, op, ...) so positional callers of
+    drop-in scripts bind correctly."""
     if op is None:
         op = Sum if average is False else Average
     return _allreduce_async_impl(tensor, op, name, prescale_factor,
-                                 postscale_factor, process_set, False)
+                                 postscale_factor, process_set, False,
+                                 compression)
 
 
-def allreduce_async_(tensor, average=None, name=None, op=None,
-                     prescale_factor=1.0, postscale_factor=1.0,
+def allreduce_async_(tensor, average=None, name=None, compression=None,
+                     op=None, prescale_factor=1.0, postscale_factor=1.0,
                      process_set=global_process_set):
     if op is None:
         op = Sum if average is False else Average
     return _allreduce_async_impl(tensor, op, name, prescale_factor,
-                                 postscale_factor, process_set, True)
+                                 postscale_factor, process_set, True,
+                                 compression)
 
 
-def allreduce(tensor, average=None, name=None, op=None,
-              prescale_factor=1.0, postscale_factor=1.0,
+def allreduce(tensor, average=None, name=None, compression=None,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
               process_set=global_process_set):
-    return synchronize(allreduce_async(tensor, average, name, op,
-                                       prescale_factor, postscale_factor,
-                                       process_set))
+    return synchronize(allreduce_async(
+        tensor, average, name, compression, op, prescale_factor,
+        postscale_factor, process_set=process_set))
 
 
-def allreduce_(tensor, average=None, name=None, op=None,
-               prescale_factor=1.0, postscale_factor=1.0,
+def allreduce_(tensor, average=None, name=None, compression=None,
+               op=None, prescale_factor=1.0, postscale_factor=1.0,
                process_set=global_process_set):
-    return synchronize(allreduce_async_(tensor, average, name, op,
-                                        prescale_factor, postscale_factor,
-                                        process_set))
+    return synchronize(allreduce_async_(
+        tensor, average, name, compression, op, prescale_factor,
+        postscale_factor, process_set=process_set))
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
@@ -355,13 +375,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     post-accumulate-grad hook fires an async allreduce; ``step()``
     synchronizes every outstanding handle, writes the averaged gradients
     back, then runs the inner optimizer."""
-    if compression is not None:
-        from ..ops.compression import Compression
-        if compression is not Compression.none:
-            raise NotImplementedError(
-                "gradient compression is not yet wired into the torch "
-                "binding; pass compression=None (the JAX binding supports "
-                "Compression.fp16/bf16)")
+    if compression is Compression.none:
+        compression = None
     if getattr(optimizer, "_hvd_wrapped", False):
         raise ValueError(
             "optimizer is already wrapped by DistributedOptimizer; "
@@ -413,7 +428,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                 self._hvd_handles[param] = allreduce_async_(
                     grad, op=op, name=f"grad.{name_of[param]}",
                     prescale_factor=pre, postscale_factor=post,
-                    process_set=process_set)
+                    compression=compression, process_set=process_set)
             return hook
 
         def synchronize(self):

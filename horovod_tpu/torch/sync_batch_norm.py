@@ -1,0 +1,156 @@
+"""Cross-rank synchronized BatchNorm for torch (reference:
+horovod/torch/sync_batch_norm.py:218 ``SyncBatchNorm``).
+
+Batch statistics (mean/var) are computed over the GLOBAL batch via
+allreduce in the forward pass, and the gradient reductions the chain rule
+requires (sum_dy, sum_dy_xmu) are allreduced in the backward pass — the
+same custom-autograd structure as the reference. Parameter gradients stay
+local (the DistributedOptimizer reduces them like any other grad).
+"""
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import _spmd, allreduce
+from ..ops import reduce_ops
+from ..process_sets import global_process_set
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm1d/2d/3d replacement syncing stats across ranks."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True,
+                 process_set=global_process_set):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        self.process_set = process_set
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input):  # noqa: A002 (torch API name)
+        if not (self.training and _spmd()):
+            return super().forward(input)
+        self._check_input_dim(input)
+        if self.momentum is None:
+            exponential_average_factor = 0.0
+        else:
+            exponential_average_factor = self.momentum
+        if self.track_running_stats and self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+            if self.momentum is None:
+                exponential_average_factor = \
+                    1.0 / float(self.num_batches_tracked)
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, exponential_average_factor,
+            self.process_set)
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum, process_set):
+        # STABLE names so the response-cache fast path hits every step
+        # (reference uses fixed names, sync_batch_norm.py:162); keyed by
+        # channel count so equal-width layers share one cached response
+        # and differently-sized layers never collide. Safe because these
+        # allreduces are synchronous — one in flight at a time.
+        ctx.call_id = input.shape[1]
+        c = input.shape[1]
+        reduce_dims = [0] + list(range(2, input.dim()))
+        local_count = input.numel() // c
+
+        local_sum = input.sum(dim=reduce_dims)
+        local_sqsum = (input * input).sum(dim=reduce_dims)
+        packed = torch.cat([local_sum, local_sqsum,
+                            torch.tensor([float(local_count)],
+                                         dtype=local_sum.dtype,
+                                         device=local_sum.device)])
+        packed = allreduce(packed, op=reduce_ops.Sum,
+                           name=f"syncbn.fwd.{ctx.call_id}",
+                           process_set=process_set)
+        total = float(packed[-1])
+        mean = packed[:c] / total
+        var = packed[c:2 * c] / total - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        if running_mean is not None:
+            with torch.no_grad():
+                running_mean.mul_(1 - momentum).add_(momentum * mean)
+                unbiased = var * (total / max(total - 1, 1))
+                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.view(shape)
+        if bias is not None:
+            out = out + bias.view(shape)
+        ctx.save_for_backward(input, weight, mean, invstd)
+        ctx.total = total
+        ctx.process_set = process_set
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        input, weight, mean, invstd = ctx.saved_tensors
+        c = input.shape[1]
+        reduce_dims = [0] + list(range(2, input.dim()))
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xmu = input - mean.view(shape)
+
+        sum_dy = grad_output.sum(dim=reduce_dims)
+        sum_dy_xmu = (grad_output * xmu).sum(dim=reduce_dims)
+        packed = torch.cat([sum_dy, sum_dy_xmu])
+        # The stat gradients span the GLOBAL batch (reference: backward
+        # allreduces sum_dy / sum_dy_xmu).
+        packed = allreduce(packed.detach(), op=reduce_ops.Sum,
+                           name=f"syncbn.bwd.{ctx.call_id}",
+                           process_set=ctx.process_set)
+        g_sum_dy = packed[:c]
+        g_sum_dy_xmu = packed[c:]
+        total = ctx.total
+
+        w = weight.view(shape) if weight is not None else 1.0
+        inv = invstd.view(shape)
+        grad_input = (grad_output
+                      - g_sum_dy.view(shape) / total
+                      - xmu * inv * inv
+                      * g_sum_dy_xmu.view(shape) / total) * inv * w
+
+        grad_weight = None
+        if weight is not None and ctx.needs_input_grad[1]:
+            grad_weight = (grad_output * xmu * inv).sum(dim=reduce_dims)
+        grad_bias = None
+        if ctx.needs_input_grad[2]:
+            grad_bias = grad_output.sum(dim=reduce_dims)
+        return (grad_input, grad_weight, grad_bias, None, None, None, None,
+                None)
+
+
+def convert_sync_batchnorm(module, process_set=global_process_set):
+    """Recursively replace BatchNorm modules with SyncBatchNorm (the
+    torch.nn.SyncBatchNorm.convert_sync_batchnorm analog)."""
+    out = module
+    if isinstance(module, _BatchNorm) and not isinstance(module,
+                                                         SyncBatchNorm):
+        out = SyncBatchNorm(module.num_features, module.eps,
+                            module.momentum, module.affine,
+                            module.track_running_stats,
+                            process_set=process_set)
+        if module.affine:
+            with torch.no_grad():
+                out.weight.copy_(module.weight)
+                out.bias.copy_(module.bias)
+        out.running_mean = module.running_mean
+        out.running_var = module.running_var
+        out.num_batches_tracked = module.num_batches_tracked
+    for name, child in module.named_children():
+        out.add_module(name,
+                       convert_sync_batchnorm(child, process_set))
+    return out

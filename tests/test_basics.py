@@ -54,3 +54,24 @@ def test_not_initialized_error():
             basics.runtime()
     finally:
         basics._runtime = saved
+
+
+def test_timeline_with_jax_profiler(hvd, tmp_path):
+    """start_timeline with jax_profiler_dir captures a device trace
+    alongside the chrome-trace host timeline."""
+    import json
+    import os
+    import jax
+    import jax.numpy as jnp
+
+    trace = tmp_path / "tl.json"
+    profdir = tmp_path / "jaxprof"
+    hvd.start_timeline(str(trace), jax_profiler_dir=str(profdir))
+    jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    hvd.allreduce(jnp.ones((len(jax.devices()), 4)), name="tlprof")
+    hvd.stop_timeline()
+    events = json.load(open(trace))
+    assert isinstance(events, list)
+    # The profiler wrote its plugin directory structure.
+    found = any("plugins" in dirs for _, dirs, _f in os.walk(profdir))
+    assert found, list(os.walk(profdir))

@@ -65,3 +65,14 @@ def test_ray_adapter_gates_without_ray():
     ex = RayExecutor(num_workers=1)
     with pytest.raises(ImportError, match="ray"):
         ex.start()
+
+
+def test_mxnet_adapter_gates_without_mxnet():
+    try:
+        import mxnet  # noqa: F401
+        pytest.skip("mxnet installed; gate not applicable")
+    except ImportError:
+        pass
+    import horovod_tpu.mxnet as hvd_mx
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.allreduce(None)

@@ -102,6 +102,42 @@ def main():
         for a_, b_ in zip(rank_w, all_w[0]):
             np.testing.assert_allclose(a_, b_, rtol=1e-4, atol=1e-6)
 
+    # -- SyncBatchNorm: global-batch stats + synced backward ----------------
+    from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm
+    full = np.random.RandomState(5).randn(8, 4, 3).astype(np.float32)
+    shard = torch.from_numpy(full[r::n].copy()).requires_grad_(True)
+    bn = SyncBatchNorm(4)
+    out_bn = bn(shard)
+    (out_bn ** 2).sum().backward()
+
+    # Oracle: plain BatchNorm over the FULL batch.
+    bn_ref = torch.nn.BatchNorm1d(4)
+    ref_in = torch.from_numpy(full.copy()).requires_grad_(True)
+    ref_out = bn_ref(ref_in)
+    (ref_out ** 2).sum().backward()
+
+    np.testing.assert_allclose(out_bn.detach().numpy(),
+                               ref_out.detach().numpy()[r::n],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(shard.grad.numpy(),
+                               ref_in.grad.numpy()[r::n],
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(bn.running_mean.numpy(),
+                               bn_ref.running_mean.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(bn.running_var.numpy(),
+                               bn_ref.running_var.numpy(), rtol=1e-5)
+    # Param grads are per-shard; their sum equals the full-batch grad.
+    wg = hvd.allreduce(bn.weight.grad, op=hvd.Sum, name="syncbn.wg")
+    np.testing.assert_allclose(wg.numpy(), bn_ref.weight.grad.numpy(),
+                               rtol=1e-3, atol=1e-5)
+
+    # -- compression ---------------------------------------------------------
+    from horovod_tpu.ops.compression import Compression
+    cr = hvd.allreduce(torch.ones(5) * (r + 1), op=hvd.Sum,
+                       name="comp.fp16", compression=Compression.fp16)
+    assert cr.dtype == torch.float32
+    np.testing.assert_allclose(cr.numpy(), sum(range(1, n + 1)), rtol=1e-2)
+
     # -- TorchState commit/restore -----------------------------------------
     from horovod_tpu.torch.elastic import TorchState
     state = TorchState(model=model, optimizer=opt, epoch=3)
