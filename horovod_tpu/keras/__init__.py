@@ -117,12 +117,17 @@ class _Callbacks:
 
     def __getattr__(self, item):
         from .._keras.callbacks import make_callbacks
+        from .._keras.elastic import make_elastic_callbacks
         (bgv, ma, warmup, sched) = make_callbacks()
+        (commit, upd_batch, upd_epoch) = make_elastic_callbacks()
         mapping = {
             "BroadcastGlobalVariablesCallback": bgv,
             "MetricAverageCallback": ma,
             "LearningRateWarmupCallback": warmup,
             "LearningRateScheduleCallback": sched,
+            "CommitStateCallback": commit,
+            "UpdateBatchStateCallback": upd_batch,
+            "UpdateEpochStateCallback": upd_epoch,
         }
         try:
             return mapping[item]

@@ -96,11 +96,18 @@ def DistributedOptimizer(optimizer):
     from ..ops import collectives as _c
 
     class _Distributed(mx.optimizer.Optimizer):
+        """Two-way proxy: reads AND writes route to the inner optimizer —
+        the gluon Trainer sets rescale_grad/lr on the optimizer every
+        step, and a one-way proxy would silently drop them."""
+
         def __init__(self, opt):
-            self._opt = opt
+            self.__dict__["_opt"] = opt
 
         def __getattr__(self, item):
-            return getattr(self._opt, item)
+            return getattr(self.__dict__["_opt"], item)
+
+        def __setattr__(self, key, value):
+            setattr(self.__dict__["_opt"], key, value)
 
         def _reduce(self, index, grad):
             reduced = _c.allreduce(grad.asnumpy(), op=Average,

@@ -115,8 +115,16 @@ def synchronize(handle):
     (reference: horovod/torch/mpi_ops.py synchronize)."""
     if handle.done:
         return handle.result
+    fn = getattr(handle, "fn", None)
+    if fn is not None:  # composite op (_LazyHandle, e.g. sparse allreduce)
+        handle.result = fn()
+        handle.done = True
+        return handle.result
     out = _c.synchronize(handle.inner)
-    if handle.compression is not None:
+    if handle.compression is not None and handle.target is None:
+        # With a write-back target, _from_np below restores the dtype
+        # anyway — an explicit decompress would be a redundant full-array
+        # cast on the hot gradient path.
         out = handle.compression.decompress(out, handle.comp_ctx)
     if isinstance(out, tuple):  # alltoall resolves to (out, recv_splits)
         data = _from_np(np.asarray(out[0]), handle.target, handle.bf16)
@@ -140,6 +148,9 @@ def synchronize(handle):
 def poll(handle):
     if handle.done:
         return True
+    if getattr(handle, "fn", None) is not None:
+        # Composite op (_LazyHandle): work happens at synchronize().
+        return False
     return _c.poll(handle.inner)
 
 
@@ -208,6 +219,63 @@ def allreduce_(tensor, average=None, name=None, compression=None,
     return synchronize(allreduce_async_(
         tensor, average, name, compression, op, prescale_factor,
         postscale_factor, process_set=process_set))
+
+
+class _LazyHandle(_Handle):
+    """Handle whose work runs at synchronize() time (sparse allreduce is a
+    composite of allgathers; reference returns a handle the same way,
+    horovod/torch/mpi_ops.py:556)."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        super().__init__(None, None, False, None)
+        self.fn = fn
+
+    # poll() reports done only after synchronize (composite op).
+
+
+def sparse_allreduce_async(tensor, name=None, op=None,
+                           process_set=global_process_set):
+    """Average/sum a sparse COO tensor across ranks by allgathering its
+    indices and values (reference: horovod/torch/mpi_ops.py:556
+    sparse_allreduce_async — same allgather formulation). Returns a handle
+    resolving to a coalesced sparse tensor."""
+    torch = _torch()
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async requires a sparse tensor")
+    if op is None:
+        op = Average
+    if not _spmd():
+        out = tensor.coalesce()
+        return _local_handle(out)
+    t = tensor.coalesce()
+    idx_np = t.indices().cpu().numpy().T.astype(np.int64)  # (nnz, ndim)
+    values_like = t.values()
+    val_np, val_bf16 = _to_np(values_like)  # bf16 rides as fp32
+    nm = name or "sparse_allreduce"
+    h_idx = _c.allgather_async(idx_np, name=f"{nm}.idx",
+                               process_set=process_set)
+    h_val = _c.allgather_async(val_np, name=f"{nm}.val",
+                               process_set=process_set)
+    world = size()
+    shape = list(t.shape)
+
+    def resolve():
+        all_idx = np.asarray(_c.synchronize(h_idx))
+        all_val = np.asarray(_c.synchronize(h_val))
+        idx_t = torch.from_numpy(
+            np.ascontiguousarray(all_idx.T)).to(tensor.device)
+        # _from_np restores the original value dtype (bf16/f64) + device.
+        val_t = _from_np(all_val, values_like, val_bf16)
+        out = torch.sparse_coo_tensor(idx_t, val_t, size=shape).coalesce()
+        if op == Average:
+            out = torch.sparse_coo_tensor(out.indices(),
+                                          out.values() / world,
+                                          size=shape).coalesce()
+        return out
+
+    return _LazyHandle(resolve)
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,

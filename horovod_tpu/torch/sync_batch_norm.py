@@ -144,9 +144,12 @@ def convert_sync_batchnorm(module, process_set=global_process_set):
                             module.track_running_stats,
                             process_set=process_set)
         if module.affine:
-            with torch.no_grad():
-                out.weight.copy_(module.weight)
-                out.bias.copy_(module.bias)
+            # Reuse the ORIGINAL Parameters by reference: optimizers
+            # already holding them keep updating the right tensors, and
+            # device placement is preserved (torch's own
+            # convert_sync_batchnorm does the same).
+            out.weight = module.weight
+            out.bias = module.bias
         out.running_mean = module.running_mean
         out.running_var = module.running_var
         out.num_batches_tracked = module.num_batches_tracked

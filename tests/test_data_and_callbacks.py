@@ -133,3 +133,52 @@ def test_metric_average_single_mode_noop(hvd):
     logs = {"loss": 1.5, "acc": 0.5}
     cb.on_epoch_end(0, logs)  # single-controller mode: no processes
     assert logs == {"loss": 1.5, "acc": 0.5}
+
+
+def test_tf_keras_state_save_restore(hvd):
+    keras = _keras()
+    import numpy as np
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+    model = keras.Sequential([keras.layers.Input((3,)),
+                              keras.layers.Dense(2)])
+    model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+    state = TensorFlowKerasState(model, epoch=2)
+    w0 = [np.array(w) for w in model.get_weights()]
+    state.commit()
+
+    model.set_weights([w + 100.0 for w in w0])
+    state.epoch = 7
+    state.restore()
+    assert state.epoch == 2
+    for a, b in zip(model.get_weights(), w0):
+        np.testing.assert_allclose(np.asarray(a), b)
+
+
+def test_keras_elastic_callbacks_commit_cadence(hvd):
+    _keras()
+    from horovod_tpu._keras.elastic import make_elastic_callbacks
+    Commit, UpdBatch, UpdEpoch = make_elastic_callbacks()
+
+    class FakeState:
+        def __init__(self):
+            self.commits = 0
+            self.batch = 0
+            self.epoch = 0
+
+        def commit(self):
+            self.commits += 1
+
+    st = FakeState()
+    commit = Commit(st, batches_per_commit=2)
+    upd_b = UpdBatch(st)
+    upd_e = UpdEpoch(st)
+    for b in range(5):
+        commit.on_train_batch_end(b)
+        upd_b.on_train_batch_end(b)
+    assert st.commits == 2  # batches 1 and 3 (0-indexed)
+    assert st.batch == 5
+    commit.on_epoch_end(0)
+    upd_b.on_epoch_end(0)
+    upd_e.on_epoch_end(0)
+    assert st.commits == 3 and st.batch == 0 and st.epoch == 1

@@ -131,6 +131,20 @@ def main():
     np.testing.assert_allclose(wg.numpy(), bn_ref.weight.grad.numpy(),
                                rtol=1e-3, atol=1e-5)
 
+    # -- sparse allreduce ----------------------------------------------------
+    # Each rank contributes nnz at different rows; the gathered result
+    # sums overlaps and averages (reference: sparse_allreduce_async).
+    idx = torch.tensor([[0, r + 1]], dtype=torch.long)  # (1, nnz=2)
+    vals = torch.tensor([1.0, float(r + 1)])
+    sp = torch.sparse_coo_tensor(idx, vals, size=(8,))
+    h_sp = hvd.sparse_allreduce_async(sp, name="sp")
+    dense = hvd.synchronize(h_sp).to_dense().numpy()
+    expect_sp = np.zeros(8, np.float32)
+    expect_sp[0] = n * 1.0 / n
+    for rr in range(n):
+        expect_sp[rr + 1] += (rr + 1) / n
+    np.testing.assert_allclose(dense, expect_sp, rtol=1e-5)
+
     # -- compression ---------------------------------------------------------
     from horovod_tpu.ops.compression import Compression
     cr = hvd.allreduce(torch.ones(5) * (r + 1), op=hvd.Sum,
