@@ -99,6 +99,38 @@ def main():
     for w in all_w2[1:]:
         np.testing.assert_allclose(w, all_w2[0], rtol=1e-5)
 
+    # -- SyncBatchNormalization: global-batch stats + synced backward ------
+    from horovod_tpu.tensorflow.sync_batch_norm import \
+        SyncBatchNormalization
+    full = np.random.RandomState(6).randn(8, 4).astype(np.float32)
+    shard = tf.constant(full[r::n])
+    sbn = SyncBatchNormalization(momentum=0.9)
+    with tf.GradientTape() as tape:
+        tape.watch(shard)
+        out_bn = sbn(shard, training=True)
+        loss_bn = tf.reduce_sum(out_bn ** 2)
+    dx = tape.gradient(loss_bn, shard)
+
+    # Oracle: plain full-batch normalization with biased variance.
+    mean = full.mean(0)
+    var = full.var(0)
+    xhat = (full - mean) / np.sqrt(var + sbn.epsilon)
+    np.testing.assert_allclose(out_bn.numpy(), xhat[r::n], rtol=1e-4,
+                               atol=1e-5)
+    # Gradient oracle via finite full-batch autograd in tf.
+    ref_in = tf.constant(full)
+    with tf.GradientTape() as tape2:
+        tape2.watch(ref_in)
+        m = tf.reduce_mean(ref_in, 0)
+        v = tf.reduce_mean((ref_in - m) ** 2, 0)
+        ref_out = (ref_in - m) * tf.math.rsqrt(v + sbn.epsilon)
+        ref_loss = tf.reduce_sum(ref_out ** 2)
+    ref_dx = tape2.gradient(ref_loss, ref_in)
+    np.testing.assert_allclose(dx.numpy(), ref_dx.numpy()[r::n],
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(
+        sbn.moving_mean.numpy(), 0.1 * mean, rtol=1e-4, atol=1e-6)
+
     print(f"rank {r}/{n}: TF-BINDING OK", flush=True)
     hvd.shutdown()
 
