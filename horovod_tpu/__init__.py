@@ -47,6 +47,17 @@ from .functions import (  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ObjectState)
 
 
+def __getattr__(name):
+    # horovod_tpu.run(func, num_proc=N) — the reference's programmatic
+    # launcher (horovod/runner/__init__.py:92 ``horovod.run``). Lazy so
+    # importing the package never pulls the runner machinery.
+    if name == "run":
+        from .runner import run
+        return run
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
     """Start recording a Chrome-trace timeline at runtime (reference:
     horovod/common/basics.py:156 start_timeline). ``jax_profiler_dir``

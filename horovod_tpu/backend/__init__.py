@@ -122,6 +122,16 @@ def make_spmd_backend(topology):
             rendezvous.bootstrap_peers(topology)
     cpu_ops = envparse.get_str(envparse.CPU_OPERATIONS, "").lower()
     if cpu_ops in ("xla", "xla-global", "nccl"):
+        if envparse.get_bool(envparse.ELASTIC):
+            # Fail ONCE, before training starts: jax.distributed cannot
+            # re-form in-process after an elastic reset, so every reset
+            # would deterministically fail (and HorovodInternalError would
+            # make the elastic loop burn its retries first).
+            raise NotImplementedError(
+                "elastic jobs cannot use the xla-global data plane: "
+                "jax.distributed cannot re-initialize in-process after a "
+                "membership change. Use HVDTPU_CPU_OPERATIONS=tcp for "
+                "elastic jobs.")
         # Compiled data plane over the jax.distributed global mesh; the
         # TCP core stays as control plane ("nccl" accepted for scripts
         # written against the reference's HOROVOD_CPU_OPERATIONS knob).

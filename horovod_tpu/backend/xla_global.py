@@ -77,6 +77,10 @@ def init_jax_distributed(topology):
     import jax
     try:
         if jax.distributed.is_initialized():
+            # Fresh world pre-initialized by user code: reuse it. (The
+            # elastic + xla combination is rejected once at backend
+            # selection, make_spmd_backend — a stale post-reset world
+            # cannot reach here.)
             return
     except AttributeError:  # older jax
         pass

@@ -31,6 +31,15 @@ def test_keras_binding_torch_backend():
     assert all("KERAS-BINDING OK" in o for o in outs)
 
 
+def test_keras_binding_tensorflow_backend():
+    """Same suite on the TF backend: exercises the tf.function-bridged
+    gradient sync branch of the keras optimizer wrapper."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("keras")
+    outs = _run("keras_worker.py", {"KERAS_BACKEND": "tensorflow"})
+    assert all("KERAS-BINDING OK" in o for o in outs)
+
+
 def test_torch_binding():
     pytest.importorskip("torch")
     outs = _run("torch_worker.py")

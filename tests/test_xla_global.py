@@ -1,6 +1,7 @@
-"""XLA-global data plane tests: 2 processes x 4 virtual devices each, the
-compiled multi-host story the driver's dryrun validates single-process
-(VERDICT round-1 item 4: prove the SPMD data plane is XLA, not sockets)."""
+"""XLA-global data plane tests over 2x4 and 4x2 process-by-device
+topologies — the compiled multi-host story the driver's dryrun validates
+single-process (VERDICT round-1 item 4: prove the SPMD data plane is XLA,
+not sockets)."""
 
 import os
 import socket
@@ -22,14 +23,15 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("size", [2])
-def test_xla_global_static(size):
-    """Static peers (env-fed) + explicit coordinator address."""
+@pytest.mark.parametrize("size,local", [(2, 4), (4, 2)])
+def test_xla_global_static(size, local):
+    """Static peers (env-fed) + explicit coordinator address, at 2x4 and
+    4x2 process-by-device topologies."""
     extra = {
         "HVDTPU_CPU_OPERATIONS": "xla",
         "HVDTPU_XLA_COORD": f"127.0.0.1:{_free_port()}",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "XGW_LOCAL_DEVICES": "4",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={local}",
+        "XGW_LOCAL_DEVICES": str(local),
     }
     codes, outs = launch(size, script=XLA_WORKER, extra_env=extra,
                          timeout=300)

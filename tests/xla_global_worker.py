@@ -97,14 +97,24 @@ def main():
                                sum(r + 1 for r in range(size)))
 
     # -- Adasum: excluded from delegation, runs native VHDD ---------------
-    ada = np.random.RandomState(7).randn(2, 17).astype(np.float32)
-    a, b = ada[0], ada[1]
-    out_ada = np.asarray(hvd.allreduce(jnp.asarray(ada[rank]),
-                                       op=hvd.Adasum, name="ada"))
-    dot, na, nb = float((a * b).sum()), float((a * a).sum()), \
-        float((b * b).sum())
-    expect_ada = (1 - dot / (2 * na)) * a + (1 - dot / (2 * nb)) * b
-    np.testing.assert_allclose(out_ada, expect_ada, rtol=1e-5, atol=1e-6)
+    if size & (size - 1) == 0:  # power-of-two ranks only
+        ada = np.random.RandomState(7).randn(size, 17).astype(np.float32)
+        out_ada = np.asarray(hvd.allreduce(jnp.asarray(ada[rank]),
+                                           op=hvd.Adasum, name="ada"))
+
+        def np_adasum(a, b):
+            dot = float((a * b).sum())
+            na, nb = float((a * a).sum()), float((b * b).sum())
+            ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+            bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+            return ac * a + bc * b
+
+        expect = [ada[i] for i in range(size)]
+        while len(expect) > 1:
+            expect = [np_adasum(expect[i], expect[i + 1])
+                      for i in range(0, len(expect), 2)]
+        np.testing.assert_allclose(out_ada, expect[0], rtol=1e-5,
+                                   atol=1e-6)
 
     # -- barrier + alltoall still ride the native TCP plane ---------------
     hvd.barrier()
