@@ -110,9 +110,21 @@ def make_spmd_backend(topology):
     """Pick the SPMD data plane like the reference picks its op chain
     (reference: horovod/common/operations.cc:144-253 CreateOperationManager).
     """
+    from ..utils import envparse
+    # The elastic + xla-global rejection must precede the size==1 early
+    # return: an elastic job can START at size 1 (Loopback) and only hit
+    # the xla path on its first scale-up reset — failing then would be
+    # the deferred mid-training crash this check exists to prevent.
+    cpu_ops = envparse.get_str(envparse.CPU_OPERATIONS, "").lower()
+    if cpu_ops in ("xla", "xla-global", "nccl") and \
+            envparse.get_bool(envparse.ELASTIC):
+        raise NotImplementedError(
+            "elastic jobs cannot use the xla-global data plane: "
+            "jax.distributed cannot re-initialize in-process after a "
+            "membership change. Use HVDTPU_CPU_OPERATIONS=tcp for "
+            "elastic jobs.")
     if topology.size == 1:
         return LoopbackBackend()
-    from ..utils import envparse
     if not envparse.get_str(envparse.PEERS, ""):
         # Launcher-spawned worker: discover peers through the driver's KV
         # rendezvous (reference: gloo_context.cc:150-228 bootstrapping from
@@ -120,18 +132,7 @@ def make_spmd_backend(topology):
         from ..runner import rendezvous
         if rendezvous.rendezvous_config() is not None:
             rendezvous.bootstrap_peers(topology)
-    cpu_ops = envparse.get_str(envparse.CPU_OPERATIONS, "").lower()
     if cpu_ops in ("xla", "xla-global", "nccl"):
-        if envparse.get_bool(envparse.ELASTIC):
-            # Fail ONCE, before training starts: jax.distributed cannot
-            # re-form in-process after an elastic reset, so every reset
-            # would deterministically fail (and HorovodInternalError would
-            # make the elastic loop burn its retries first).
-            raise NotImplementedError(
-                "elastic jobs cannot use the xla-global data plane: "
-                "jax.distributed cannot re-initialize in-process after a "
-                "membership change. Use HVDTPU_CPU_OPERATIONS=tcp for "
-                "elastic jobs.")
         # Compiled data plane over the jax.distributed global mesh; the
         # TCP core stays as control plane ("nccl" accepted for scripts
         # written against the reference's HOROVOD_CPU_OPERATIONS knob).

@@ -1,0 +1,95 @@
+"""Disk checkpointing helpers (TPU value-add).
+
+The reference has no checkpoint engine of its own — elastic State objects
+are in-memory and disk persistence is left to user code / Keras callbacks
+(SURVEY §5.4). On TPU the idiomatic store is orbax; these helpers add the
+distributed etiquette around it: rank-0-only writes, a barrier so no rank
+races ahead of an in-flight save, and restore-then-broadcast so every
+rank starts from identical bytes.
+
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint as ckpt
+
+    ckpt.save(path, {"params": params, "opt": opt_state, "epoch": 3})
+    state = ckpt.restore(path)               # broadcast from rank 0
+    state = ckpt.restore_latest(directory)   # newest step under directory
+"""
+
+import os
+
+from . import basics
+from .functions import broadcast_object
+from .ops.collectives import barrier
+
+
+def _spmd():
+    rt = basics.runtime()
+    return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
+
+
+def _rank():
+    return basics.runtime().topology.rank
+
+
+def save(path, state):
+    """Write ``state`` (a pytree) at ``path``; rank 0 writes, everyone
+    waits at a barrier so no rank resumes training against a half-written
+    checkpoint."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(str(path))
+    if not _spmd() or _rank() == 0:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, state, force=True)
+    if _spmd():
+        barrier()
+
+
+def restore(path, target=None):
+    """Load a checkpoint. In SPMD mode rank 0 reads the bytes and
+    broadcasts — one storage read per job, identical state everywhere
+    (the elastic sync-from-survivor pattern applied to disk)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(str(path))
+    state = None
+    if not _spmd() or _rank() == 0:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            state = ckptr.restore(path, item=target)
+    if _spmd():
+        state = broadcast_object(state, root_rank=0, name="ckpt.restore")
+    return state
+
+
+def save_step(directory, step, state):
+    """Save under ``directory/step_<N>`` (monotonic step layout)."""
+    save(os.path.join(str(directory), f"step_{step}"), state)
+
+
+def latest_step(directory):
+    """Highest step with a checkpoint under ``directory``, or None."""
+    directory = str(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[5:]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def restore_latest(directory, target=None):
+    """Restore the newest ``step_<N>`` checkpoint; returns (step, state)
+    or (None, None) when the directory holds none."""
+    step = latest_step(directory)
+    if _spmd():
+        # All ranks must agree on which step to load (a rank may race a
+        # concurrent save when listing).
+        step = broadcast_object(step, root_rank=0, name="ckpt.latest")
+    if step is None:
+        return None, None
+    return step, restore(os.path.join(str(directory), f"step_{step}"),
+                         target=target)

@@ -75,3 +75,26 @@ def test_timeline_with_jax_profiler(hvd, tmp_path):
     # The profiler wrote its plugin directory structure.
     found = any("plugins" in dirs for _, dirs, _f in os.walk(profdir))
     assert found, list(os.walk(profdir))
+
+
+def test_checkpoint_save_restore(hvd, tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu import checkpoint as ckpt
+
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+             "epoch": np.asarray(4)}
+    ckpt.save_step(tmp_path, 4, state)
+    ckpt.save_step(tmp_path, 9, {"params": {"w": jnp.ones((2, 3)) * 7},
+                                 "epoch": np.asarray(9)})
+    assert ckpt.latest_step(tmp_path) == 9
+    step, restored = ckpt.restore_latest(tmp_path)
+    assert step == 9
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 7.0)
+    assert int(restored["epoch"]) == 9
+    # Direct restore of the older step.
+    old = ckpt.restore(tmp_path / "step_4")
+    np.testing.assert_allclose(np.asarray(old["params"]["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert ckpt.restore_latest(tmp_path / "empty") == (None, None)
