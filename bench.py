@@ -30,9 +30,10 @@ def _bench_resnet(hvd, hvd_jax, on_tpu):
     from horovod_tpu.models import ResNet50
 
     n = hvd.size()
-    # Batch 256 is the measured throughput peak on v5e (docs/PERF.md:
-    # 64->1482, 128->1977, 256->2149, 512->1102 img/s).
-    per_replica = 256 if on_tpu else 2
+    # Batch 384 is the measured throughput peak on v5e (docs/PERF.md:
+    # 64->1482, 128->1977, 256->2149, 320->2166, 384->2252, 448->2213,
+    # 512->1102 img/s).
+    per_replica = 384 if on_tpu else 2
     image = 224 if on_tpu else 64
     global_batch = n * per_replica
 
