@@ -282,13 +282,36 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
                       process_set=global_process_set):
     if op is None:
         op = Sum if average is False else Average
+    return _grouped_call(
+        tensors, lambda arrs: _c.grouped_allreduce(
+            arrs, op=op, name=name, process_set=process_set))
+
+
+def _grouped_call(tensors, call):
+    """Shared torch<->numpy marshalling for grouped collectives: one
+    place for the dtype/device round-trip (and safe for iterator
+    inputs — materialized before any consumption)."""
+    tensors = list(tensors)
     if not _spmd():
-        return list(tensors)
-    arrs, bf16s = zip(*[_to_np(t) for t in tensors])
-    outs = _c.grouped_allreduce(list(arrs), op=op, name=name,
-                                process_set=process_set)
+        return tensors
+    arrs, bf16s = zip(*[_to_np(t) for t in tensors]) if tensors else ((), ())
+    outs = call(list(arrs))
     return [_from_np(np.asarray(o), t, b)
             for o, t, b in zip(outs, tensors, bf16s)]
+
+
+def grouped_allgather(tensors, name=None,
+                      process_set=global_process_set):
+    return _grouped_call(
+        tensors, lambda arrs: _c.grouped_allgather(
+            arrs, name=name, process_set=process_set))
+
+
+def grouped_reducescatter(tensors, op=None, name=None,
+                          process_set=global_process_set):
+    return _grouped_call(
+        tensors, lambda arrs: _c.grouped_reducescatter(
+            arrs, op=op or Average, name=name, process_set=process_set))
 
 
 def allgather_async(tensor, name=None, process_set=global_process_set):

@@ -116,6 +116,16 @@ def main():
     np.testing.assert_allclose(np.asarray(big_sum[-4:]),
                                sum(r + 1 for r in range(size)))
 
+    # -- many in-flight async ops (fusion + handle stress) -------------------
+    handles = [hvd.allreduce_async(jnp.full((257,), float(i + rank)),
+                                   op=hvd.Sum, name=f"flood.{i}")
+               for i in range(64)]
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(
+            np.asarray(out)[0], sum(i + rr for rr in range(size)),
+            rtol=1e-5)
+
     # -- barrier ------------------------------------------------------------
     hvd.barrier()
 

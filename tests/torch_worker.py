@@ -51,6 +51,19 @@ def main():
     np.testing.assert_allclose(outs[0].numpy(), s)
     np.testing.assert_allclose(outs[1].numpy(), 2.0 * s)
 
+    # -- grouped allgather / reducescatter -----------------------------------
+    gg = hvd.grouped_allgather([torch.full((r + 1, 2), float(r)),
+                                torch.full((1,), float(r))], name="gag")
+    assert gg[0].shape == (sum(i + 1 for i in range(n)), 2)
+    assert gg[1].shape == (n,)
+    np.testing.assert_allclose(gg[1].numpy(),
+                               np.arange(n, dtype=np.float32))
+    grs = hvd.grouped_reducescatter([torch.ones(2 * n, 3) * (r + 1)],
+                                    op=hvd.Sum, name="grs")
+    assert grs[0].shape == (2, 3)
+    np.testing.assert_allclose(grs[0].numpy(),
+                               sum(i + 1 for i in range(n)))
+
     # -- bf16 --------------------------------------------------------------
     bf = hvd.allreduce(torch.ones(4, dtype=torch.bfloat16) * (r + 1),
                        op=hvd.Sum, name="bf16")
