@@ -292,9 +292,11 @@ def _grouped_call(tensors, call):
     place for the dtype/device round-trip (and safe for iterator
     inputs — materialized before any consumption)."""
     tensors = list(tensors)
-    if not _spmd():
+    if not tensors or not _spmd():
+        # Empty groups are a no-op in every mode (an empty bucket would
+        # IndexError inside the backend's group enqueue).
         return tensors
-    arrs, bf16s = zip(*[_to_np(t) for t in tensors]) if tensors else ((), ())
+    arrs, bf16s = zip(*[_to_np(t) for t in tensors])
     outs = call(list(arrs))
     return [_from_np(np.asarray(o), t, b)
             for o, t, b in zip(outs, tensors, bf16s)]

@@ -181,3 +181,23 @@ def test_failed_rank_fails_job():
          "else 0)"],
         num_proc=2, env=_worker_env())
     assert rc == 3
+
+
+def test_run_command_multi_host_topology():
+    """Two distinct 'hosts' (localhost + 127.0.0.1, both local) at one
+    slot each: the launcher's GLOBAL/LOCAL/CROSS slot math must surface in
+    worker topology queries end to end."""
+    from horovod_tpu.runner import run_command
+    script = ("import horovod_tpu as hvd, jax.numpy as jnp, numpy as np; "
+              "hvd.init(); "
+              "assert hvd.size() == 2 and hvd.local_size() == 1, "
+              "(hvd.size(), hvd.local_size()); "
+              "assert hvd.cross_size() == 2, hvd.cross_size(); "
+              "assert hvd.cross_rank() == hvd.rank(), "
+              "(hvd.cross_rank(), hvd.rank()); "
+              "out = hvd.allreduce(jnp.ones(2), op=hvd.Sum, name='m'); "
+              "np.testing.assert_allclose(np.asarray(out), 2.0); "
+              "print('MULTIHOST-OK', hvd.rank())")
+    rc = run_command([sys.executable, "-c", script], num_proc=2,
+                     hosts="localhost:1,127.0.0.1:1", env=_worker_env())
+    assert rc == 0
