@@ -19,7 +19,7 @@ import threading
 import jax.numpy as jnp
 
 from .. import basics
-from ..coordinator import TensorEntry
+from ..coordinator import Handle, TensorEntry
 from ..process_sets import global_process_set
 from . import reduce_ops
 from .compression import Compression
@@ -86,6 +86,14 @@ allreduce_async_ = allreduce_async
 allreduce_ = allreduce
 
 
+def _empty_group_handle(kind):
+    """Completed no-op handle for an empty group: an empty bucket must
+    never reach the coordinator (fused execution indexes arrays[0])."""
+    h = Handle(_auto_name(f"{kind}.empty"))
+    h._complete([])
+    return h
+
+
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0,
                             process_set=global_process_set):
@@ -94,6 +102,8 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
     group_table.cc semantics)."""
     op = reduce_ops.handle_average_backwards_compatibility(op, average)
     arrays = [jnp.asarray(t) for t in tensors]
+    if not arrays:
+        return _empty_group_handle("grouped_allreduce")
     for a in arrays:
         _check_stacked(a, process_set, "grouped_allreduce")
     entry = TensorEntry(name or _auto_name("grouped_allreduce"), "allreduce",
@@ -159,6 +169,8 @@ def allgather(tensor, name=None, process_set=global_process_set):
 def grouped_allgather_async(tensors, name=None,
                             process_set=global_process_set):
     arrays = [jnp.asarray(t) for t in tensors]
+    if not arrays:
+        return _empty_group_handle("grouped_allgather")
     for a in arrays:
         _check_stacked(a, process_set, "grouped_allgather")
     entry = TensorEntry(name or _auto_name("grouped_allgather"), "allgather",
@@ -254,6 +266,8 @@ def reducescatter(tensor, op=reduce_ops.Average, name=None,
 def grouped_reducescatter_async(tensors, op=reduce_ops.Average, name=None,
                                 process_set=global_process_set):
     arrays = [jnp.asarray(t) for t in tensors]
+    if not arrays:
+        return _empty_group_handle("grouped_reducescatter")
     for a in arrays:
         _check_stacked(a, process_set, "grouped_reducescatter")
     entry = TensorEntry(name or _auto_name("grouped_reducescatter"),

@@ -302,3 +302,13 @@ def test_error_propagation_unknown_op(hvd, n_devices):
 def test_stacked_shape_validation(hvd, n_devices):
     with pytest.raises(ValueError):
         hvd.allreduce(np.zeros((n_devices + 1, 3), dtype=np.float32))
+
+
+def test_empty_grouped_ops_are_noops(hvd):
+    """Empty groups complete as [] without touching the coordinator (an
+    empty fused bucket would IndexError in cycle execution)."""
+    assert hvd.grouped_allreduce([]) == []
+    assert hvd.grouped_allgather([]) == []
+    assert hvd.grouped_reducescatter([]) == []
+    h = hvd.grouped_allreduce_async([])
+    assert h.poll() and hvd.synchronize(h) == []

@@ -57,3 +57,25 @@ def test_xla_global_through_hvdrun():
     rc = run_command([sys.executable, XLA_WORKER], num_proc=2, env=env,
                      start_timeout=180)
     assert rc == 0
+
+
+def test_elastic_rejects_xla_plane():
+    """Elastic + xla-global must fail at launch with guidance (not on the
+    first scale-up reset): jax.distributed cannot re-form in-process."""
+    import subprocess
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(HERE),
+        "HVDTPU_CPU_OPERATIONS": "xla",
+        "HVDTPU_ELASTIC": "1",
+        "HVDTPU_RANK": "0", "HVDTPU_SIZE": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import horovod_tpu as hvd; hvd.init()"],
+        env=env, capture_output=True, timeout=120)
+    assert proc.returncode != 0
+    err = proc.stderr.decode()
+    assert "elastic jobs cannot use the xla-global data plane" in err, err
