@@ -1,0 +1,106 @@
+"""PyTorch BERT pretraining benchmark: masked-LM samples/s through the
+torch binding's grad-hook DistributedOptimizer (reference:
+examples/pytorch/pytorch_synthetic_benchmark.py structure; model target
+is BASELINE config #3, "BERT-large pretraining, examples/pytorch").
+
+The model comes from the local `transformers` package built from a config
+(no weight download); `--large` selects true BERT-large dimensions
+(1024h/24L/16heads). Torch in this image is CPU-only, so this benchmarks
+the binding + collective path; the TPU-resident BERT-dims number comes
+from bench.py's transformer line.
+
+Run:  hvdrun -np 2 python examples/pytorch_bert_benchmark.py
+      hvdrun -np 2 python examples/pytorch_bert_benchmark.py --large
+"""
+
+import argparse
+import os
+import sys
+import timeit
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu.torch as hvd
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--large", action="store_true",
+                   help="true BERT-large dims (slow on CPU)")
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--num-batches-per-iter", type=int, default=2)
+    p.add_argument("--num-iters", type=int, default=3)
+    return p.parse_args()
+
+
+def build_model(args):
+    from transformers import BertConfig, BertForMaskedLM
+    if args.large:
+        cfg = BertConfig(hidden_size=1024, num_hidden_layers=24,
+                         num_attention_heads=16, intermediate_size=4096,
+                         max_position_embeddings=max(512, args.seq_len))
+    else:  # CI-sized stand-in with the same architecture
+        cfg = BertConfig(hidden_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, intermediate_size=512,
+                         vocab_size=1024,
+                         max_position_embeddings=max(128, args.seq_len))
+    return BertForMaskedLM(cfg), cfg
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    torch.manual_seed(42)
+
+    model, cfg = build_model(args)
+    optimizer = torch.optim.AdamW(model.parameters(),
+                                  lr=1e-4 * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    rng = np.random.RandomState(42 + hvd.rank())
+    tokens = torch.from_numpy(
+        rng.randint(0, cfg.vocab_size,
+                    size=(args.batch_size, args.seq_len)))
+    # 15% of positions carry an MLM label; the rest are ignored (-100).
+    labels = tokens.clone()
+    labels[torch.from_numpy(rng.uniform(
+        size=labels.shape) > 0.15)] = -100
+
+    model.train()
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = model(input_ids=tokens, labels=labels).loss
+        loss.backward()
+        optimizer.step()
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    n_params = sum(p.numel() for p in model.parameters())
+    log(f"BERT {'large' if args.large else 'tiny'}: "
+        f"{n_params / 1e6:.0f}M params, batch {args.batch_size}, "
+        f"seq {args.seq_len}, ranks {hvd.size()}")
+
+    benchmark_step()  # warmup + hook registration
+    samples = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(benchmark_step,
+                          number=args.num_batches_per_iter)
+        sps = args.batch_size * args.num_batches_per_iter / t
+        log(f"Iter: {sps:.2f} samples/sec per rank")
+        samples.append(sps)
+    log(f"Samples/sec per rank: {np.mean(samples):.2f}; total on "
+        f"{hvd.size()} rank(s): {hvd.size() * np.mean(samples):.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""TF2 synthetic benchmark: ResNet-50 img/s with DistributedOptimizer
+(reference: examples/tensorflow2/tensorflow2_synthetic_benchmark.py —
+same structure: keras.applications model, synthetic data, timed batches).
+
+This is BASELINE config #2 ("ResNet-50 ImageNet, TF2 DistributedOptimizer")
+runnable end to end. TF has no TPU tunnel in this image, so it benchmarks
+the binding's collective plumbing on CPU; the TPU-resident ResNet number
+comes from bench.py's JAX path.
+
+Run:  hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py \
+          --model ResNet50 --batch-size 32
+Smoke (tiny, CI-sized):
+      hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py --tiny
+"""
+
+import argparse
+import os
+import sys
+import timeit
+
+import numpy as np
+import tensorflow as tf
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu.tensorflow as hvd
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="ResNet50",
+                   help="any tf.keras.applications model name")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny conv net + 32px images (CI smoke)")
+    return p.parse_args()
+
+
+def build_model(args):
+    if args.tiny:
+        return tf.keras.Sequential([
+            tf.keras.layers.Conv2D(8, 3, activation="relu",
+                                   input_shape=(32, 32, 3)),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(10),
+        ]), 32
+    cls = getattr(tf.keras.applications, args.model)
+    return cls(weights=None), 224
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+
+    model, image = build_model(args)
+    opt = tf.optimizers.SGD(0.01 * hvd.size())
+    # Wrap with gradient averaging across ranks (reference pattern).
+    opt = hvd.DistributedOptimizer(opt)
+    loss_fn = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    rng = np.random.RandomState(42 + hvd.rank())
+    data = tf.constant(
+        rng.uniform(size=(args.batch_size, image, image, 3)),
+        dtype=tf.float32)
+    target = tf.constant(
+        rng.randint(0, 10 if args.tiny else 1000,
+                    size=(args.batch_size,)), dtype=tf.int64)
+
+    @tf.function
+    def benchmark_step(first_batch):
+        with tf.GradientTape() as tape:
+            probs = model(data, training=True)
+            loss = loss_fn(target, probs)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {'tiny' if args.tiny else args.model}")
+    log(f"Batch size: {args.batch_size}, ranks: {hvd.size()}")
+
+    benchmark_step(first_batch=True)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    hvd.broadcast_variables(opt.variables, root_rank=0)
+    timeit.timeit(lambda: benchmark_step(first_batch=False),
+                  number=args.num_warmup_batches)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(lambda: benchmark_step(first_batch=False),
+                          number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        log(f"Iter: {img_sec:.1f} img/sec per rank")
+        img_secs.append(img_sec)
+
+    img_sec_mean = np.mean(img_secs)
+    log(f"Img/sec per rank: {img_sec_mean:.1f} +- "
+        f"{1.96 * np.std(img_secs):.1f}")
+    log(f"Total img/sec on {hvd.size()} rank(s): "
+        f"{hvd.size() * img_sec_mean:.1f}")
+
+
+if __name__ == "__main__":
+    main()

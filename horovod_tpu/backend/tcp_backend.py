@@ -178,7 +178,7 @@ class TcpBackend(Backend):
                     red_op=red, prescale=pre,
                     postscale=post * post_extra)
                     for i, a in enumerate(arrays)]
-                return _Pending(entry, handles, _unpack_list(arrays))
+                return _Pending(entry, handles, _unpack_list_shaped(arrays))
             flat = np.concatenate([a.reshape(-1) for a in arrays])
             h = self._native_enqueue(
                 ps, entry.name, native.REQ_ALLREDUCE, flat, red_op=red,
@@ -204,7 +204,10 @@ class TcpBackend(Backend):
                 handles.append(self._native_enqueue(
                     ps, nm, native.REQ_BROADCAST, a,
                     root_rank=entry.root_rank))
-            return _Pending(entry, handles, _unpack_list(arrays))
+            # Shape-preserving unpack: broadcast output shape == input
+            # shape, and the native wire drops 0-d shapes (c_api.cc keeps
+            # shape only for ndim > 0), so scalars would come back (1,).
+            return _Pending(entry, handles, _unpack_list_shaped(arrays))
 
         if kind == "alltoall":
             a = np.asarray(entry.arrays[0])
@@ -443,6 +446,20 @@ def _unpack_list(arrays):
     def unpack(core, handles):
         outs = [_to_jax(core.output(h, dt))
                 for h, dt in zip(handles, dtypes)]
+        return outs if len(outs) > 1 else outs[0]
+    return unpack
+
+
+def _unpack_list_shaped(arrays):
+    """Like _unpack_list, but reshapes each output to its input's shape —
+    for ops whose output shape equals the input shape (broadcast), where
+    the native wire cannot represent 0-d shapes."""
+    dtypes = [a.dtype for a in arrays]
+    shapes = [a.shape for a in arrays]
+
+    def unpack(core, handles):
+        outs = [_to_jax(core.output(h, dt).reshape(shape))
+                for h, dt, shape in zip(handles, dtypes, shapes)]
         return outs if len(outs) > 1 else outs[0]
     return unpack
 

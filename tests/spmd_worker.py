@@ -84,6 +84,13 @@ def main():
     out = hvd.broadcast(b, root_rank=min(1, size - 1), name="bc")
     np.testing.assert_allclose(np.asarray(out), float(min(1, size - 1)))
 
+    # 0-d broadcast keeps its shape (regression: the native wire only
+    # carries ndim>0 shapes, so scalars came back as (1,)).
+    sc = hvd.broadcast(jnp.asarray(float(rank), jnp.float32),
+                       root_rank=0, name="bc.scalar")
+    assert sc.shape == (), sc.shape
+    np.testing.assert_allclose(np.asarray(sc), 0.0)
+
     # -- broadcast_object ---------------------------------------------------
     obj = {"rank": rank, "payload": list(range(10))}
     got = hvd.broadcast_object(obj, root_rank=0, name="bo")
@@ -153,10 +160,15 @@ def main():
         np.testing.assert_allclose(np.asarray(out), expect[0], rtol=1e-5,
                                    atol=1e-6)
         # Grouped adasum reduces PER TENSOR (never concat-fused: the dot
-        # coefficients are per-tensor).
+        # coefficients are per-tensor). A 0-d member checks the grouped
+        # path preserves scalar shapes (the wire only carries ndim>0).
         gouts = hvd.grouped_allreduce(
             [jnp.asarray(ada_vecs[rank]), jnp.asarray(ada_vecs[rank] * 3.0)],
             op=hvd.Adasum, name="gada")
+        g0 = hvd.grouped_allreduce(
+            [jnp.asarray(np.float32(1.0 + rank))], op=hvd.Adasum,
+            name="gada0")
+        assert g0[0].shape == (), g0[0].shape
         for scale, gout in zip((1.0, 3.0), gouts):
             ge = [v * scale for v in ada_vecs]
             while len(ge) > 1:

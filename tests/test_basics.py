@@ -56,6 +56,23 @@ def test_not_initialized_error():
         basics._runtime = saved
 
 
+def test_empty_grouped_ops_check_liveness():
+    """A dynamically-empty grouped collective must still surface a dead
+    runtime instead of silently succeeding."""
+    import horovod_tpu as hvd
+    import horovod_tpu.basics as basics
+    from horovod_tpu.exceptions import NotInitializedError
+    saved = basics._runtime
+    basics._runtime = None
+    try:
+        with pytest.raises(NotInitializedError):
+            hvd.grouped_allreduce([])
+        with pytest.raises(NotInitializedError):
+            hvd.grouped_allgather_async([])
+    finally:
+        basics._runtime = saved
+
+
 def test_timeline_with_jax_profiler(hvd, tmp_path):
     """start_timeline with jax_profiler_dir captures a device trace
     alongside the chrome-trace host timeline."""
