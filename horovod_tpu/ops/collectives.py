@@ -89,9 +89,10 @@ allreduce_ = allreduce
 def _empty_group_handle(kind):
     """Completed no-op handle for an empty group: an empty bucket must
     never reach the coordinator (fused execution indexes arrays[0]).
-    Still checks runtime liveness so a dynamically-empty bucket cannot
-    mask ops issued before init() or after shutdown()."""
-    basics.runtime().check_alive()
+    Still checks runtime liveness (runtime() raises both before init()
+    and after shutdown()) so a dynamically-empty bucket cannot mask a
+    dead runtime."""
+    basics.runtime()
     h = Handle(_auto_name(f"{kind}.empty"))
     h._complete([])
     return h

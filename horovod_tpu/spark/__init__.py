@@ -1,13 +1,20 @@
 """Spark integration: run horovod_tpu training inside Spark executors
-(reference: horovod/spark/runner.py:197 ``horovod.spark.run``).
+(reference: horovod/spark/runner.py:197 ``horovod.spark.run``), plus the
+ML Estimator layer (``KerasEstimator``/``KerasModel``/``Store``,
+reference: horovod/spark/keras/estimator.py:88 + common/store.py).
 
-Thin by design: Spark provides placement and the barrier stage; the
-rendezvous and topology machinery is the shared cluster core
-(runner/cluster.py). Requires pyspark (not bundled in TPU images — the
-adapter gates with a clear error).
+Spark provides placement and the barrier stage; rendezvous and topology
+are the shared cluster core (runner/cluster.py), and the estimator's
+training loop (``fit_on_parquet``) is Spark-free — only DataFrame
+materialization and ``transform`` require pyspark (not bundled in TPU
+images; those entry points gate with a clear error).
 
     import horovod_tpu.spark as hvd_spark
     results = hvd_spark.run(train_fn, args=(lr,), num_proc=4)
+
+    est = hvd_spark.KerasEstimator(model=m, store=hvd_spark.Store.create(
+        "/mnt/run"), loss="mse", feature_cols=["x"], label_cols=["y"])
+    keras_model = est.fit(df)
 """
 
 from ..runner.cluster import ClusterJob, cluster_task_bootstrap
@@ -64,4 +71,18 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
     return [r for _, r in sorted(pairs)]
 
 
-__all__ = ["run", "ClusterJob", "cluster_task_bootstrap"]
+__all__ = ["run", "ClusterJob", "cluster_task_bootstrap", "Store",
+           "LocalStore", "KerasEstimator", "KerasModel", "fit_on_parquet"]
+
+
+def __getattr__(name):
+    # Estimator/store symbols lazily: they pull fsspec/pyarrow/keras,
+    # which the plain run() path does not need (and which stay optional
+    # dependencies — see pyproject [project.optional-dependencies]).
+    if name in ("Store", "LocalStore"):
+        from . import store as _store_mod
+        return getattr(_store_mod, name)
+    if name in ("KerasEstimator", "KerasModel", "fit_on_parquet"):
+        from . import keras as _keras_mod
+        return getattr(_keras_mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,46 @@
+"""Worker for the estimator training-loop test: runs fit_on_parquet as
+one rank of an np=2 job (launched by test_spark_estimator.py). The same
+function body is what KerasEstimator.fit executes inside Spark barrier
+tasks — this harness proves the loop needs no Spark."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import keras
+    import numpy as np
+
+    from horovod_tpu.spark.keras import fit_on_parquet
+
+    keras.utils.set_random_seed(int(os.environ["HVDTPU_RANK"]) + 1)
+    # Deliberately rank-divergent init: BroadcastGlobalVariablesCallback
+    # must sync rank 0's weights before step 1.
+    model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
+    from horovod_tpu.spark.keras import serialize_model
+
+    history = fit_on_parquet(
+        store_prefix=os.environ["STORE_PREFIX"],
+        run_id="testrun",
+        model_bytes=serialize_model(model),
+        feature_cols=["features"],
+        label_cols=["label"],
+        batch_size=16,
+        epochs=5,
+        optimizer={"class_name": "Adam",
+                   "config": {"learning_rate": 0.05}},
+        loss="mse",
+        validation=0.25,
+    )
+    assert history["loss"][-1] < history["loss"][0], history
+    assert "val_loss" in history, list(history)
+    print("HISTORY " + json.dumps(history), flush=True)
+
+
+if __name__ == "__main__":
+    main()

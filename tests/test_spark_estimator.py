@@ -1,0 +1,148 @@
+"""Estimator-layer tests: Store, parquet sharding, and the worker-side
+training loop at np=2 (reference test analog: test/integration/
+test_spark_keras.py, minus the Spark session — the loop itself is
+Spark-free by design)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from horovod_tpu.spark.data import ParquetShard, shard_files
+from horovod_tpu.spark.store import LocalStore, Store
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_store_layout(tmp_path):
+    store = Store.create(str(tmp_path))
+    assert store.get_train_data_path().endswith("intermediate_train_data")
+    assert store.get_train_data_path(2).endswith(
+        "intermediate_train_data.2")
+    assert store.get_checkpoint_path("r1").endswith(
+        "runs/r1/checkpoint.keras")
+    assert store.get_logs_path("r1").endswith("runs/r1/logs")
+
+
+def test_store_read_write_roundtrip(tmp_path):
+    store = Store.create(str(tmp_path))
+    p = store.get_checkpoint_path("abc")
+    assert not store.exists(p)
+    store.write(p, b"\x00weights\x01")
+    assert store.exists(p)
+    assert store.read(p) == b"\x00weights\x01"
+    store.write_text(store.get_logs_path("abc") + "/note.txt", "hi")
+    assert store.read_text(store.get_logs_path("abc") + "/note.txt") == "hi"
+
+
+def test_store_file_url_and_dbfs_rewrite(tmp_path):
+    s = Store.create(f"file://{tmp_path}")
+    s.write_text(s.get_run_path("x") + "/a.txt", "ok")
+    assert (tmp_path / "runs" / "x" / "a.txt").read_text() == "ok"
+    d = Store.create("dbfs:/foo/bar")
+    assert d.prefix_path == "file:///dbfs/foo/bar"
+
+
+def _write_parquet_dataset(path, n_files=4, rows_per_file=32, seed=0):
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    w = np.array([1.0, -2.0, 3.0, 0.5], np.float64)
+    for i in range(n_files):
+        x = rng.uniform(-1, 1, size=(rows_per_file, 4))
+        y = x @ w + 1.0
+        table = pa.table({
+            "features": pa.array(list(x),
+                                 type=pa.list_(pa.float64())),
+            "label": pa.array(y),
+        })
+        pq.write_table(table, os.path.join(path, f"part-{i}.parquet"))
+
+
+def test_shard_files_disjoint_cover():
+    files = [f"f{i}.parquet" for i in range(7)]
+    shards = [shard_files(files, r, 3) for r in range(3)]
+    flat = sorted(f for s in shards for f in s)
+    assert flat == sorted(files)
+    assert all(shards)
+    with pytest.raises(ValueError):
+        shard_files(files[:2], 0, 3)
+
+
+def test_parquet_shard_reads_list_columns(tmp_path):
+    store = LocalStore(str(tmp_path))
+    data_path = store.get_train_data_path()
+    _write_parquet_dataset(data_path, n_files=3, rows_per_file=10)
+    files = store.list_parquet_files(data_path)
+    assert len(files) == 3
+    shard = ParquetShard(store, files[:2], ["features", "label"])
+    assert shard.num_rows == 20
+    batch = next(shard.batches(8, seed=1))
+    assert batch["label"].shape == (8,)
+    feats = np.stack([np.asarray(v) for v in batch["features"]])
+    assert feats.shape == (8, 4)
+
+
+def test_parquet_shard_batches_cycle(tmp_path):
+    store = LocalStore(str(tmp_path))
+    data_path = store.get_train_data_path()
+    _write_parquet_dataset(data_path, n_files=1, rows_per_file=5)
+    shard = ParquetShard(store, store.list_parquet_files(data_path),
+                         ["label"])
+    gen = shard.batches(16, seed=0)  # batch > shard: whole-shard batches
+    b1, b2 = next(gen), next(gen)
+    assert len(b1["label"]) == 5 and len(b2["label"]) == 5
+
+
+def test_fit_on_parquet_np2(tmp_path):
+    """The estimator's executor body trains at np=2 under plain process
+    spawn: loss decreases, metrics average, rank 0 checkpoints, and the
+    restored transformer predicts the linear target."""
+    from tests.test_spmd import free_ports
+
+    store = Store.create(str(tmp_path))
+    _write_parquet_dataset(store.get_train_data_path(), n_files=4,
+                           rows_per_file=64)
+
+    size = 2
+    ports = free_ports(size)
+    peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.update({
+            "HVDTPU_RANK": str(rank), "HVDTPU_SIZE": str(size),
+            "HVDTPU_LOCAL_RANK": str(rank),
+            "HVDTPU_LOCAL_SIZE": str(size),
+            "HVDTPU_CROSS_RANK": "0", "HVDTPU_CROSS_SIZE": "1",
+            "HVDTPU_PEERS": peers, "JAX_PLATFORMS": "cpu",
+            "STORE_PREFIX": str(tmp_path),
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "spark_fit_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+
+    hists = [json.loads(line.split("HISTORY ", 1)[1])
+             for out in outs for line in out.splitlines()
+             if line.startswith("HISTORY ")]
+    assert len(hists) == size
+    # MetricAverageCallback: averaged epoch metrics agree across ranks.
+    np.testing.assert_allclose(hists[0]["loss"], hists[1]["loss"],
+                               rtol=1e-4)
+
+    from horovod_tpu.spark.keras import KerasEstimator
+    km = KerasEstimator.load(store, "testrun",
+                             feature_cols=["features"],
+                             label_cols=["label"])
+    assert store.exists(store.get_checkpoint_path("testrun"))
+    x = np.zeros((3, 4))
+    preds = km.predict([x])
+    assert preds.shape == (3, 1)
