@@ -89,7 +89,8 @@ def _bench_resnet(hvd, hvd_jax, on_tpu):
     }
 
 
-def _bench_transformer(hvd, hvd_jax, on_tpu):
+def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
+                       metric=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -98,17 +99,18 @@ def _bench_transformer(hvd, hvd_jax, on_tpu):
     from horovod_tpu.models import TransformerLM, TransformerConfig
 
     n = hvd.size()
-    seq = 512 if on_tpu else 64
-    batch = (16 if on_tpu else 2) * n
+    seq = seq_tpu if on_tpu else 64
+    batch = (batch_tpu if on_tpu else 2) * n
     # BERT-large dimensions as a causal decoder LM (the reference's BERT
-    # target, BASELINE.md): 365M params. einsum attention wins at seq 512
-    # (XLA's fused softmax-attention); the pallas flash kernel is the
-    # long-context path — at seq 2048 einsum OOMs 27G>15.75G HBM while
-    # flash runs (docs/PERF.md).
+    # target, BASELINE.md): 365M params. The pallas flash kernel (causal
+    # block-skip + 256-tiles) now beats XLA's fused einsum attention at
+    # seq 512 (75.0 vs 71.6 samples/s): skipping above-diagonal tiles
+    # halves attention FLOPs and the freed O(s^2) logits memory admits
+    # batch 24 without remat (docs/PERF.md sweep).
     if on_tpu:
         cfg = TransformerConfig(vocab_size=30522, hidden=1024, layers=24,
                                 heads=16, max_len=seq, causal=True,
-                                use_rope=True, attention_impl="einsum")
+                                use_rope=True, attention_impl="flash")
     else:
         cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2,
                                 heads=4, max_len=seq, causal=True,
@@ -151,7 +153,8 @@ def _bench_transformer(hvd, hvd_jax, on_tpu):
     flops_per_tok = 6 * n_params + 12 * cfg.layers * seq * cfg.hidden
     mfu = tok_s * flops_per_tok / V5E_BF16_PEAK
     return {
-        "metric": "transformer_lm_365m_seq512_train_samples_per_sec_per_chip",
+        "metric": metric or ("transformer_lm_365m_seq512_train_samples"
+                             "_per_sec_per_chip"),
         "value": round(per_chip, 2),
         "unit": "samples/s/chip",
         # No published reference absolute exists for transformers; report
@@ -177,6 +180,12 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
 
     print(json.dumps(_bench_transformer(hvd, hvd_jax, on_tpu)), flush=True)
+    # Long-context line: seq 2048 is where the einsum path cannot run at
+    # all (27G logits > 15.75G HBM) and the flash kernel carries it.
+    print(json.dumps(_bench_transformer(
+        hvd, hvd_jax, on_tpu, seq_tpu=2048, batch_tpu=4,
+        metric="transformer_lm_365m_seq2048_flash_train_samples"
+               "_per_sec_per_chip")), flush=True)
     # Headline last (the driver records the final line); metric name kept
     # compatible with round 1 for cross-round comparison.
     print(json.dumps(_bench_resnet(hvd, hvd_jax, on_tpu)), flush=True)

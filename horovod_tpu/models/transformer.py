@@ -28,7 +28,10 @@ class TransformerConfig:
     mlp_ratio: int = 4
     max_len: int = 512
     dtype: jnp.dtype = jnp.bfloat16
-    remat: bool = False
+    # False | True/"full" (recompute everything) | "dots" (save matmul
+    # outputs, recompute elementwise — near-free recompute, most of the
+    # memory win; the policy that unlocks larger batches on 16G HBM).
+    remat: object = False
     causal: bool = True
     use_rope: bool = True          # decoder LM; BERT uses learned positions
     attention_impl: str = "einsum"  # 'einsum' | 'flash' (pallas kernel)
@@ -85,9 +88,12 @@ class Attention(nn.Module):
                     "attention_impl='flash' does not support padding "
                     "masks; use 'einsum'")
             from ..ops.flash_attention import flash_attention
+            # 256-tiles measured fastest at long context (median sweep,
+            # docs/PERF.md); _prepare clamps them for short sequences.
             out = flash_attention(
                 q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
-                causal=cfg.causal).swapaxes(1, 2)
+                causal=cfg.causal, block_q=256,
+                block_k=256).swapaxes(1, 2)
         else:
             scale = 1.0 / np.sqrt(head_dim)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -133,7 +139,12 @@ class Backbone(nn.Module):
                            name="pos_embed")(jnp.arange(tokens.shape[1]))
             x = x + pos[None]
         block = Block
-        if cfg.remat:
+        if cfg.remat == "dots":
+            block = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.
+                dots_with_no_batch_dims_saveable)
+        elif cfg.remat:
             block = nn.remat(Block)
         for i in range(cfg.layers):
             x = block(cfg, name=f"block_{i}")(x, mask)
@@ -150,9 +161,11 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, mask=None):
         cfg = self.cfg
         x = Backbone(cfg, name="backbone")(tokens, mask)
-        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+        # bf16 matmul on the MXU (fp32 here costs several passes of MXU
+        # time on a 1024x30k projection), fp32 logits for the softmax.
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
                           name="lm_head")(x)
-        return logits
+        return logits.astype(jnp.float32)
 
 
 class BertModel(nn.Module):
@@ -168,5 +181,5 @@ class BertModel(nn.Module):
         x = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="mlm_dense")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(x)
-        return nn.Dense(cfg.vocab_size, dtype=jnp.float32,
-                        name="mlm_head")(x)
+        return nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                        name="mlm_head")(x).astype(jnp.float32)

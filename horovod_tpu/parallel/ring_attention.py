@@ -41,7 +41,7 @@ def _merge(o1, lse1, o2, lse2):
 
 
 def ring_attention(q, k, v, axis_name="sp", *, causal=True, sm_scale=None,
-                   impl="flash", block_q=128, block_k=128):
+                   impl="flash", block_q=256, block_k=256):
     """Blockwise ring attention (call inside shard_map over ``axis_name``).
 
     Args:

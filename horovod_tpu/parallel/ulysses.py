@@ -15,7 +15,7 @@ from ..ops.flash_attention import flash_attention, reference_attention
 
 
 def ulysses_attention(q, k, v, axis_name="sp", *, causal=True, sm_scale=None,
-                      impl="flash", block_q=128, block_k=128):
+                      impl="flash", block_q=256, block_k=256):
     """Sequence-parallel attention (call inside shard_map over ``axis_name``).
 
     Args:

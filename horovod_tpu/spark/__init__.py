@@ -72,17 +72,21 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
 
 
 __all__ = ["run", "ClusterJob", "cluster_task_bootstrap", "Store",
-           "LocalStore", "KerasEstimator", "KerasModel", "fit_on_parquet"]
+           "LocalStore", "KerasEstimator", "KerasModel", "fit_on_parquet",
+           "TorchEstimator", "TorchModel", "fit_on_parquet_torch"]
 
 
 def __getattr__(name):
-    # Estimator/store symbols lazily: they pull fsspec/pyarrow/keras,
-    # which the plain run() path does not need (and which stay optional
-    # dependencies — see pyproject [project.optional-dependencies]).
+    # Estimator/store symbols lazily: they pull fsspec/pyarrow/keras/
+    # torch, which the plain run() path does not need (and which stay
+    # optional dependencies — see pyproject optional-dependencies).
     if name in ("Store", "LocalStore"):
         from . import store as _store_mod
         return getattr(_store_mod, name)
     if name in ("KerasEstimator", "KerasModel", "fit_on_parquet"):
         from . import keras as _keras_mod
         return getattr(_keras_mod, name)
+    if name in ("TorchEstimator", "TorchModel", "fit_on_parquet_torch"):
+        from . import torch as _torch_mod
+        return getattr(_torch_mod, name)
     raise AttributeError(name)

@@ -12,6 +12,14 @@ import numpy as np
 import pyarrow.parquet as pq
 
 
+def stack_column(col):
+    """Parquet list columns come back as object arrays of arrays; stack
+    them into one dense array (shared by the estimator flavors)."""
+    if col.dtype == object:
+        return np.stack([np.asarray(v) for v in col])
+    return col
+
+
 def shard_files(files, rank, size):
     """Round-robin file assignment; every rank gets >=1 file when
     possible (raises when there are fewer files than ranks — repartition
@@ -51,6 +59,13 @@ class ParquetShard:
         """Infinite batch generator; reshuffles every epoch. Infinite so
         all ranks can run the SAME number of steps per epoch regardless
         of shard-size imbalance (collectives must stay in lockstep)."""
+        if self.num_rows == 0:
+            # Training on empty batches would NaN/raise mid-job while
+            # peers block in the gradient allreduce — fail loudly now.
+            raise ValueError(
+                "shard has 0 training rows (empty part files, or a "
+                "validation split consumed the whole shard); repartition "
+                "the dataset or lower the validation fraction")
         rng = np.random.RandomState(seed)
         while True:
             order = (rng.permutation(self.num_rows) if shuffle

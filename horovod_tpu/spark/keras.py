@@ -15,25 +15,15 @@ the heavy path is fully testable without a Spark cluster.
     hist = fit_on_parquet(...)         # same loop, no Spark needed
 """
 
-import io
 import os
 import tempfile
 import uuid
 
 import numpy as np
 
+from ._transform import check_output_width, require_pyspark, transform_with
+from .data import stack_column as _stack_column
 from .store import Store
-
-
-def _require_pyspark():
-    try:
-        import pyspark  # noqa: F401
-        return pyspark
-    except ImportError as e:
-        raise ImportError(
-            "horovod_tpu.spark.keras requires pyspark for DataFrame "
-            "fit/transform; the parquet training loop (fit_on_parquet) "
-            "works without it.") from e
 
 
 def serialize_model(model):
@@ -53,13 +43,6 @@ def deserialize_model(data, custom_objects=None):
             f.write(data)
         return keras.models.load_model(
             path, custom_objects=custom_objects, compile=False)
-
-
-def _stack_column(col):
-    """Parquet list columns come back as object arrays of arrays."""
-    if col.dtype == object:
-        return np.stack([np.asarray(v) for v in col])
-    return col
 
 
 def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
@@ -157,6 +140,16 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
             history.history.items()}
 
 
+def _materialize_df(df, store, num_proc):
+    """DataFrame -> parquet shards in the store, at least one part file
+    per rank (reference: horovod/spark/common/util.py prepare_data).
+    Shared by the Keras and Torch estimators."""
+    path = store.get_train_data_path()
+    (df.repartition(max(num_proc, df.rdd.getNumPartitions()))
+       .write.mode("overwrite").parquet(path))
+    return path
+
+
 class KerasModel:
     """Trained-model transformer (reference:
     horovod/spark/keras/estimator.py KerasModel): holds the serialized
@@ -177,40 +170,25 @@ class KerasModel:
     def predict(self, features):
         """Local numpy prediction (no Spark needed)."""
         xs = [_stack_column(np.asarray(f)) for f in features]
-        return self.keras_model().predict(
-            xs[0] if len(xs) == 1 else tuple(xs), verbose=0)
+        preds = np.asarray(self.keras_model().predict(
+            xs[0] if len(xs) == 1 else tuple(xs), verbose=0))
+        check_output_width(preds.reshape(len(preds), -1),
+                           self.output_cols)
+        return preds
 
     def transform(self, df):
         """Append prediction columns to a Spark DataFrame via
         mapInPandas (executor-local inference)."""
-        _require_pyspark()
-        import pandas as pd
-        from pyspark.sql.types import DoubleType, StructField, StructType
-
         model_bytes = self.model_bytes
-        feature_cols = self.feature_cols
-        output_cols = self.output_cols
         custom_objects = self.custom_objects
 
-        schema = StructType(df.schema.fields + [
-            StructField(c, DoubleType()) for c in output_cols])
-
-        def infer(iterator):
+        def make_predict():
             model = deserialize_model(model_bytes, custom_objects)
-            for pdf in iterator:
-                xs = [_stack_column(pdf[c].to_numpy())
-                      for c in feature_cols]
-                preds = np.asarray(model.predict(
-                    xs[0] if len(xs) == 1 else tuple(xs), verbose=0))
-                preds = preds.reshape(len(pdf), -1)
-                out = pdf.copy()
-                for i, c in enumerate(output_cols):
-                    col = preds if preds.shape[1] == 1 else preds[:, i:i+1]
-                    out[c] = pd.Series(col.ravel().astype(float),
-                                       index=pdf.index)
-                yield out
+            return lambda feats: model.predict(
+                feats[0] if len(feats) == 1 else tuple(feats), verbose=0)
 
-        return df.mapInPandas(infer, schema=schema)
+        return transform_with(df, self.feature_cols, self.output_cols,
+                              make_predict)
 
 
 class KerasEstimator:
@@ -246,22 +224,14 @@ class KerasEstimator:
         self.train_steps_per_epoch = train_steps_per_epoch
         self.verbose = verbose
 
-    def _materialize(self, df, num_proc):
-        """DataFrame -> parquet shards in the store (reference:
-        horovod/spark/common/util.py prepare_data)."""
-        path = self.store.get_train_data_path()
-        (df.repartition(max(num_proc, df.rdd.getNumPartitions()))
-           .write.mode("overwrite").parquet(path))
-        return path
-
     def fit(self, df):
-        _require_pyspark()
+        require_pyspark("KerasEstimator.fit")
         from . import run as spark_run
         from pyspark import SparkContext
 
         sc = SparkContext.getOrCreate()
         num_proc = self.num_proc or sc.defaultParallelism
-        self._materialize(df, num_proc)
+        _materialize_df(df, self.store, num_proc)
 
         spark_run(
             fit_on_parquet, kwargs=dict(

@@ -1,0 +1,42 @@
+"""Worker for the torch estimator training-loop test (np=2, launched by
+test_spark_estimator.py) — the TorchEstimator.fit executor body without
+Spark."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import torch
+
+    from horovod_tpu.spark.torch import (fit_on_parquet_torch,
+                                         serialize_torch)
+
+    torch.manual_seed(int(os.environ["HVDTPU_RANK"]) + 1)
+    # Rank-divergent init: broadcast_parameters must sync rank 0's.
+    model = torch.nn.Linear(4, 1)
+
+    history = fit_on_parquet_torch(
+        store_prefix=os.environ["STORE_PREFIX"],
+        run_id="torchrun",
+        model_bytes=serialize_torch(model),
+        opt_spec=(torch.optim.Adam, {"lr": 0.05}),
+        loss=lambda out, y: torch.nn.functional.mse_loss(
+            out.squeeze(-1), y.to(out.dtype)),
+        feature_cols=["features"],
+        label_cols=["label"],
+        batch_size=16,
+        epochs=5,
+        validation=0.25,
+    )
+    assert history["loss"][-1] < history["loss"][0], history
+    assert "val_loss" in history, list(history)
+    print("HISTORY " + json.dumps(history), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,17 +98,15 @@ def test_parquet_shard_batches_cycle(tmp_path):
     assert len(b1["label"]) == 5 and len(b2["label"]) == 5
 
 
-def test_fit_on_parquet_np2(tmp_path):
-    """The estimator's executor body trains at np=2 under plain process
-    spawn: loss decreases, metrics average, rank 0 checkpoints, and the
-    restored transformer predicts the linear target."""
+def _run_fit_workers(tmp_path, worker, size=2):
+    """Spawn the estimator executor body as an np=2 job; returns the
+    per-rank HISTORY dicts after asserting success and metric-average
+    agreement across ranks."""
     from tests.test_spmd import free_ports
 
     store = Store.create(str(tmp_path))
     _write_parquet_dataset(store.get_train_data_path(), n_files=4,
                            rows_per_file=64)
-
-    size = 2
     ports = free_ports(size)
     peers = ",".join(f"127.0.0.1:{p}" for p in ports)
     procs = []
@@ -124,7 +122,7 @@ def test_fit_on_parquet_np2(tmp_path):
         })
         env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "spark_fit_worker.py")],
+            [sys.executable, os.path.join(HERE, worker)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     outs = [p.communicate(timeout=300)[0].decode() for p in procs]
     for rank, (p, out) in enumerate(zip(procs, outs)):
@@ -134,15 +132,70 @@ def test_fit_on_parquet_np2(tmp_path):
              for out in outs for line in out.splitlines()
              if line.startswith("HISTORY ")]
     assert len(hists) == size
-    # MetricAverageCallback: averaged epoch metrics agree across ranks.
+    # Metric averaging: per-epoch losses agree across ranks.
     np.testing.assert_allclose(hists[0]["loss"], hists[1]["loss"],
                                rtol=1e-4)
+    return store, hists
+
+
+def test_zero_row_shard_fails_loudly(tmp_path):
+    store = LocalStore(str(tmp_path))
+    path = store.get_train_data_path()
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(pa.table({"label": pa.array([], type=pa.float64())}),
+                   os.path.join(path, "part-0.parquet"))
+    shard = ParquetShard(store, store.list_parquet_files(path), ["label"])
+    with pytest.raises(ValueError, match="0 training rows"):
+        next(shard.batches(8))
+
+
+def test_output_width_mismatch_raises():
+    from horovod_tpu.spark._transform import check_output_width
+    check_output_width(np.zeros((4, 1)), ["a"])
+    check_output_width(np.zeros((4, 3)), ["a", "b", "c"])
+    with pytest.raises(ValueError, match="output components"):
+        check_output_width(np.zeros((4, 10)), ["a"])
+
+
+def test_multi_param_group_optimizer_rejected():
+    import torch
+    from horovod_tpu.spark.torch import _optimizer_spec
+    m = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD([
+        {"params": [m.weight], "lr": 0.1},
+        {"params": [m.bias], "lr": 0.01},
+    ])
+    with pytest.raises(ValueError, match="param-group"):
+        _optimizer_spec(opt)
+    cls, defaults = _optimizer_spec(
+        torch.optim.SGD(m.parameters(), lr=0.1))
+    assert cls is torch.optim.SGD and defaults["lr"] == 0.1
+
+
+def test_fit_on_parquet_np2(tmp_path):
+    """The Keras estimator's executor body trains at np=2 under plain
+    process spawn: loss decreases, metrics average, rank 0 checkpoints,
+    and the restored transformer predicts."""
+    store, _ = _run_fit_workers(tmp_path, "spark_fit_worker.py")
 
     from horovod_tpu.spark.keras import KerasEstimator
     km = KerasEstimator.load(store, "testrun",
                              feature_cols=["features"],
                              label_cols=["label"])
     assert store.exists(store.get_checkpoint_path("testrun"))
-    x = np.zeros((3, 4))
-    preds = km.predict([x])
+    preds = km.predict([np.zeros((3, 4))])
+    assert preds.shape == (3, 1)
+
+
+def test_fit_on_parquet_torch_np2(tmp_path):
+    """Same for the torch estimator body: grad-hook DistributedOptimizer,
+    broadcast init, lockstep steps, averaged history, checkpoint."""
+    store, _ = _run_fit_workers(tmp_path, "spark_torch_fit_worker.py")
+
+    from horovod_tpu.spark.torch import TorchEstimator
+    tm = TorchEstimator.load(store, "torchrun",
+                             feature_cols=["features"],
+                             label_cols=["label"])
+    assert store.exists(store.get_checkpoint_path("torchrun"))
+    preds = tm.predict([np.zeros((3, 4))])
     assert preds.shape == (3, 1)

@@ -40,6 +40,33 @@ def test_xla_global_static(size, local):
         assert f"rank {rank}/{size}: XLA-GLOBAL OK" in out
 
 
+def test_xla_global_kill_rank_fails_fast():
+    """Peer death on the delegated plane: survivors must terminate
+    promptly, never hang inside a jitted collective missing a
+    participant. Two legitimate fail-fast paths race: the native TCP
+    control plane surfaces HorovodInternalError (survivor exits 0 after
+    handling it), or the JAX coordination service detects the death
+    first and terminates the process (the NCCL-abort analog)."""
+    extra = {
+        "HVDTPU_CPU_OPERATIONS": "xla",
+        "HVDTPU_XLA_COORD": f"127.0.0.1:{_free_port()}",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "XGW_LOCAL_DEVICES": "2",
+        "XGW_MODE": "kill",
+    }
+    codes, outs = launch(3, script=XLA_WORKER, extra_env=extra,
+                         timeout=120)
+    assert codes[2] == 17, f"rank 2 should die(17), got {codes[2]}"
+    for rank in (0, 1):
+        handled = ("XLA-GLOBAL-KILL OK" in outs[rank]
+                   and codes[rank] == 0)
+        terminated = ("JAX distributed service detected fatal errors"
+                      in outs[rank] and codes[rank] not in (None, 0))
+        assert handled or terminated, (
+            f"rank {rank} neither handled the death nor was terminated "
+            f"(exit {codes[rank]}):\n{outs[rank][-4000:]}")
+
+
 def test_xla_global_through_hvdrun():
     """Launcher-rendezvoused: the JAX coordinator address is brokered
     through the hvdrun KV store (the NCCL-unique-id-over-controller

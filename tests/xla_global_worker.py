@@ -35,6 +35,27 @@ def main():
     assert rt.backend.name == "xla-global", rt.backend.name
     assert rt.backend.delegate_data_ops
 
+    if os.environ.get("XGW_MODE") == "kill":
+        # Adversity: peer death on the delegated plane. The native TCP
+        # control plane must surface HorovodInternalError to survivors
+        # BEFORE any jitted collective launches over the global mesh
+        # (an XLA collective with a dead participant would hang in the
+        # distributed runtime).
+        warm = hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="warm")
+        np.testing.assert_allclose(np.asarray(warm), float(size))
+        if rank == size - 1:
+            os._exit(17)  # die abruptly: no shutdown, no consensus
+        try:
+            for i in range(50):
+                hvd.allreduce(jnp.ones(256), op=hvd.Sum, name=f"k{i}")
+            raise SystemExit("collectives kept succeeding w/ dead peer")
+        except hvd.HorovodInternalError:
+            pass
+        print(f"rank {rank}/{size}: XLA-GLOBAL-KILL OK", flush=True)
+        # Skip hvd.shutdown(): its final consensus would need the dead
+        # peer; abrupt exit is the point of this scenario.
+        os._exit(0)
+
     local_n = int(os.environ.get("XGW_LOCAL_DEVICES", "4"))
     assert len(jax.devices()) == size * local_n, (
         f"global mesh missing: {len(jax.devices())} != {size}x{local_n}")
