@@ -1,0 +1,17 @@
+#!/bin/sh
+# Host discovery script for the elastic examples (reference:
+# horovod/runner/elastic/discovery.py:80 HostDiscoveryScript — the driver
+# polls this every second; output is "host[:slots]" per line).
+#
+# This sample serves a fixed localhost pool, which is enough to exercise
+# elastic rendezvous on one machine:
+#
+#   hvdrun -np 2 --min-np 1 --host-discovery-script ./discover.sh \
+#       python examples/elastic_jax_train.py
+#
+# To simulate membership changes while a job runs, point the script at a
+# file you edit (the integration tests generate exactly this shape,
+# tests/test_elastic.py):
+#
+#   echo "localhost:4" > /tmp/hosts; cat /tmp/hosts
+echo "localhost:2"

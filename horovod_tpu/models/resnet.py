@@ -14,6 +14,7 @@ from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
@@ -71,6 +72,10 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
     axis_name: Optional[str] = None   # set to 'hvd' for SyncBatchNorm
+    # False | True/"full" | "dots" (save conv outputs, recompute
+    # elementwise BN/ReLU) — trades recompute for backward-pass HBM,
+    # pushing the batch-size spill cliff out (docs/PERF.md).
+    remat: Any = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -84,12 +89,20 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = self.block_cls
+        if self.remat == "dots":
+            block_cls = nn.remat(
+                block_cls,
+                policy=jax.checkpoint_policies.
+                dots_with_no_batch_dims_saveable)
+        elif self.remat:
+            block_cls = nn.remat(block_cls)
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(self.num_filters * 2 ** i,
-                                   conv=conv, norm=norm,
-                                   strides=strides)(x)
+                x = block_cls(self.num_filters * 2 ** i,
+                              conv=conv, norm=norm,
+                              strides=strides)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x

@@ -29,6 +29,16 @@ def check_output_width(preds, output_cols):
             "column per component (or reduce the output in the model)")
 
 
+def materialize_df(df, store, num_proc):
+    """DataFrame -> parquet shards in the store, at least one part file
+    per rank (reference: horovod/spark/common/util.py prepare_data).
+    Shared by the estimator flavors."""
+    path = store.get_train_data_path()
+    (df.repartition(max(num_proc, df.rdd.getNumPartitions()))
+       .write.mode("overwrite").parquet(path))
+    return path
+
+
 def transform_with(df, feature_cols, output_cols, make_predict):
     """Append prediction columns to a Spark DataFrame via mapInPandas.
     ``make_predict()`` runs once per executor partition stream and

@@ -21,7 +21,8 @@ import uuid
 
 import numpy as np
 
-from ._transform import check_output_width, require_pyspark, transform_with
+from ._transform import (check_output_width, materialize_df,
+                         require_pyspark, transform_with)
 from .data import stack_column as _stack_column
 from .store import Store
 
@@ -109,6 +110,13 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
     if size > 1:
         n_rows = int(np.min(np.asarray(
             hvd.allgather(np.asarray([n_rows], np.int64)))))
+    if n_rows == 0:
+        # Raise on ALL ranks (the allgathered min is identical
+        # everywhere): one rank raising alone would leave its peers
+        # deadlocked in the first gradient allreduce.
+        raise ValueError(
+            "a rank has 0 training rows after the validation split; "
+            "repartition the dataset or lower the validation fraction")
     steps = train_steps_per_epoch or max(1, n_rows // batch_size)
 
     def to_xy(batch):
@@ -138,16 +146,6 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
     hvd.allreduce(np.zeros(1, np.float32), name="fit.final.barrier")
     return {k: [float(v) for v in vs] for k, vs in
             history.history.items()}
-
-
-def _materialize_df(df, store, num_proc):
-    """DataFrame -> parquet shards in the store, at least one part file
-    per rank (reference: horovod/spark/common/util.py prepare_data).
-    Shared by the Keras and Torch estimators."""
-    path = store.get_train_data_path()
-    (df.repartition(max(num_proc, df.rdd.getNumPartitions()))
-       .write.mode("overwrite").parquet(path))
-    return path
 
 
 class KerasModel:
@@ -231,7 +229,7 @@ class KerasEstimator:
 
         sc = SparkContext.getOrCreate()
         num_proc = self.num_proc or sc.defaultParallelism
-        _materialize_df(df, self.store, num_proc)
+        materialize_df(df, self.store, num_proc)
 
         spark_run(
             fit_on_parquet, kwargs=dict(

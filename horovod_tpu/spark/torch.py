@@ -14,7 +14,8 @@ import uuid
 
 import numpy as np
 
-from ._transform import check_output_width, require_pyspark, transform_with
+from ._transform import (check_output_width, materialize_df,
+                         require_pyspark, transform_with)
 from .data import stack_column as _stack_column
 from .store import Store
 
@@ -37,7 +38,9 @@ def _optimizer_spec(optimizer):
     horovod/spark/torch/remote.py:444 get_optimizer_with_unscaled_lr).
     Multi-param-group optimizers cannot round-trip this way (parameter
     identity does not survive serialization), so they are rejected
-    rather than silently rebuilt with one uniform setting."""
+    rather than silently rebuilt with one uniform setting. The live
+    param_groups[0] hyperparameters are captured (not ``defaults``) so
+    post-construction changes — manual decay, schedulers — survive."""
     if len(optimizer.param_groups) > 1:
         raise ValueError(
             "TorchEstimator supports single-param-group optimizers "
@@ -45,7 +48,9 @@ def _optimizer_spec(optimizer):
             "the deserialized model's parameters on the executors. "
             "Rebuild the groups inside a custom training fn run via "
             "horovod_tpu.spark.run instead.")
-    return type(optimizer), dict(optimizer.defaults)
+    hparams = {k: v for k, v in optimizer.param_groups[0].items()
+               if k != "params" and k in optimizer.defaults}
+    return type(optimizer), hparams
 
 
 def _resolve_loss(loss):
@@ -113,6 +118,13 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
         n_rows = int(min(
             int(t) for t in hvd.allgather(
                 torch.tensor([n_rows], dtype=torch.int64))))
+    if n_rows == 0:
+        # Raise on ALL ranks (the allgathered min is identical
+        # everywhere): one rank raising alone would leave its peers
+        # deadlocked in the first gradient allreduce.
+        raise ValueError(
+            "a rank has 0 training rows after the validation split; "
+            "repartition the dataset or lower the validation fraction")
     steps = train_steps_per_epoch or max(1, n_rows // batch_size)
 
     def to_xy(batch):
@@ -147,13 +159,22 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
             torch.tensor([total / steps]), name=f"ep{epoch}.loss"))
         history["loss"].append(avg)
         if val_batch is not None:
+            # Batched eval: one whole-split forward would allocate
+            # activations for 25% of a host-RAM-sized shard at once.
             model.eval()
+            n_val = len(next(iter(val_batch.values())))
+            vl_sum, vl_n = 0.0, 0
             with torch.no_grad():
-                vx, vy = to_xy(val_batch)
-                vl = float(loss_fn(model(vx), vy))
+                for start in range(0, n_val, batch_size):
+                    chunk = {c: v[start:start + batch_size]
+                             for c, v in val_batch.items()}
+                    vx, vy = to_xy(chunk)
+                    rows = len(next(iter(chunk.values())))
+                    vl_sum += float(loss_fn(model(vx), vy)) * rows
+                    vl_n += rows
             model.train()
             history["val_loss"].append(float(hvd.allreduce(
-                torch.tensor([vl]), name=f"ep{epoch}.vloss")))
+                torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
         if verbose and rank == 0:
             print(f"epoch {epoch}: " + ", ".join(
                 f"{k}={v[-1]:.4f}" for k, v in history.items()),
@@ -251,12 +272,11 @@ class TorchEstimator:
     def fit(self, df):
         require_pyspark("TorchEstimator.fit")
         from . import run as spark_run
-        from .keras import _materialize_df
         from pyspark import SparkContext
 
         sc = SparkContext.getOrCreate()
         num_proc = self.num_proc or sc.defaultParallelism
-        _materialize_df(df, self.store, num_proc)
+        materialize_df(df, self.store, num_proc)
 
         spark_run(
             fit_on_parquet_torch, kwargs=dict(
