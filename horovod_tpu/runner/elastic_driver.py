@@ -183,7 +183,8 @@ class ElasticDriver:
         slot = SimpleNamespace(hostname=host, rank=worker_id)
         proc = spawn.SlotProcess(
             slot, self.command, env,
-            prefix_output=self.elastic.base.prefix_output)
+            prefix_output=self.elastic.base.prefix_output,
+            output_dir=self.elastic.base.output_filename)
         self.workers[worker_id] = _Worker(worker_id, host, slot_index, proc)
 
     def _reconcile(self, targets):
@@ -385,6 +386,7 @@ class ElasticDriver:
 
 def launch_elastic_job(elastic, command):
     """Entry used by hvdrun for elastic flags; returns the exit code."""
+    spawn.reset_capture_dir(elastic.base.output_filename)
     driver = ElasticDriver(elastic, command)
     try:
         return driver.run()

@@ -21,7 +21,7 @@ class Settings:
 
     def __init__(self, num_proc=1, hosts=None, hostfile=None,
                  start_timeout=120, verbose=False, prefix_output=True,
-                 env=None, rendezvous_addr=None):
+                 env=None, rendezvous_addr=None, output_filename=None):
         self.num_proc = num_proc
         self.hosts = hosts
         self.hostfile = hostfile
@@ -30,6 +30,9 @@ class Settings:
         self.prefix_output = prefix_output
         self.env = dict(env or {})   # extra env forwarded to every slot
         self.rendezvous_addr = rendezvous_addr
+        # Directory for per-rank rank.N/stdout|stderr capture (reference:
+        # horovodrun --output-filename).
+        self.output_filename = output_filename
 
     def resolve_hosts(self):
         if self.hosts:
@@ -51,6 +54,7 @@ def launch_job(settings, command):
     """Run ``command`` (argv list) across all slots; returns the job's
     exit code (0 only when every rank exits 0)."""
     slots = get_host_assignments(settings.resolve_hosts(), settings.num_proc)
+    spawn.reset_capture_dir(settings.output_filename)
     token = new_job_token()
     server = RendezvousServer(job_token=token, verbose=settings.verbose)
     port = server.start()
@@ -69,7 +73,8 @@ def launch_job(settings, command):
                 "HVDTPU_START_TIMEOUT": str(settings.start_timeout),
             })
             procs.append(spawn.SlotProcess(
-                slot, command, env, prefix_output=settings.prefix_output))
+                slot, command, env, prefix_output=settings.prefix_output,
+                output_dir=settings.output_filename))
 
         return _monitor(procs, settings)
     finally:

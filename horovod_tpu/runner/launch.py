@@ -35,6 +35,13 @@ def parse_args(argv=None):
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--disable-prefix-output", action="store_true",
                         help="do not prefix worker output with [rank]")
+    parser.add_argument("--output-filename", default=None,
+                        help="directory collecting per-rank "
+                             "rank.N/stdout|stderr captures")
+    parser.add_argument("--config-file", default=None,
+                        help="YAML file of flag values (flag names with "
+                             "dashes or underscores); explicit CLI flags "
+                             "win")
     # Elastic flags (reference: launch.py --min-np/--max-np/
     # --host-discovery-script routed to _run_elastic).
     parser.add_argument("--min-np", type=int, default=None,
@@ -66,7 +73,58 @@ def parse_args(argv=None):
         parser.error("no command given")
     if args.command[0] == "--":
         args.command = args.command[1:]
+    if args.config_file:
+        _apply_config_file(parser, args, argv)
     return args
+
+
+def _explicit_dests(parser, argv):
+    """Dests the user actually passed on the command line — re-parse
+    with all defaults suppressed so unset flags don't appear at all
+    (a value equal to its default is otherwise indistinguishable)."""
+    import copy
+    p = copy.deepcopy(parser)
+    for action in p._actions:
+        action.default = argparse.SUPPRESS
+    ns, _ = p.parse_known_args(argv if argv is not None
+                               else sys.argv[1:])
+    return set(vars(ns))
+
+
+def _apply_config_file(parser, args, argv):
+    """Fill args from a YAML mapping of flag names (reference:
+    horovod/runner/launch.py:513 + common/util/config_parser.py
+    set_args_from_config). Explicit CLI flags win even when they equal
+    the parser default; values go through the flag's argparse type."""
+    import yaml
+    with open(args.config_file) as f:
+        config = yaml.safe_load(f) or {}
+    if not isinstance(config, dict):
+        raise SystemExit(f"config file {args.config_file} must be a "
+                         "YAML mapping of flag names to values")
+    explicit = _explicit_dests(parser, argv)
+    actions = {a.dest: a for a in parser._actions}
+    for key, value in config.items():
+        dest = key.replace("-", "_").lstrip("_")
+        if dest in ("command", "config_file"):
+            raise SystemExit(f"config file cannot set '{key}'")
+        if dest not in actions:
+            raise SystemExit(f"unknown config key '{key}' (use hvdrun "
+                             "flag names)")
+        if dest in explicit:
+            continue
+        action = actions[dest]
+        if isinstance(action, (argparse._StoreTrueAction,
+                               argparse._StoreFalseAction)):
+            value = bool(value)
+        elif action.type is not None and value is not None:
+            try:
+                value = action.type(str(value))
+            except (TypeError, ValueError):
+                raise SystemExit(
+                    f"config key '{key}': cannot convert {value!r} "
+                    f"to {action.type.__name__}")
+        setattr(args, dest, value)
 
 
 def _knob_env(args):
@@ -102,7 +160,8 @@ def run_commandline(argv=None):
     settings = Settings(
         num_proc=args.num_proc, hosts=args.hosts, hostfile=args.hostfile,
         start_timeout=args.start_timeout, verbose=args.verbose,
-        prefix_output=not args.disable_prefix_output, env=_knob_env(args))
+        prefix_output=not args.disable_prefix_output, env=_knob_env(args),
+        output_filename=args.output_filename)
     if args.host_discovery_script or args.min_np or args.max_np:
         from .elastic_driver import ElasticSettings, launch_elastic_job
         elastic = ElasticSettings(

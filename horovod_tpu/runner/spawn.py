@@ -28,21 +28,57 @@ def is_local(hostname):
         return False
 
 
-def _stream(pipe, sink, prefix):
-    """Forward lines from pipe to sink with the rank prefix."""
+def _stream(pipe, sinks):
+    """Forward lines from pipe to each (sink, prefix) pair — the console
+    gets the [rank] prefix, a per-rank capture file gets the raw line
+    (reference: horovod/runner/gloo_run.py MultiFile). A sink that fails
+    to write (capture disk full, dir deleted) is dropped so the others
+    keep streaming and the pipe stays drained (an abandoned pipe would
+    EPIPE-kill a healthy worker)."""
+    sinks = list(sinks)
     try:
         for raw in iter(pipe.readline, b""):
             line = raw.decode(errors="replace")
-            sink.write(f"{prefix}{line}")
-            sink.flush()
+            for pair in list(sinks):
+                sink, prefix = pair
+                try:
+                    sink.write(f"{prefix}{line}")
+                    sink.flush()
+                except (OSError, ValueError):
+                    sinks.remove(pair)
     finally:
         pipe.close()
+
+
+def _safe_rank_name(rank):
+    """Filesystem-safe capture dir component: elastic worker ids are
+    'host:slot' strings — colons break non-POSIX filesystems."""
+    return str(rank).replace(":", ".").replace("/", "_")
+
+
+def reset_capture_dir(output_dir):
+    """Truncate existing rank.*/stdout|stderr once per LAUNCH so runs
+    don't concatenate; per-process opens append so same-job elastic
+    respawns keep earlier attempts."""
+    if not output_dir or not os.path.isdir(output_dir):
+        return
+    for name in os.listdir(output_dir):
+        if not name.startswith("rank."):
+            continue
+        for leaf in ("stdout", "stderr"):
+            path = os.path.join(output_dir, name, leaf)
+            if os.path.exists(path):
+                try:
+                    open(path, "w").close()
+                except OSError:
+                    pass
 
 
 class SlotProcess:
     """One spawned worker with its output pumps."""
 
-    def __init__(self, slot, command, env, prefix_output=True):
+    def __init__(self, slot, command, env, prefix_output=True,
+                 output_dir=None):
         self.slot = slot
         if is_local(slot.hostname):
             full_env = dict(os.environ)
@@ -65,12 +101,29 @@ class SlotProcess:
         rank = slot.rank
         out_prefix = f"[{rank}]<stdout> " if prefix_output else ""
         err_prefix = f"[{rank}]<stderr> " if prefix_output else ""
+        out_sinks = [(sys.stdout, out_prefix)]
+        err_sinks = [(sys.stderr, err_prefix)]
+        self._files = []
+        if output_dir:
+            # Per-rank capture alongside the console (reference:
+            # gloo_run.py:157-166 output_filename/rank.N/std{out,err});
+            # the file gets raw lines, the console keeps the prefix.
+            rank_dir = os.path.join(output_dir,
+                                    f"rank.{_safe_rank_name(rank)}")
+            os.makedirs(rank_dir, exist_ok=True)
+            # Append: an elastic respawn of the same rank must not
+            # truncate the previous attempt's capture.
+            fo = open(os.path.join(rank_dir, "stdout"), "a")
+            fe = open(os.path.join(rank_dir, "stderr"), "a")
+            self._files = [fo, fe]
+            out_sinks.append((fo, ""))
+            err_sinks.append((fe, ""))
         self._pumps = [
             threading.Thread(target=_stream,
-                             args=(self.proc.stdout, sys.stdout, out_prefix),
+                             args=(self.proc.stdout, out_sinks),
                              daemon=True),
             threading.Thread(target=_stream,
-                             args=(self.proc.stderr, sys.stderr, err_prefix),
+                             args=(self.proc.stderr, err_sinks),
                              daemon=True),
         ]
         for t in self._pumps:
@@ -83,6 +136,11 @@ class SlotProcess:
         rc = self.proc.wait(timeout)
         for t in self._pumps:
             t.join(timeout=5)
+        for f in self._files:
+            try:
+                f.close()
+            except OSError:
+                pass
         return rc
 
     def terminate(self):

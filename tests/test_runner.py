@@ -157,6 +157,75 @@ def test_hvdrun_console_entry():
     assert "LAUNCHED-OK 1" in out
 
 
+def test_output_filename_captures_per_rank(tmp_path):
+    """--output-filename mirrors each rank's streams into
+    rank.N/stdout|stderr (reference: gloo_run.py:157 MultiFile capture)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out_dir = str(tmp_path / "logs")
+    script = ("import horovod_tpu as hvd, sys; hvd.init(); "
+              "print('CAPTURED', hvd.rank()); "
+              "print('ERRSIDE', hvd.rank(), file=sys.stderr)")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--output-filename", out_dir,
+         sys.executable, "-c", script],
+        env=env, capture_output=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout.decode() + \
+        proc.stderr.decode()
+    for rank in (0, 1):
+        stdout = open(os.path.join(out_dir, f"rank.{rank}",
+                                   "stdout")).read()
+        stderr = open(os.path.join(out_dir, f"rank.{rank}",
+                                   "stderr")).read()
+        assert f"CAPTURED {rank}" in stdout
+        assert f"ERRSIDE {rank}" in stderr
+    # Console still shows the prefixed stream.
+    assert "CAPTURED 0" in proc.stdout.decode()
+
+
+def test_config_file_fills_defaults(tmp_path):
+    """--config-file YAML fills unset flags; explicit CLI flags win;
+    unknown keys error (reference: launch.py:513 + config_parser)."""
+    from horovod_tpu.runner.launch import parse_args
+
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text("num-proc: 4\nstart_timeout: 33\n"
+                   "fusion-threshold-mb: 16\nautotune: true\n")
+    args = parse_args(["--config-file", str(cfg), "echo", "hi"])
+    assert args.num_proc == 4
+    assert args.start_timeout == 33
+    assert args.fusion_threshold_mb == 16
+    assert args.autotune is True
+
+    # CLI wins over the file — including a flag passed AT its default
+    # value (-np 1 equals the parser default but was explicit).
+    args = parse_args(["-np", "2", "--config-file", str(cfg),
+                       "echo", "hi"])
+    assert args.num_proc == 2
+    args = parse_args(["-np", "1", "--config-file", str(cfg),
+                       "echo", "hi"])
+    assert args.num_proc == 1
+
+    # Config values go through the flag's argparse type.
+    typed = tmp_path / "typed.yaml"
+    typed.write_text('num-proc: "4"\n')
+    args = parse_args(["--config-file", str(typed), "echo", "hi"])
+    assert args.num_proc == 4 and isinstance(args.num_proc, int)
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("not-a-flag: 1\n")
+    import pytest as _pytest
+    with _pytest.raises(SystemExit):
+        parse_args(["--config-file", str(bad), "echo", "hi"])
+    untyped = tmp_path / "untyped.yaml"
+    untyped.write_text("num-proc: not-a-number\n")
+    with _pytest.raises(SystemExit):
+        parse_args(["--config-file", str(untyped), "echo", "hi"])
+
+
 def test_run_programmatic():
     """horovod_tpu.runner.run(): pickled function, per-rank results."""
     from horovod_tpu.runner import run
