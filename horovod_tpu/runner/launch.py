@@ -106,18 +106,21 @@ def _apply_config_file(parser, args, argv):
     actions = {a.dest: a for a in parser._actions}
     for key, value in config.items():
         dest = key.replace("-", "_").lstrip("_")
-        if dest in ("command", "config_file"):
+        if dest in ("command", "config_file", "help"):
             raise SystemExit(f"config file cannot set '{key}'")
         if dest not in actions:
             raise SystemExit(f"unknown config key '{key}' (use hvdrun "
                              "flag names)")
+        if value is None:
+            raise SystemExit(f"config key '{key}' has a null value; "
+                             "omit the key or give it a value")
         if dest in explicit:
             continue
         action = actions[dest]
         if isinstance(action, (argparse._StoreTrueAction,
                                argparse._StoreFalseAction)):
-            value = bool(value)
-        elif action.type is not None and value is not None:
+            value = _config_bool(key, value)
+        elif action.type is not None:
             try:
                 value = action.type(str(value))
             except (TypeError, ValueError):
@@ -125,6 +128,20 @@ def _apply_config_file(parser, args, argv):
                     f"config key '{key}': cannot convert {value!r} "
                     f"to {action.type.__name__}")
         setattr(args, dest, value)
+
+
+def _config_bool(key, value):
+    """Strict boolean for flag-valued config keys: bool('false') being
+    True would silently enable a feature the user asked to disable."""
+    if isinstance(value, bool):
+        return value
+    text = str(value).strip().lower()
+    if text in ("true", "1", "yes", "on"):
+        return True
+    if text in ("false", "0", "no", "off"):
+        return False
+    raise SystemExit(f"config key '{key}': expected a boolean, got "
+                     f"{value!r}")
 
 
 def _knob_env(args):

@@ -1,0 +1,95 @@
+"""Examples as a smoke-test matrix (reference: .buildkite/
+gen-pipeline.sh:155-279 runs every example as a CI test).
+
+Each example runs under the real launcher at np=2 with CI-sized
+arguments; assertions are on exit codes and the example's own output.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run_example(argv, timeout=420, np=2, extra_launch=()):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", str(np), *extra_launch, sys.executable, *argv]
+    proc = subprocess.run(cmd, env=env, capture_output=True,
+                          timeout=timeout, cwd=EXAMPLES)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out[-4000:]
+    return out
+
+
+def test_jax_mnist():
+    out = _run_example(["jax_mnist.py"])
+    assert "loss=" in out
+
+
+def test_pytorch_mnist():
+    pytest.importorskip("torch")
+    out = _run_example(["pytorch_mnist.py"])
+    assert "done" in out
+
+
+def test_tensorflow2_mnist():
+    pytest.importorskip("tensorflow")
+    out = _run_example(["tensorflow2_mnist.py"])
+    assert "done" in out
+
+
+def test_keras_mnist():
+    pytest.importorskip("keras")
+    out = _run_example(["keras_mnist.py"])
+    assert "loss" in out.lower() or "done" in out.lower()
+
+
+def test_tensorflow2_synthetic_benchmark_tiny():
+    pytest.importorskip("tensorflow")
+    out = _run_example(
+        ["tensorflow2_synthetic_benchmark.py", "--tiny",
+         "--num-iters", "1", "--num-batches-per-iter", "1",
+         "--num-warmup-batches", "1", "--batch-size", "4"])
+    assert "Total img/sec" in out
+
+
+def test_pytorch_bert_benchmark_tiny():
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    out = _run_example(
+        ["pytorch_bert_benchmark.py", "--num-iters", "1",
+         "--num-batches-per-iter", "1", "--batch-size", "2",
+         "--seq-len", "32"])
+    assert "Samples/sec" in out
+
+
+def test_adasum_small_model():
+    out = _run_example(["adasum_small_model.py"])
+    assert "adasum" in out.lower() or "done" in out.lower()
+
+
+def test_elastic_examples():
+    pytest.importorskip("tensorflow")
+    for script in ("elastic_jax_train.py", "elastic_tensorflow2.py"):
+        out = _run_example(
+            [script], extra_launch=("--min-np", "1",
+                                    "--host-discovery-script",
+                                    "./discover.sh"))
+        assert "done" in out
+
+
+def test_jax_synthetic_benchmark_tiny():
+    out = _run_example(
+        ["jax_synthetic_benchmark.py", "--model", "ResNet18",
+         "--image-size", "32", "--batch-size", "2", "--num-iters", "1",
+         "--num-batches-per-iter", "1", "--num-warmup-batches", "1"])
+    assert "/sec" in out

@@ -225,6 +225,26 @@ def test_config_file_fills_defaults(tmp_path):
     with _pytest.raises(SystemExit):
         parse_args(["--config-file", str(untyped), "echo", "hi"])
 
+    # Boolean flags parse strictly: a quoted "false" must not enable.
+    boolcfg = tmp_path / "bool.yaml"
+    boolcfg.write_text('autotune: "false"\nverbose: "on"\n')
+    args = parse_args(["--config-file", str(boolcfg), "echo", "hi"])
+    assert args.autotune is False and args.verbose is True
+    badbool = tmp_path / "badbool.yaml"
+    badbool.write_text("autotune: maybe\n")
+    with _pytest.raises(SystemExit):
+        parse_args(["--config-file", str(badbool), "echo", "hi"])
+
+    # Null values and parser-internal dests fail fast.
+    nullcfg = tmp_path / "null.yaml"
+    nullcfg.write_text("num-proc:\n")
+    with _pytest.raises(SystemExit):
+        parse_args(["--config-file", str(nullcfg), "echo", "hi"])
+    helpcfg = tmp_path / "help.yaml"
+    helpcfg.write_text("help: true\n")
+    with _pytest.raises(SystemExit):
+        parse_args(["--config-file", str(helpcfg), "echo", "hi"])
+
 
 def test_run_programmatic():
     """horovod_tpu.runner.run(): pickled function, per-rank results."""
