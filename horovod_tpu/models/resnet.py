@@ -65,6 +65,33 @@ class BasicBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+def _space_to_depth(x, block=2):
+    """NHWC space-to-depth: (B,H,W,C) -> (B,H/b,W/b,b*b*C) with channel
+    order (di*b+dj)*C + c."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+def stem_weights_to_space_to_depth(w7):
+    """Map a (7,7,C,F) stem kernel to the equivalent (4,4,4C,F)
+    space-to-depth kernel (zero-pad to 8x8, fold the 2x2 phase into
+    input channels) — lets checkpoints trained with either stem load
+    into the other."""
+    import numpy as np
+    k, _, c, f = w7.shape
+    assert k == 7, w7.shape
+    w8 = np.zeros((8, 8, c, f), w7.dtype)
+    w8[1:8, 1:8] = np.asarray(w7)
+    w4 = np.zeros((4, 4, 4 * c, f), w7.dtype)
+    for da in range(2):
+        for db in range(2):
+            w4[:, :, (da * 2 + db) * c:(da * 2 + db + 1) * c] = \
+                w8[da::2, db::2]
+    return w4
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -76,6 +103,12 @@ class ResNet(nn.Module):
     # elementwise BN/ReLU) — trades recompute for backward-pass HBM,
     # pushing the batch-size spill cliff out (docs/PERF.md).
     remat: Any = False
+    # "conv" (classic 7x7/s2) | "space_to_depth": reorganize the input
+    # to (H/2, W/2, 4C) and run an equivalent 4x4/s1 conv — the 7x7
+    # stem's contraction dim (7*7*3=147) underfills the MXU; the
+    # space-to-depth form (4*4*12=192, no stride) tiles better (the
+    # standard MLPerf-era TPU ResNet stem).
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -84,8 +117,16 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        axis_name=self.axis_name if train else None)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = _space_to_depth(x)
+            # Exactly equivalent to the 7x7/s2 conv: the 7x7 kernel
+            # zero-pads to 8x8 (pad (4,3) in pixels = (2,1) in blocks)
+            # and folds its 2x2 phase into the input channels.
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -111,11 +111,13 @@ def _apply_config_file(parser, args, argv):
         if dest not in actions:
             raise SystemExit(f"unknown config key '{key}' (use hvdrun "
                              "flag names)")
+        if dest in explicit:
+            # Explicit CLI flags win — including over a malformed
+            # config value for the same key.
+            continue
         if value is None:
             raise SystemExit(f"config key '{key}' has a null value; "
                              "omit the key or give it a value")
-        if dest in explicit:
-            continue
         action = actions[dest]
         if isinstance(action, (argparse._StoreTrueAction,
                                argparse._StoreFalseAction)):

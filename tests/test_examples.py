@@ -77,14 +77,21 @@ def test_adasum_small_model():
     assert "adasum" in out.lower() or "done" in out.lower()
 
 
-def test_elastic_examples():
+def _run_elastic_example(script):
+    out = _run_example(
+        [script], extra_launch=("--min-np", "1",
+                                "--host-discovery-script",
+                                "./discover.sh"))
+    assert "done" in out
+
+
+def test_elastic_jax_example():
+    _run_elastic_example("elastic_jax_train.py")
+
+
+def test_elastic_tensorflow2_example():
     pytest.importorskip("tensorflow")
-    for script in ("elastic_jax_train.py", "elastic_tensorflow2.py"):
-        out = _run_example(
-            [script], extra_launch=("--min-np", "1",
-                                    "--host-discovery-script",
-                                    "./discover.sh"))
-        assert "done" in out
+    _run_elastic_example("elastic_tensorflow2.py")
 
 
 def test_jax_synthetic_benchmark_tiny():

@@ -142,6 +142,60 @@ def test_compression_bf16_training(hvd, n_devices):
     assert np.isfinite(float(loss))
 
 
+def test_zero_train_step_matches_regular(hvd, n_devices):
+    """ZeRO-1 step == regular step numerically; optimizer moments live
+    sharded 1/N per device (global state leaves are flat vectors padded
+    to N x shard_len)."""
+    import optax
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(13, 5), jnp.float32),
+              "b": jnp.zeros(5)}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    x = jnp.asarray(rng.randn(2 * n_devices, 13), jnp.float32)
+    y = jnp.asarray(rng.randn(2 * n_devices, 5), jnp.float32)
+
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2))
+    step = hvd_jax.make_train_step(loss_fn, opt, donate=False)
+    s = opt.init(params)
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2))
+    zstep, zinit = hvd_jax.make_zero_train_step(loss_fn, zopt,
+                                                donate=False)
+    zs = zinit(params)
+
+    n_elem = sum(int(np.prod(v.shape)) for v in params.values())
+    padded = n_elem + (-n_elem) % n_devices
+    vec_shapes = {np.shape(t) for t in jax.tree.leaves(zs)
+                  if np.ndim(t) >= 1}
+    assert vec_shapes == {(padded,)}, vec_shapes
+
+    pp, zpp = params, params
+    for i in range(4):
+        pp, s, loss = step(pp, s, (x, y))
+        zpp, zs, zloss = zstep(zpp, zs, (x, y))
+        assert abs(float(loss) - float(zloss)) < 1e-5, i
+    np.testing.assert_allclose(np.asarray(pp["w"]),
+                               np.asarray(zpp["w"]), atol=1e-4)
+
+
+def test_zero_train_step_rejects_unsupported(hvd):
+    import optax
+
+    def loss_fn(p, b):
+        return jnp.sum(p["w"])
+
+    for bad in (dict(op=hvd_mod.Sum),
+                dict(backward_passes_per_step=2),
+                dict(compression=hvd_mod.Compression.bf16)):
+        opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1), **bad)
+        with pytest.raises(ValueError, match="make_zero_train_step"):
+            hvd_jax.make_zero_train_step(loss_fn, opt)
+
+
 def test_broadcast_variables_single_mode_identity(hvd):
     params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
     out = hvd_jax.broadcast_parameters(params, root_rank=0)

@@ -235,11 +235,16 @@ def test_config_file_fills_defaults(tmp_path):
     with _pytest.raises(SystemExit):
         parse_args(["--config-file", str(badbool), "echo", "hi"])
 
-    # Null values and parser-internal dests fail fast.
+    # Null values and parser-internal dests fail fast...
     nullcfg = tmp_path / "null.yaml"
     nullcfg.write_text("num-proc:\n")
     with _pytest.raises(SystemExit):
         parse_args(["--config-file", str(nullcfg), "echo", "hi"])
+    # ...unless the same key was given explicitly on the CLI, which wins
+    # over a malformed config value.
+    args = parse_args(["-np", "4", "--config-file", str(nullcfg),
+                       "echo", "hi"])
+    assert args.num_proc == 4
     helpcfg = tmp_path / "help.yaml"
     helpcfg.write_text("help: true\n")
     with _pytest.raises(SystemExit):
