@@ -103,10 +103,11 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
     batch = (batch_tpu if on_tpu else 2) * n
     # BERT-large dimensions as a causal decoder LM (the reference's BERT
     # target, BASELINE.md): 365M params. The pallas flash kernel (causal
-    # block-skip + 256-tiles) now beats XLA's fused einsum attention at
-    # seq 512 (75.0 vs 71.6 samples/s): skipping above-diagonal tiles
-    # halves attention FLOPs and the freed O(s^2) logits memory admits
-    # batch 24 without remat (docs/PERF.md sweep).
+    # block-skip + 1024-tiles + unpadded d=64) beats XLA's fused einsum
+    # attention at seq 512 (87.2 vs 71.6 samples/s): skipping
+    # above-diagonal tiles halves attention FLOPs, big tiles amortize the
+    # online-softmax bookkeeping, and the freed O(s^2) logits memory
+    # admits batch 24 without remat (docs/PERF.md round-3 sweep).
     if on_tpu:
         cfg = TransformerConfig(vocab_size=30522, hidden=1024, layers=24,
                                 heads=16, max_len=seq, causal=True,

@@ -369,6 +369,23 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
         shard_len = (flat.size + (-flat.size) % n) // n
         dtype = flat.dtype
 
+        # Every leaf we mark P(axis_name) must actually mirror the flat
+        # parameter shard: an optax transform carrying a non-per-parameter
+        # 1-D leaf (e.g. a schedule table) would otherwise be silently
+        # sharded along the replica axis and corrupt its layout.
+        local_shape = jax.eval_shape(
+            inner.init, jax.ShapeDtypeStruct((shard_len,), dtype))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                local_shape)[0]:
+            if leaf.ndim >= 1 and leaf.shape != (shard_len,):
+                raise ValueError(
+                    "make_zero_train_step requires elementwise optimizer "
+                    "state; leaf "
+                    + jax.tree_util.keystr(path)
+                    + f" has shape {leaf.shape} != ({shard_len},) (the "
+                    "per-device parameter shard). Use make_train_step "
+                    "for transforms with non-per-parameter state.")
+
         def body(p):
             del p
             return inner.init(jnp.zeros((shard_len,), dtype))

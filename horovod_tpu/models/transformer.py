@@ -88,12 +88,13 @@ class Attention(nn.Module):
                     "attention_impl='flash' does not support padding "
                     "masks; use 'einsum'")
             from ..ops.flash_attention import flash_attention
-            # 256-tiles measured fastest at long context (median sweep,
-            # docs/PERF.md); _prepare clamps them for short sequences.
+            # 1024-tiles measured fastest (round-3 sweep, docs/PERF.md:
+            # 2048² exceeds the 16M scoped-VMEM stack; _prepare clamps to
+            # the sequence for shorter contexts).
             out = flash_attention(
                 q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
-                causal=cfg.causal, block_q=256,
-                block_k=256).swapaxes(1, 2)
+                causal=cfg.causal, block_q=1024,
+                block_k=1024).swapaxes(1, 2)
         else:
             scale = 1.0 / np.sqrt(head_dim)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

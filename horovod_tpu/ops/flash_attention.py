@@ -215,7 +215,7 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         q = q_ref[0]                  # (block_q, d)
         k = k_ref[0]                  # (block_k, d)
         v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         lse = lse_ref[0, 0]           # (block_q,)
         delta = delta_ref[0, 0]       # (block_q,)
 
@@ -230,17 +230,21 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if causal:
             mask = jnp.logical_and(mask,
                                    (q_start + rows) >= (k_start + cols))
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk) fp32
 
+        # MXU operands in the input dtype (bf16 in training; identity for
+        # fp32 inputs), fp32 accumulation. fp32 operands would run the
+        # matmuls at a fraction of MXU rate — the softmax weights and ds
+        # are the canonical safe-to-round tensors of the flash backward.
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bq, bk)
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qb == n_q - 1)
@@ -268,7 +272,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
@@ -285,11 +289,12 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                    (q_start + rows) >= (k_start + cols))
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
+        # input-dtype operand, fp32 accumulation (see _bwd_dkv_kernel).
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == n_k - 1)
@@ -429,8 +434,9 @@ _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
 def _prepare(q, k, v, block_q, block_k):
-    """Reshape (B,H,S,D)→(BH,S,D), pad D to the 128-lane tile and S to
-    block multiples. Returns padded tensors + original dims."""
+    """Reshape (B,H,S,D)→(BH,S,D), pad D to a lane tile (64 when D<=64,
+    else 128) and S to block multiples. Returns padded tensors +
+    original dims."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     # Clamp requested blocks to the (pow2-rounded) sequence lengths; the
@@ -442,10 +448,15 @@ def _prepare(q, k, v, block_q, block_k):
     def flat(x):
         return x.reshape((b * h,) + x.shape[2:])
 
+    # Head dims <=64 stay at 64 lanes: Mosaic supports 64-wide last dims,
+    # and padding d=64 heads to 128 would double both the matmul work and
+    # the HBM traffic of every block (~10% kernel time at seq 512,
+    # docs/PERF.md round-3 sweep).
+    d_pad = 64 if d <= 64 else _LANE
     q, k, v = flat(q), flat(k), flat(v)
-    q = _pad_to(_pad_to(q, _LANE, 2), block_q, 1)
-    k = _pad_to(_pad_to(k, _LANE, 2), block_k, 1)
-    v = _pad_to(_pad_to(v, _LANE, 2), block_k, 1)
+    q = _pad_to(_pad_to(q, d_pad, 2), block_q, 1)
+    k = _pad_to(_pad_to(k, d_pad, 2), block_k, 1)
+    v = _pad_to(_pad_to(v, d_pad, 2), block_k, 1)
     return q, k, v, (b, h, sq, sk, d), block_q, block_k
 
 

@@ -28,14 +28,18 @@ def is_local(hostname):
         return False
 
 
-def _stream(pipe, sinks):
+def _stream(pipe, sinks, console_sinks=()):
     """Forward lines from pipe to each (sink, prefix) pair — the console
     gets the [rank] prefix, a per-rank capture file gets the raw line
-    (reference: horovod/runner/gloo_run.py MultiFile). A sink that fails
-    to write (capture disk full, dir deleted) is dropped so the others
-    keep streaming and the pipe stays drained (an abandoned pipe would
-    EPIPE-kill a healthy worker)."""
+    (reference: horovod/runner/gloo_run.py MultiFile). A capture-file sink
+    that fails twice in a row (disk full, dir deleted) is dropped so the
+    others keep streaming and the pipe stays drained (an abandoned pipe
+    would EPIPE-kill a healthy worker). Console sinks are never dropped:
+    a transient EINTR/EAGAIN on the console fd must not silence a rank
+    for the rest of the job — errors there are swallowed per line."""
     sinks = list(sinks)
+    console_sinks = set(id(s) for s, _ in console_sinks)
+    failed_once = set()
     try:
         for raw in iter(pipe.readline, b""):
             line = raw.decode(errors="replace")
@@ -44,8 +48,14 @@ def _stream(pipe, sinks):
                 try:
                     sink.write(f"{prefix}{line}")
                     sink.flush()
+                    failed_once.discard(id(sink))
                 except (OSError, ValueError):
-                    sinks.remove(pair)
+                    if id(sink) in console_sinks:
+                        continue  # keep console unconditionally
+                    if id(sink) in failed_once:
+                        sinks.remove(pair)
+                    else:
+                        failed_once.add(id(sink))
     finally:
         pipe.close()
 
@@ -57,21 +67,20 @@ def _safe_rank_name(rank):
 
 
 def reset_capture_dir(output_dir):
-    """Truncate existing rank.*/stdout|stderr once per LAUNCH so runs
-    don't concatenate; per-process opens append so same-job elastic
-    respawns keep earlier attempts."""
+    """Remove stale rank.* capture dirs once per LAUNCH so runs don't
+    concatenate and a later launch with fewer ranks doesn't leave old
+    empty rank.N dirs that read as ranks-with-no-output. Per-process
+    opens append so same-job elastic respawns keep earlier attempts."""
+    import shutil
     if not output_dir or not os.path.isdir(output_dir):
         return
     for name in os.listdir(output_dir):
         if not name.startswith("rank."):
             continue
-        for leaf in ("stdout", "stderr"):
-            path = os.path.join(output_dir, name, leaf)
-            if os.path.exists(path):
-                try:
-                    open(path, "w").close()
-                except OSError:
-                    pass
+        try:
+            shutil.rmtree(os.path.join(output_dir, name))
+        except OSError:
+            pass
 
 
 class SlotProcess:
@@ -120,10 +129,12 @@ class SlotProcess:
             err_sinks.append((fe, ""))
         self._pumps = [
             threading.Thread(target=_stream,
-                             args=(self.proc.stdout, out_sinks),
+                             args=(self.proc.stdout, out_sinks,
+                                   out_sinks[:1]),
                              daemon=True),
             threading.Thread(target=_stream,
-                             args=(self.proc.stderr, err_sinks),
+                             args=(self.proc.stderr, err_sinks,
+                                   err_sinks[:1]),
                              daemon=True),
         ]
         for t in self._pumps:
