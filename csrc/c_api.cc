@@ -64,6 +64,15 @@ void hvd_core_set_fusion_threshold(void* h, int64_t bytes) {
   static_cast<Ctx*>(h)->core->SetFusionThreshold(bytes);
 }
 
+// Host topology for hierarchical collectives: host_of[r] = host index of
+// global rank r; threshold = min buffer bytes for the two-level path
+// (0 disables).
+void hvd_core_set_topology(void* h, const int32_t* host_of, int n,
+                           int64_t threshold) {
+  std::vector<int> hosts(host_of, host_of + n);
+  static_cast<Ctx*>(h)->core->SetTopology(hosts, threshold);
+}
+
 // Rendezvous bootstrap: reserve (bind+listen) an ephemeral port that a
 // later hvd_core_create consumes, closing the publish-then-rebind race.
 int hvd_reserve_listen_port() { return ReserveListenPort(); }

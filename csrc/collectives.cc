@@ -4,6 +4,7 @@
 #include <cmath>
 #include <cstring>
 #include <limits>
+#include <map>
 
 namespace hvdcore {
 namespace {
@@ -710,6 +711,79 @@ Status DisseminationBarrier(Transport* t) {
     if (!st.ok()) return st;
   }
   return Status::OK();
+}
+
+Status HierarchicalAllreduce(Transport* t, void* vbuf, int64_t count,
+                             DataType dtype, RedOp op,
+                             const std::vector<int>& host_of) {
+  const int size = t->size();
+  const int rank = t->rank();
+  if (static_cast<int>(host_of.size()) != size)
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "host_of size != transport size");
+  if (size == 1 || count == 0) return Status::OK();
+
+  // Group ranks by host in ONE pass (this runs per collective on the
+  // cycle thread — keep it O(size)). Rank order within a host defines
+  // the local order; hosts are numbered by first appearance.
+  std::map<int, int> host_slot;        // host id -> dense host index
+  std::vector<std::vector<int>> by_host;
+  for (int r = 0; r < size; ++r) {
+    auto it = host_slot.find(host_of[r]);
+    if (it == host_slot.end()) {
+      it = host_slot.emplace(host_of[r],
+                             static_cast<int>(by_host.size())).first;
+      by_host.emplace_back();
+    }
+    by_host[it->second].push_back(r);
+  }
+  const std::vector<int>& my_local = by_host[host_slot[host_of[rank]]];
+  const int k = static_cast<int>(my_local.size());
+  const int num_hosts = static_cast<int>(by_host.size());
+  if (k == 1 || num_hosts == 1)
+    return RingAllreduce(t, vbuf, count, dtype, op);
+  // Chunk boundaries must agree across hosts: require homogeneous local
+  // sizes (the reference's hierarchical paths assume the same).
+  for (const auto& group : by_host) {
+    if (static_cast<int>(group.size()) != k)
+      return Status::Error(StatusCode::kInvalidArgument,
+                           "hierarchical allreduce needs equal ranks per "
+                           "host");
+  }
+
+  int li = 0;
+  while (my_local[li] != rank) ++li;
+  // Cross-host group: the rank holding local index li on every host
+  // (first-appearance host order keeps it identical on every rank).
+  std::vector<int> cross;
+  cross.reserve(num_hosts);
+  for (const auto& group : by_host) cross.push_back(group[li]);
+  int ci = 0;
+  while (cross[ci] != rank) ++ci;
+
+  const size_t esize = DataTypeSize(dtype);
+  auto offsets = EvenOffsets(count, k);
+  std::vector<int64_t> counts(k);
+  for (int i = 0; i < k; ++i) counts[i] = offsets[i + 1] - offsets[i];
+  uint8_t* buf = static_cast<uint8_t*>(vbuf);
+
+  // 1. Intra-host reduce-scatter: local rank li ends up owning the
+  //    locally-reduced chunk li.
+  SubsetTransport local(t, my_local, li);
+  std::vector<uint8_t> shard(static_cast<size_t>(counts[li]) * esize);
+  Status st = RingReducescatter(&local, buf, shard.data(), counts, dtype,
+                                op);
+  if (!st.ok()) return st;
+
+  // 2. Cross-host ring allreduce of chunk li among the hosts' li-ranks —
+  //    the only phase that touches the cross-host network, moving
+  //    count/k elements instead of count.
+  SubsetTransport xhost(t, cross, ci);
+  st = RingAllreduce(&xhost, shard.data(), counts[li], dtype, op);
+  if (!st.ok()) return st;
+
+  // 3. Intra-host allgather of the fully-reduced chunks.
+  return RingAllgatherv(&local, shard.data(), buf, counts, dtype);
 }
 
 }  // namespace hvdcore

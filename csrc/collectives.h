@@ -21,6 +21,45 @@ namespace hvdcore {
 Status RingAllreduce(Transport* t, void* buf, int64_t count, DataType dtype,
                      RedOp op);
 
+// Rank-subset adapter: expose members of a parent transport as a dense
+// 0..k-1 transport (the SubsetTransport of the hierarchical algorithms;
+// the mux-channel twin for process sets is core.h ChannelView).
+class SubsetTransport : public Transport {
+ public:
+  // members: parent ranks in subset order; my_index: this rank's slot.
+  SubsetTransport(Transport* base, std::vector<int> members, int my_index)
+      : base_(base), members_(std::move(members)), my_index_(my_index) {}
+  int rank() const override { return my_index_; }
+  int size() const override { return static_cast<int>(members_.size()); }
+  Status Send(int to, const void* data, size_t len) override {
+    return base_->Send(members_[to], data, len);
+  }
+  Status Recv(int from, std::vector<uint8_t>* out) override {
+    return base_->Recv(members_[from], out);
+  }
+  Status SendRecv(int to, const void* sdata, size_t slen, int from,
+                  std::vector<uint8_t>* out) override {
+    return base_->SendRecv(members_[to], sdata, slen, members_[from], out);
+  }
+  void Close() override {}
+
+ private:
+  Transport* base_;
+  std::vector<int> members_;
+  int my_index_;
+};
+
+// Two-level allreduce for multi-host topologies (reference:
+// horovod/common/ops/nccl_operations.cc:267 NCCLHierarchicalAllreduce /
+// mpi_operations.cc:331 shared-mem hierarchical allgather): intra-host
+// reduce-scatter to spread the load, cross-host ring allreduce among the
+// per-host shards, intra-host allgather. host_of[r] = host index of
+// transport rank r. Cross-host traffic drops from N ring peers to
+// num_hosts, which is the win once per-host rank counts grow.
+Status HierarchicalAllreduce(Transport* t, void* buf, int64_t count,
+                             DataType dtype, RedOp op,
+                             const std::vector<int>& host_of);
+
 // Gather variable-size blocks: rank r contributes counts[r] elements from
 // sendbuf; recvbuf (sum(counts) elements) receives blocks ordered by rank.
 Status RingAllgatherv(Transport* t, const void* sendbuf, void* recvbuf,

@@ -211,6 +211,12 @@ void Core::SetFusionThreshold(int64_t bytes) {
       kv.second->controller->set_fusion_threshold(bytes);
 }
 
+void Core::SetTopology(const std::vector<int>& host_of, int64_t threshold) {
+  std::lock_guard<std::mutex> g(mu_);
+  host_of_ = host_of;
+  hierarchical_threshold_ = threshold;
+}
+
 void Core::CompleteHandle(int64_t handle, HandleState state,
                           const std::string& error) {
   auto it = handles_.find(handle);
@@ -426,14 +432,48 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
       }
       if (!fused && resp.prescale != 1.0)
         ScaleBuffer(buf, total, resp.dtype, resp.prescale);
+      // Two-level path: engaged for large buffers on a known multi-host
+      // topology (SetTopology). host_of_ indexes GLOBAL ranks; the view
+      // ranks are process-set-local, so remap through ps.members.
+      // Snapshot under mu_: SetTopology is runtime-settable (autotune)
+      // and the cycle thread must not read the vector mid-reassignment.
+      std::vector<int> topo_snapshot;
+      int64_t hier_threshold;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        topo_snapshot = host_of_;
+        hier_threshold = hierarchical_threshold_;
+      }
+      std::vector<int> view_hosts;
+      if (resp.op != RedOp::kAdasum && hier_threshold > 0 &&
+          static_cast<int64_t>(total * esize) >= hier_threshold &&
+          !topo_snapshot.empty()) {
+        view_hosts.reserve(ps.members.size());
+        bool ok = true;
+        for (int g : ps.members) {
+          ok = ok && g >= 0 && g < static_cast<int>(topo_snapshot.size());
+          if (ok) view_hosts.push_back(topo_snapshot[g]);
+        }
+        if (!ok) view_hosts.clear();
+      }
+      const bool hier = !view_hosts.empty();
       if (timeline_)
         timeline_->ActivityStart(resp.names[0],
                                  resp.op == RedOp::kAdasum
                                      ? "VHDD_ADASUM"
-                                     : "RING_ALLREDUCE");
-      st = resp.op == RedOp::kAdasum
-               ? VhddAdasum(view, buf, total, resp.dtype)
-               : RingAllreduce(view, buf, total, resp.dtype, resp.op);
+                                     : (hier ? "HIERARCHICAL_ALLREDUCE"
+                                             : "RING_ALLREDUCE"));
+      if (resp.op == RedOp::kAdasum) {
+        st = VhddAdasum(view, buf, total, resp.dtype);
+      } else if (hier) {
+        st = HierarchicalAllreduce(view, buf, total, resp.dtype, resp.op,
+                                   view_hosts);
+        // Heterogeneous local sizes are detected inside; fall back flat.
+        if (!st.ok() && st.code == StatusCode::kInvalidArgument)
+          st = RingAllreduce(view, buf, total, resp.dtype, resp.op);
+      } else {
+        st = RingAllreduce(view, buf, total, resp.dtype, resp.op);
+      }
       if (timeline_) timeline_->ActivityEnd(resp.names[0]);
       if (st.ok() && resp.postscale != 1.0)
         ScaleBuffer(buf, total, resp.dtype, resp.postscale);

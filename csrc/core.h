@@ -136,6 +136,11 @@ class Core {
   // Apply an autotuned fusion threshold to every process-set controller.
   void SetFusionThreshold(int64_t bytes);
 
+  // Host topology for hierarchical collectives: host_of[r] = host index
+  // of global rank r; threshold = minimum buffer bytes before the
+  // two-level path engages (0 disables). Settable at runtime (autotune).
+  void SetTopology(const std::vector<int>& host_of, int64_t threshold);
+
   void RequestShutdown() { shutdown_requested_.store(true); }
   bool ShutdownComplete() const { return shutdown_complete_.load(); }
 
@@ -190,6 +195,8 @@ class Core {
   CoreOptions opts_;
   std::unique_ptr<MuxTransport> mux_;
   std::unique_ptr<Timeline> timeline_;
+  std::vector<int> host_of_;            // empty = flat topology
+  int64_t hierarchical_threshold_ = 0;  // bytes; 0 = disabled
 
   std::mutex mu_;  // guards handles_ + queues + process-set table
   std::condition_variable cv_;

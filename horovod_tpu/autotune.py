@@ -6,17 +6,30 @@ horovod/common/parameter_manager.cc, optim/bayesian_optimization.cc),
 scoring each candidate by observed bytes/sec and broadcasting winners
 (reference: controller.cc:39-53 SynchronizeParameters).
 
-TPU-native rethink: the dominant knobs are the same two — fusion threshold
-and cycle time — but the search space is small, so a deterministic
-coordinate sweep over a discrete grid replaces the GP (the reference's
-categorical mode, parameter_manager.h:59-78). Candidate changes are driven
-by the CYCLE COUNTER, which is identical on every rank in SPMD mode (each
-negotiation round is collective), so all ranks apply the same candidate at
-the same cycle without any extra message. Only the final winner needs
-cross-rank agreement (scores are timing-noisy): rank 0's choice broadcasts
-over the data plane, the analog of SynchronizeParameters.
+TPU-native rethink: the knob space is small and discrete, so **successive
+halving** replaces the GP — every candidate gets a short scoring window,
+the top half survives into a longer round (the final head-to-head runs at
+the full configured window), repeat until one remains.
+Total cycles ≈ 2x an exhaustive sweep at the FINAL budget while having
+screened 2^rounds more candidates, which is the bandit-style tradeoff the
+reference buys with its GP.
+
+Knobs: fusion threshold and cycle time (the host-plane pair the reference
+tunes) plus the **delegated-plane minimum bucket size** — on TPU the
+XLA-executed collectives round flat buffers up to a bucket
+(backend/xla_global.py _bucket), and a larger minimum bucket turns a
+flood of small allreduces into fewer, fuller launches; this is the knob
+that actually matters on the chip.
+
+Determinism: candidate changes are driven by the ACTIVE-cycle counter,
+identical on every rank in SPMD mode (each negotiation round is
+collective), so all ranks apply the same candidate at the same cycle with
+no extra message. Scores are timing-noisy and rank-local, so every
+round boundary broadcasts rank 0's survivor set over the data plane (the
+SynchronizeParameters analog); convergence broadcasts the final winner.
 """
 
+import math
 import time
 
 import numpy as np
@@ -27,8 +40,10 @@ from .utils.logging_util import get_logger
 # Discrete candidate grids (reference sweeps similar ranges).
 FUSION_CANDIDATES_MIB = [0, 1, 2, 4, 8, 16, 32, 64, 128]
 CYCLE_CANDIDATES_MS = [0.1, 0.5, 1.0, 2.5, 5.0, 10.0]
+BUCKET_CANDIDATES = [256, 4096, 65536]
 WARMUP_CYCLES = 10
-CYCLES_PER_CANDIDATE = 20
+CYCLES_PER_CANDIDATE = 20   # budget of the FINAL round; early rounds
+                            # screen at budget >> 2^(rounds remaining)
 
 
 def _env_list(name, default, conv):
@@ -39,7 +54,7 @@ def _env_list(name, default, conv):
 
 
 class ParameterManager:
-    """Cycle-driven knob sweep; see module docstring."""
+    """Cycle-driven successive-halving sweep; see module docstring."""
 
     def __init__(self, runtime):
         self.runtime = runtime
@@ -50,19 +65,30 @@ class ParameterManager:
                            FUSION_CANDIDATES_MIB, float)
         cycle = _env_list("AUTOTUNE_CYCLE_CANDIDATES_MS",
                           CYCLE_CANDIDATES_MS, float)
+        # The bucket knob only exists on delegated (XLA data plane)
+        # backends; tuning it elsewhere would burn windows on a no-op.
+        if hasattr(runtime.backend, "set_min_bucket"):
+            bucket = _env_list("AUTOTUNE_BUCKET_CANDIDATES",
+                               BUCKET_CANDIDATES, int)
+        else:
+            bucket = [None]
         self._warmup = envparse.get_int("AUTOTUNE_WARMUP_CYCLES",
                                         WARMUP_CYCLES)
-        self._per_candidate = envparse.get_int(
+        self._final_budget = envparse.get_int(
             "AUTOTUNE_CYCLES_PER_CANDIDATE", CYCLES_PER_CANDIDATE)
-        self._grid = [(int(f * 1024 * 1024), c) for f in fusion
-                      for c in cycle]
+        self._grid = [(int(f * 1024 * 1024), c, b)
+                      for f in fusion for c in cycle for b in bucket]
+        self._active = list(range(len(self._grid)))
+        self._budget = self._round_budget(len(self._active))
+        self._pos = -1               # index into _active; -1 = warming up
         self._cycle = 0
-        self._window = 0            # scored cycles under current candidate
-        self._idx = -1              # -1 = still warming up
-        self._scores = {}           # candidate index -> [bytes/sec]
+        self._window = 0
+        self._round_scores = {}      # candidate -> [bytes/sec] this round
+        self._history = []           # (round, cand_idx, mean) for the log
+        self._round = 0
         self._last_bytes = 0
         self._last_time = time.monotonic()
-        self.best = None            # set at convergence
+        self.best = None             # set at convergence
 
     # -- called once per coordinator cycle --------------------------------
     def record_cycle(self):
@@ -86,59 +112,91 @@ class ParameterManager:
         self._last_bytes = bytes_now
         self._last_time = now
 
-        if self._idx == -1:
+        if self._pos == -1:
             # Warming up (warmup=0 => candidate 0 applies on the first
             # active cycle; scoring starts the cycle after it applied).
             if self._cycle >= self._warmup:
-                self._set_candidate(0)
+                self._set_position(0)
             return
-        self._scores.setdefault(self._idx, []).append(score)
+        cand = self._active[self._pos]
+        self._round_scores.setdefault(cand, []).append(score)
         self._window += 1
-        if self._window >= self._per_candidate:
-            nxt = self._idx + 1
-            if nxt >= len(self._grid):
-                self._converge()
+        if self._window >= self._budget:
+            if self._pos + 1 < len(self._active):
+                self._set_position(self._pos + 1)
             else:
-                self._set_candidate(nxt)
+                self._halve()
 
-    def _set_candidate(self, idx):
-        self._idx = idx
+    def _round_budget(self, n_active):
+        """Scoring window for a round with n_active candidates: the LAST
+        round (2 survivors) runs at exactly AUTOTUNE_CYCLES_PER_CANDIDATE;
+        earlier rounds screen at that budget halved once per remaining
+        halving (floor 2). keep=n//2 needs ceil(log2 n) halvings."""
+        if n_active <= 1:
+            return self._final_budget
+        rounds_left = max(1, math.ceil(math.log2(n_active)))
+        return max(2, self._final_budget >> (rounds_left - 1))
+
+    def _set_position(self, pos):
+        self._pos = pos
         self._window = 0
-        self._apply(self._grid[idx])
+        self._apply(self._grid[self._active[pos]])
 
-    def _converge(self):
-        """Rank 0's argmax wins and broadcasts over the data plane (the
-        SynchronizeParameters analog); ranks reach here at the same point
-        in their cycle streams because convergence is cycle-count driven."""
-        local_best = max(
-            self._scores,
-            key=lambda i: sum(self._scores[i]) / len(self._scores[i]))
+    def _agree(self, indices):
+        """Rank 0's candidate-index selection broadcasts over the data
+        plane (the SynchronizeParameters analog); every rank reaches this
+        at the same active cycle, so the collective lines up. The vector
+        is fixed-length (grid-sized mask) so no shape negotiation is
+        needed."""
         rt = self.runtime
-        winner = local_best
         from . import basics
-        if rt.mode == basics.MODE_SPMD and rt.topology.size > 1:
-            from .process_sets import global_process_set
-            out = rt.backend.broadcast(
-                [np.asarray([local_best], np.int32)], 0,
-                global_process_set)
-            winner = int(np.asarray(out[0])[0])
+        if rt.mode != basics.MODE_SPMD or rt.topology.size <= 1:
+            return indices
+        from .process_sets import global_process_set
+        mask = np.zeros(len(self._grid), np.int32)
+        mask[np.asarray(indices, np.int32)] = 1
+        out = rt.backend.broadcast([mask], 0, global_process_set)
+        got = np.flatnonzero(np.asarray(out[0]))
+        return [int(i) for i in got]
+
+    def _halve(self):
+        means = {i: sum(s) / len(s) for i, s in self._round_scores.items()}
+        for i, m in sorted(means.items()):
+            self._history.append((self._round, i, m))
+        keep = max(1, len(self._active) // 2)
+        # Ordered by score desc, ties broken by grid order (deterministic
+        # on rank 0; everyone else takes the broadcast).
+        survivors = sorted(sorted(means), key=lambda i: -means[i])[:keep]
+        survivors = self._agree(sorted(survivors))
+        if len(survivors) == 1:
+            self._converge(survivors[0])
+            return
+        self._active = survivors
+        self._round += 1
+        self._budget = self._round_budget(len(survivors))
+        self._round_scores = {}
+        self._set_position(0)
+
+    def _converge(self, winner):
         self.best = self._grid[winner]
         self._apply(self.best)
         # Last: observers poll `enabled`, so best/knobs must be in place
         # before the flag flips (the worker thread races this method).
         self.enabled = False
-        self._log.info("autotune converged: fusion=%dB cycle=%.2fms",
-                       self.best[0], self.best[1])
+        self._log.info(
+            "autotune converged after %d halving round(s): fusion=%dB "
+            "cycle=%.2fms bucket=%s", self._round + 1, self.best[0],
+            self.best[1], self.best[2])
         if self._log_path:
             with open(self._log_path, "a") as f:
-                for idx, scores in sorted(self._scores.items()):
+                for rnd, idx, mean in self._history:
                     cand = self._grid[idx]
                     marker = "*" if idx == winner else ""
-                    f.write(f"{cand[0]},{cand[1]},"
-                            f"{sum(scores)/len(scores):.1f}{marker}\n")
+                    f.write(f"r{rnd},{cand[0]},{cand[1]},{cand[2]},"
+                            f"{mean:.1f}{marker}\n")
 
     def _apply(self, cand):
-        fusion, cycle_ms = cand
+        fusion, cycle_ms, bucket = cand
         coord = self.runtime.coordinator
         coord.fusion_threshold = max(fusion, 1)
         coord.cycle_time_s = cycle_ms / 1000.0
@@ -149,3 +207,5 @@ class ParameterManager:
             # fusion logic). Deterministic across ranks: candidate changes
             # are cycle-count driven.
             backend.core.set_fusion_threshold(max(fusion, 1))
+        if bucket is not None and hasattr(backend, "set_min_bucket"):
+            backend.set_min_bucket(bucket)

@@ -76,6 +76,19 @@ class TcpBackend(Backend):
             else "",
             delegate_data_ops=self.delegate_data_ops)
         self.topology = topology
+        # Hierarchical allreduce: derive host_of[] from the peer list's
+        # host parts (every worker already knows the full mesh) and arm
+        # the threshold. Default 1 MiB: below that the extra phases cost
+        # more latency than the cross-host bandwidth they save.
+        hier = envparse.get_int(envparse.HIERARCHICAL_THRESHOLD,
+                                1 << 20)
+        if hier > 0:
+            host_names = [p.rsplit(":", 1)[0] for p in peers.split(",")]
+            host_ids = {}
+            host_of = [host_ids.setdefault(h, len(host_ids))
+                       for h in host_names]
+            if len(host_ids) > 1:
+                self.core.set_topology(host_of, hier)
         self._pending = []
         self._transport_dead = False
         # handle -> submitted np array (delegated execution needs the

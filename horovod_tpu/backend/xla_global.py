@@ -40,11 +40,13 @@ def _enum_to_np():
     return {v: k for k, v in native._dtype_table().items()}
 
 
-def _bucket(n):
-    """Round element counts up to the next power of two (min 256) so the
-    jitted-collective cache sees a bounded set of shapes instead of one
-    compilation per fusion-bucket size."""
-    b = 256
+def _bucket(n, min_b=256):
+    """Round element counts up to the next power of two (min ``min_b``) so
+    the jitted-collective cache sees a bounded set of shapes instead of
+    one compilation per fusion-bucket size. The minimum is the autotuned
+    delegated-plane knob (autotune.py): raising it turns a flood of small
+    collectives into fewer, fuller launches."""
+    b = min_b
     while b < n:
         b <<= 1
     return b
@@ -182,7 +184,16 @@ class XlaGlobalBackend(TcpBackend):
         self._proc_devices = [by_proc[i] for i in range(topology.size)]
         self._ps_ranks = {0: list(range(topology.size))}
         self._mesh_cache = {}
+        # Delegated-plane bucket floor (autotunable; see autotune.py).
+        self.min_bucket = envparse.get_int("MIN_BUCKET", 256)
         self._fn_cache = {}
+
+    def set_min_bucket(self, n):
+        """Autotune hook: floor for collective bucket sizes (elements).
+        Applied at a cycle boundary on every rank with the same value
+        (candidate changes are cycle-count driven, autotune.py), so the
+        jitted-collective cache stays consistent across ranks."""
+        self.min_bucket = max(1, int(n))
 
     # -- process sets -----------------------------------------------------
     def register_process_set(self, ps):
@@ -354,9 +365,9 @@ class XlaGlobalBackend(TcpBackend):
         flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
         n = int(flat.shape[0])
         fn = self._collective(
-            mesh, "allreduce", _bucket(n), dtype,
+            mesh, "allreduce", _bucket(n, self.min_bucket), dtype,
             (op, float(d["postscale"])))
-        out = self._run_stacked(mesh, fn, _pad(flat, _bucket(n), op))[0]
+        out = self._run_stacked(mesh, fn, _pad(flat, _bucket(n, self.min_bucket), op))[0]
         off = 0
         for h, nelem in zip(d["handles"], sizes):
             nelem = int(nelem)
@@ -377,9 +388,11 @@ class XlaGlobalBackend(TcpBackend):
             arr = np.zeros(count, dtype=dtype)
             shape = None
         flat = arr.reshape(-1)
-        fn = self._collective(mesh, "broadcast", _bucket(count), dtype,
+        fn = self._collective(mesh, "broadcast",
+                              _bucket(count, self.min_bucket), dtype,
                               (root,))
-        out = self._run_stacked(mesh, fn, _pad(flat, _bucket(count)))[0]
+        out = self._run_stacked(
+            mesh, fn, _pad(flat, _bucket(count, self.min_bucket)))[0]
         if h >= 0:
             self.core.delegated_complete(h, out[:count].reshape(shape))
 
@@ -397,7 +410,7 @@ class XlaGlobalBackend(TcpBackend):
         else:
             tail = None
             flat = np.zeros(rows[me] * row_elems, dtype=dtype)
-        bn = _bucket(max_n) if max_n else 256
+        bn = _bucket(max_n, self.min_bucket) if max_n else self.min_bucket
         padded = np.zeros(bn, dtype=dtype)
         padded[:flat.shape[0]] = flat
         fn = self._collective(mesh, "allgather", bn, dtype)
@@ -428,10 +441,11 @@ class XlaGlobalBackend(TcpBackend):
         if pre != 1.0:
             flat = flat * np.asarray(pre, dtype=dtype)
         fn = self._collective(
-            mesh, "allreduce", _bucket(flat.shape[0]), dtype,
+            mesh, "allreduce", _bucket(flat.shape[0], self.min_bucket), dtype,
             (op, float(d["postscale"])))
         out = self._run_stacked(mesh, fn,
-                                _pad(flat, _bucket(flat.shape[0]), op))[0]
+                                _pad(flat, _bucket(flat.shape[0],
+                                                   self.min_bucket), op))[0]
         base, rem = divmod(rows, nranks)
         my_rows = base + (1 if me < rem else 0)
         offset_rows = me * base + min(me, rem)

@@ -134,6 +134,9 @@ def load_library():
         lib.hvd_core_bytes_processed.argtypes = [ctypes.c_void_p]
         lib.hvd_core_set_fusion_threshold.argtypes = [
             ctypes.c_void_p, ctypes.c_int64]
+        lib.hvd_core_set_topology.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int64]
         lib.hvd_core_next_delegated.restype = ctypes.c_int64
         lib.hvd_core_next_delegated.argtypes = [ctypes.c_void_p]
         lib.hvd_core_delegated_info.argtypes = [
@@ -310,6 +313,15 @@ class NativeCore:
         """Apply an autotuned fusion threshold (all ranks must call with
         the same value at the same cycle boundary)."""
         self._lib.hvd_core_set_fusion_threshold(self._ctx, int(nbytes))
+
+    def set_topology(self, host_of, threshold):
+        """Host map for hierarchical collectives: host_of[r] = host index
+        of global rank r; buffers >= threshold bytes take the two-level
+        (intra-host reduce-scatter / cross-host ring / intra-host
+        allgather) allreduce. threshold 0 disables."""
+        arr = (ctypes.c_int32 * len(host_of))(*host_of)
+        self._lib.hvd_core_set_topology(self._ctx, arr, len(host_of),
+                                        int(threshold))
 
     # -- delegated execution (external XLA data plane) --------------------
     def next_delegated(self):

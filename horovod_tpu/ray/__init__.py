@@ -92,4 +92,18 @@ class RayExecutor:
             self._job = None
 
 
-__all__ = ["RayExecutor", "ClusterJob", "cluster_task_bootstrap"]
+def __getattr__(name):
+    # Lazy: the elastic executor and strategies import ray only on use.
+    if name in ("ElasticRayExecutor", "RayHostDiscovery"):
+        from . import elastic
+        return getattr(elastic, name)
+    if name in ("PlacementStrategy", "ColocatedStrategy", "SpreadStrategy",
+                "strategy_for"):
+        from . import strategy
+        return getattr(strategy, name)
+    raise AttributeError(name)
+
+
+__all__ = ["RayExecutor", "ElasticRayExecutor", "RayHostDiscovery",
+           "ClusterJob", "cluster_task_bootstrap", "ColocatedStrategy",
+           "SpreadStrategy", "strategy_for"]

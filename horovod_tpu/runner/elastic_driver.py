@@ -83,10 +83,13 @@ class _Worker:
 class ElasticDriver:
     """Owns the rendezvous server and the worker fleet for one job."""
 
-    def __init__(self, elastic, command):
+    def __init__(self, elastic, command, discovery=None):
         self.elastic = elastic
         self.command = command
-        self.discovery = HostDiscovery(elastic)
+        # Pluggable membership source: anything with find_available_hosts()
+        # -> [HostInfo]. The Ray integration substitutes actor-cluster
+        # discovery here (ray/elastic.py RayHostDiscovery).
+        self.discovery = discovery or HostDiscovery(elastic)
         self.token = new_job_token()
         self.server = RendezvousServer(job_token=self.token,
                                        verbose=elastic.base.verbose)

@@ -153,7 +153,7 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
             loss_val = loss_fn(model(x), y)
             loss_val.backward()
             optimizer.step()
-            total += float(loss_val)
+            total += float(loss_val.detach())
         # Cross-rank metric averaging (the MetricAverageCallback analog).
         avg = float(hvd.allreduce(
             torch.tensor([total / steps]), name=f"ep{epoch}.loss"))

@@ -72,15 +72,21 @@ def _np_of(tensor):
 
 def _eager(fn, tensors, out_dtypes, name):
     """Run fn (numpy -> list[numpy]) now if eager, else via py_function so
-    it works inside tf.function graphs."""
+    it works inside tf.function graphs. Results are cast back to
+    out_dtypes: the data plane runs x64-off, so float64/int64 inputs come
+    back narrowed and the reference contract (result dtype == input
+    dtype) must be restored here."""
     tf = _tf()
+
+    def restore(outs):
+        return [tf.cast(tf.convert_to_tensor(o), dt)
+                for o, dt in zip(outs, out_dtypes)]
+
     if tf.executing_eagerly():
-        outs = fn([_np_of(t) for t in tensors])
-        return [tf.convert_to_tensor(o) for o in outs]
+        return restore(fn([_np_of(t) for t in tensors]))
 
     def wrapper(*args):
-        outs = fn([a.numpy() for a in args])
-        return [tf.convert_to_tensor(o) for o in outs]
+        return restore(fn([a.numpy() for a in args]))
 
     return tf.py_function(func=wrapper, inp=list(tensors), Tout=out_dtypes)
 

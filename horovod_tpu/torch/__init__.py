@@ -6,8 +6,9 @@ Process-level semantics (one process per accelerator under ``hvdrun``).
 Async ops return a handle immediately; ``synchronize(handle)`` blocks and
 writes the result back (in-place for ``*_`` variants) — the same contract
 as the reference's pybind handle manager (mpi_ops_v2.cc:624). bfloat16
-tensors ride the wire as float32 (numpy has no native bf16) and are cast
-back on completion; results always come back in the input tensor's dtype.
+tensors enter the data plane natively via dlpack (no fp32 upcast);
+results always come back in the input tensor's dtype. Model math itself
+can run on the TPU through :func:`tpu_compile` (fx→JAX, compile.py).
 Caveat: the compiled data plane runs with JAX x64 disabled, so int64
 values beyond 2^31 and float64 precision are not preserved end to end.
 """
@@ -58,22 +59,40 @@ def _spmd():
 
 
 def _to_np(t):
+    """torch tensor -> data-plane array. CPU fp32/int tensors hand over
+    their buffer zero-copy via the numpy protocol; bf16 enters through
+    dlpack as a NATIVE jax bfloat16 array (no fp32 upcast round-trip —
+    numpy has no bf16, but the plane does). Falls back to the historical
+    fp32-upcast when dlpack is unavailable."""
     torch = _torch()
     t = t.detach()
     if t.dtype == torch.bfloat16:
+        if t.device.type == "cpu":
+            try:
+                import jax
+                return jax.dlpack.from_dlpack(t.contiguous()), \
+                    torch.bfloat16
+            except (TypeError, RuntimeError, BufferError):
+                pass
         return t.float().cpu().numpy(), torch.bfloat16
     return t.cpu().numpy(), None
 
 
 def _from_np(arr, like, bf16):
     torch = _torch()
-    arr = np.ascontiguousarray(arr)
+    # ascontiguousarray would promote 0-d to (1,): keep scalars 0-d.
+    arr = np.ascontiguousarray(arr) if arr.ndim else np.asarray(arr)
     if not arr.flags.writeable:
         # np.asarray(jax_array) is a read-only zero-copy view of the JAX
         # buffer; torch must not alias it (in-place user ops would write
         # into backend-owned memory).
         arr = arr.copy()
-    out = torch.from_numpy(arr)
+    if arr.dtype.name == "bfloat16":
+        # ml_dtypes bf16 out of the native-bf16 plane: torch can't read
+        # it through numpy — reinterpret the bits (free) instead.
+        out = torch.from_numpy(arr.view(np.uint16)).view(torch.bfloat16)
+    else:
+        out = torch.from_numpy(arr)
     if like is not None:
         # Restore the input dtype: the data plane may have narrowed
         # (int64->int32, float64->float32 under JAX x64-off).
@@ -540,6 +559,24 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     _module_synchronize = synchronize
 
     optimizer.__class__ = _Distributed
+    # LR schedulers created BEFORE this wrapper (torch's with_counter)
+    # shadow .step with an instance attribute that captured the original
+    # class step — calls would bypass synchronize() and the next backward
+    # would hit DuplicateNameError. Re-wrap the instance attribute so the
+    # scheduler's step counting survives AND gradients synchronize.
+    _inst_step = optimizer.__dict__.get("step")
+    if _inst_step is not None:
+        import functools
+
+        @functools.wraps(_inst_step)
+        def _dist_inst_step(closure=None):
+            if _spmd():
+                optimizer.synchronize()
+            optimizer._hvd_synchronized = False
+            return (_inst_step() if closure is None
+                    else _inst_step(closure))
+
+        optimizer.step = _dist_inst_step
     optimizer._hvd_handles = {}
     optimizer._hvd_counters = {}
     optimizer._hvd_sync_disabled = not _spmd()
@@ -558,3 +595,16 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                     p.register_post_accumulate_grad_hook(
                         optimizer._hvd_hook(p)))
     return optimizer
+
+
+def tpu_compile(module, input_names=None, example_inputs=None,
+                loss_key="loss", compute_dtype=None):
+    """Compile a torch module to run its math on the TPU via fx→JAX
+    (see horovod_tpu/torch/compile.py — the TPU-first replacement for
+    the reference's device-tensor adapter, mpi_ops_v2.cc:624).
+    ``compute_dtype=jnp.bfloat16`` enables mixed precision (fp32 master
+    weights, bf16 matmuls — the torch-xla XLA_USE_BF16 analog)."""
+    from .compile import tpu_compile as _impl
+    return _impl(module, input_names=input_names,
+                 example_inputs=example_inputs, loss_key=loss_key,
+                 compute_dtype=compute_dtype)

@@ -1,0 +1,716 @@
+"""torch.fx → JAX compiler: run torch model math on the TPU.
+
+The reference's torch binding delivers accelerator compute by handing
+GPU-resident torch tensors to the collective engine (reference:
+horovod/torch/mpi_ops_v2.cc:624, adapter_v2.cc:1-165). This image has no
+torch-xla and torch is CPU-only, so a tensor-adapter port would leave the
+model math on the host. The TPU-first answer is a *frontend bridge*: trace
+the torch module with ``torch.fx`` (HF models via
+``transformers.utils.fx``), convert the graph to a pure JAX function over
+a flat parameter dict, and let the existing JAX data plane (jit, shard_map
+collectives, optax optimizers, the Pallas kernels) do everything else.
+The torch module is the model *definition*; the chip runs XLA.
+
+    compiled = tpu_compile(model, input_names=["input_ids", "labels"])
+    out = compiled(input_ids=ids, labels=labels)        # jitted forward
+    step = compiled.make_train_step(optax.adamw(1e-4))   # fwd+bwd+update
+    loss = step(batch)                                   # on the chip
+    compiled.copy_params_to_module(model)                # sync back
+
+Supported surface: the op set emitted by fx traces of transformer-family
+models (BERT/GPT-style: Linear/LayerNorm/Embedding/Dropout/CELoss modules,
+scaled_dot_product_attention, arithmetic, shape ops). Unsupported nodes
+raise with the node name and op so coverage gaps are explicit, not silent.
+Dropout and attention-dropout are driven by a JAX PRNG key (deterministic
+per site); ``train=False`` disables them.
+
+Caveats: runs under JAX x64-off — int64 becomes int32 (fine for token ids
+and -100 label sentinels), float64 becomes float32. Data-dependent Python
+control flow in the torch module is out of scope (same restriction fx
+itself has).
+"""
+
+import math
+import operator
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_DTYPE_MAP_CACHE = None
+
+
+def _dtype_map():
+    """torch dtype -> numpy dtype under JAX x64-off semantics."""
+    global _DTYPE_MAP_CACHE
+    if _DTYPE_MAP_CACHE is None:
+        import torch
+        import jax.numpy as jnp
+        _DTYPE_MAP_CACHE = {
+            torch.float32: jnp.float32, torch.float64: jnp.float32,
+            torch.float16: jnp.float16, torch.bfloat16: jnp.bfloat16,
+            torch.int64: jnp.int32, torch.int32: jnp.int32,
+            torch.int16: jnp.int16, torch.int8: jnp.int8,
+            torch.uint8: jnp.uint8, torch.bool: jnp.bool_,
+        }
+    return _DTYPE_MAP_CACHE
+
+
+def _to_jax_dtype(dt):
+    """Accept a torch dtype, numpy dtype, or jax value's dtype."""
+    mapped = _dtype_map().get(dt)
+    return mapped if mapped is not None else dt
+
+
+def _t2j(tensor):
+    """torch tensor -> jax array (via numpy; bf16 upcast handled)."""
+    import torch
+    import jax.numpy as jnp
+    t = tensor.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        return jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+    if t.dtype == torch.int64:
+        return jnp.asarray(t.numpy().astype(np.int32))
+    return jnp.asarray(t.numpy())
+
+
+class _Device:
+    """Sentinel for getattr(x, 'device') results; consumed (and ignored)
+    by factory-function device= kwargs."""
+
+
+def _dropout(x, p, train, key):
+    jnp = _jnp()
+    if not train or p == 0.0 or key is None:
+        return x
+    import jax
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+
+
+def _sdpa(rng_key, train, q, k, v, attn_mask=None, dropout_p=0.0,
+          is_causal=False, scale=None):
+    """torch.nn.functional.scaled_dot_product_attention semantics on jax:
+    bool masks keep-where-True; float masks are additive."""
+    jnp = _jnp()
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            s = jnp.where(attn_mask, s, -1e30)
+        else:
+            s = s + attn_mask.astype(jnp.float32)
+    if is_causal:
+        sq, sk = q.shape[-2], k.shape[-2]
+        causal = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(causal, s, -1e30)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    p = _dropout(p, dropout_p, train, rng_key)
+    return jnp.einsum("...qk,...kd->...qd",
+                      p.astype(v.dtype), v)
+
+
+def _cross_entropy(logits, target, ignore_index=-100, reduction="mean",
+                   label_smoothing=0.0):
+    import jax
+    jnp = _jnp()
+    logits = logits.astype(jnp.float32)
+    n_class = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = target != ignore_index
+    tgt = jnp.where(valid, target, 0)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        smooth = -jnp.mean(logp, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+        del n_class
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+def _embedding(weight, ids, padding_idx=None):
+    del padding_idx  # affects only the gradient at pad rows; weights there
+    # are zero-initialized by torch, matching forward semantics.
+    return weight[ids]
+
+
+def _layer_norm(x, normalized_shape, weight, bias, eps):
+    jnp = _jnp()
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean((xf - mean) ** 2, axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + eps)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _linear(x, weight, bias=None):
+    jnp = _jnp()
+    out = jnp.matmul(x, weight.T)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _expand(x, *sizes):
+    jnp = _jnp()
+    if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+        sizes = tuple(sizes[0])
+    # Torch expand: -1 keeps the dim; leading new dims allowed.
+    ndim = len(sizes)
+    shape = list(sizes)
+    offset = ndim - x.ndim
+    for i in range(ndim):
+        if shape[i] == -1:
+            shape[i] = x.shape[i - offset] if i >= offset else 1
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def _masked_fill(x, mask, value):
+    jnp = _jnp()
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def _to(x, *args, **kwargs):
+    # .to(dtype) / .to(device) / .to(device, dtype) / .to(other_tensor)
+    jnp = _jnp()
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, _Device) or a is None or isinstance(a, str):
+            continue
+        if hasattr(a, "dtype") and hasattr(a, "shape"):  # tensor-like
+            return x.astype(a.dtype)
+        mapped = _to_jax_dtype(a)
+        try:
+            return x.astype(mapped)
+        except TypeError:
+            continue
+    return x
+
+
+def _size(x, dim=None):
+    return x.shape if dim is None else x.shape[dim]
+
+
+def _softmax(x, dim=-1, dtype=None):
+    import jax
+    jnp = _jnp()
+    xf = x.astype(jnp.float32)
+    out = jax.nn.softmax(xf, axis=dim)
+    if dtype is not None:
+        return out.astype(_to_jax_dtype(dtype))
+    return out.astype(x.dtype)
+
+
+def _build_function_table():
+    import torch
+    import torch.nn.functional as F
+    import jax
+    jnp = _jnp()
+
+    table = {
+        operator.add: operator.add, operator.sub: operator.sub,
+        operator.mul: operator.mul, operator.truediv: operator.truediv,
+        operator.floordiv: operator.floordiv, operator.mod: operator.mod,
+        operator.pow: operator.pow, operator.neg: operator.neg,
+        operator.eq: operator.eq, operator.ne: operator.ne,
+        operator.lt: operator.lt, operator.le: operator.le,
+        operator.gt: operator.gt, operator.ge: operator.ge,
+        operator.and_: operator.and_, operator.or_: operator.or_,
+        operator.invert: operator.invert,
+        operator.getitem: lambda x, idx: x[idx],
+        operator.matmul: jnp.matmul,
+        getattr: _getattr_node,
+        torch.matmul: jnp.matmul,
+        torch.bmm: jnp.matmul,
+        torch.einsum: jnp.einsum,
+        torch.cat: lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+        torch.stack: lambda ts, dim=0: jnp.stack(ts, axis=dim),
+        torch.where: jnp.where,
+        torch.tanh: jnp.tanh, torch.erf: jax.scipy.special.erf,
+        torch.exp: jnp.exp, torch.log: jnp.log, torch.sqrt: jnp.sqrt,
+        torch.rsqrt: lambda x: 1.0 / jnp.sqrt(x),
+        torch.abs: jnp.abs, torch.sigmoid: jax.nn.sigmoid,
+        torch.cumsum: lambda x, dim: jnp.cumsum(x, axis=dim),
+        torch.clamp: lambda x, min=None, max=None: jnp.clip(x, min, max),
+        torch.mean: lambda x, dim=None, keepdim=False: jnp.mean(
+            x, axis=dim, keepdims=keepdim),
+        torch.pow: jnp.power,
+        torch.finfo: lambda dt: jnp.finfo(_to_jax_dtype(dt)),
+        F.relu: jax.nn.relu,
+        F.gelu: _gelu,
+        F.silu: jax.nn.silu,
+        F.tanh: jnp.tanh,
+        F.softmax: _softmax,
+        F.linear: _linear,
+        F.embedding: lambda ids, w, padding_idx=None, **kw: w[ids],
+        F.layer_norm: lambda x, shape, weight=None, bias=None, eps=1e-5:
+            _layer_norm(x, shape, weight, bias, eps),
+        F.cross_entropy: _cross_entropy,
+    }
+    # gelu may be traced as the C-level builtin (torch._C._nn.gelu)
+    try:
+        table[torch._C._nn.gelu] = _gelu
+        table[torch._C._nn.linear] = _linear
+        table[torch._C._nn.scaled_dot_product_attention] = "sdpa"
+    except AttributeError:
+        pass
+    table[F.scaled_dot_product_attention] = "sdpa"
+    table[F.dropout] = "dropout"
+
+    def factory(fill):
+        def make(size, *rest, dtype=None, device=None, **kw):
+            del device, kw
+            if rest:  # torch.ones(a, b, c) calling convention
+                size = (size,) + tuple(rest)
+            elif isinstance(size, int):
+                size = (size,)
+            dt = _to_jax_dtype(dtype) if dtype is not None else jnp.float32
+            return jnp.full(tuple(size), fill, dtype=dt)
+        return make
+
+    table[torch.ones] = factory(1)
+    table[torch.zeros] = factory(0)
+    table[torch.full] = lambda size, value, dtype=None, device=None, **kw: \
+        jnp.full(tuple(size), value,
+                 dtype=_to_jax_dtype(dtype) if dtype else None)
+    table[torch.arange] = lambda *a, dtype=None, device=None, **kw: \
+        jnp.arange(*a, dtype=_to_jax_dtype(dtype) if dtype else None)
+    table[torch.tensor] = lambda v, dtype=None, device=None, **kw: \
+        jnp.asarray(v, dtype=_to_jax_dtype(dtype) if dtype else None)
+    return table
+
+
+def _gelu(x, approximate="none"):
+    import jax
+    return jax.nn.gelu(x, approximate=(approximate == "tanh"))
+
+
+def _getattr_node(obj, name):
+    if name == "device":
+        return _Device()
+    if name == "dtype":
+        return obj.dtype
+    if name == "shape":
+        return obj.shape
+    if name == "min":  # torch.finfo(...).min
+        return float(obj.min)
+    if name == "max":
+        return float(obj.max)
+    return getattr(obj, name)
+
+
+_METHODS = None
+
+
+def _method_table():
+    global _METHODS
+    if _METHODS is None:
+        jnp = _jnp()
+        _METHODS = {
+            "view": lambda x, *s: x.reshape(
+                s[0] if len(s) == 1 and isinstance(s[0], (tuple, list))
+                else s),
+            "reshape": lambda x, *s: x.reshape(
+                s[0] if len(s) == 1 and isinstance(s[0], (tuple, list))
+                else s),
+            "transpose": lambda x, a, b: jnp.swapaxes(x, a, b),
+            "permute": lambda x, *dims: jnp.transpose(
+                x, dims[0] if len(dims) == 1 and
+                isinstance(dims[0], (tuple, list)) else dims),
+            "contiguous": lambda x: x,
+            "clone": lambda x: x,
+            "detach": lambda x: x,
+            "expand": _expand,
+            "expand_as": lambda x, o: _jnp().broadcast_to(x, o.shape),
+            "to": _to,
+            "type_as": lambda x, o: x.astype(o.dtype),
+            "masked_fill": _masked_fill,
+            "masked_fill_": _masked_fill,
+            "dim": lambda x: x.ndim,
+            "size": _size,
+            "numel": lambda x: int(np.prod(x.shape)),
+            "unsqueeze": lambda x, d: jnp.expand_dims(x, d),
+            "squeeze": lambda x, d=None: jnp.squeeze(
+                x, axis=d) if d is not None else jnp.squeeze(x),
+            "float": lambda x: x.astype(jnp.float32),
+            "long": lambda x: x.astype(jnp.int32),
+            "int": lambda x: x.astype(jnp.int32),
+            "bool": lambda x: x.astype(bool),
+            "softmax": _softmax,
+            "mean": lambda x, dim=None, keepdim=False: jnp.mean(
+                x, axis=dim, keepdims=keepdim),
+            "sum": lambda x, dim=None, keepdim=False: jnp.sum(
+                x, axis=dim, keepdims=keepdim),
+            "pow": jnp.power,
+            "tanh": jnp.tanh,
+            "split": lambda x, size, dim=-1: tuple(
+                jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
+            "chunk": lambda x, n, dim=-1: tuple(jnp.split(x, n, axis=dim)),
+            "flatten": lambda x, start=0, end=-1: _flatten(x, start, end),
+            "repeat": lambda x, *reps: jnp.tile(
+                x, reps[0] if len(reps) == 1 and
+                isinstance(reps[0], (tuple, list)) else reps),
+            "t": lambda x: x.T,
+            "gather": lambda x, dim, index: jnp.take_along_axis(
+                x, index, axis=dim),
+            "argmax": lambda x, dim=None, keepdim=False: jnp.argmax(
+                x, axis=dim, keepdims=keepdim),
+            "cumsum": lambda x, dim: jnp.cumsum(x, axis=dim),
+            "ne": lambda x, o: x != o,
+            "eq": lambda x, o: x == o,
+            "mul": operator.mul, "add": operator.add,
+            "sub": operator.sub, "div": operator.truediv,
+            "neg": operator.neg,
+            "item": lambda x: x,   # stays traced; fine under jit
+        }
+    return _METHODS
+
+
+def _flatten(x, start, end):
+    shape = list(x.shape)
+    if end < 0:
+        end += len(shape)
+    new = shape[:start] + [int(np.prod(shape[start:end + 1]))] \
+        + shape[end + 1:]
+    return x.reshape(new)
+
+
+class _JaxInterpreter:
+    """Execute an fx GraphModule with jax values.
+
+    Parameters/buffers arrive as flat name->array dicts; call_module
+    nodes look their weights up by the module path. One PRNG key drives
+    every dropout site (fold_in by site index) so a jitted step is
+    deterministic given the key."""
+
+    def __init__(self, gm, aliases=None):
+        import torch
+        self.gm = gm
+        self.graph = gm.graph
+        self.fn_table = _build_function_table()
+        self.torch = torch
+        # Tied weights (e.g. BERT's decoder<->word-embedding) appear once
+        # in the params dict under their canonical name; aliases map the
+        # other module paths onto it so the tie survives training (one
+        # leaf, gradients from every use site accumulate into it).
+        self.aliases = aliases or {}
+        # Stable dropout-site numbering: graph order.
+        self.site_of = {}
+        for node in self.graph.nodes:
+            if self._is_dropout_site(node):
+                self.site_of[node.name] = len(self.site_of)
+
+    def _is_dropout_site(self, node):
+        import torch.nn.functional as F
+        if node.op == "call_module":
+            sub = self.gm.get_submodule(node.target)
+            return isinstance(sub, self.torch.nn.Dropout)
+        if node.op == "call_function":
+            return self.fn_table.get(node.target) in ("sdpa", "dropout")
+        return False
+
+    def run(self, params, buffers, inputs, rng=None, train=False):
+        import jax
+        import torch.fx
+        env = {}
+
+        def load_arg(a):
+            return torch.fx.graph.map_arg(a, lambda n: env[n.name])
+
+        for node in self.graph.nodes:
+            if node.op == "placeholder":
+                name = node.target
+                if name in inputs:
+                    env[node.name] = inputs[name]
+                elif node.args:
+                    env[node.name] = node.args[0]  # default value
+                else:
+                    env[node.name] = None
+                continue
+            if node.op == "get_attr":
+                tgt = self.aliases.get(node.target, node.target)
+                if tgt in params:
+                    env[node.name] = params[tgt]
+                elif tgt in buffers:
+                    env[node.name] = buffers[tgt]
+                else:
+                    raise KeyError(
+                        f"get_attr {node.target!r}: not found in params "
+                        "or buffers")
+                continue
+            if node.op == "output":
+                out = load_arg(node.args[0])
+                # fx wraps collections in immutable variants jit rejects.
+                if isinstance(out, dict):
+                    out = dict(out)
+                elif isinstance(out, list):
+                    out = list(out)
+                return out
+
+            args = load_arg(node.args)
+            kwargs = load_arg(node.kwargs)
+            key = None
+            if node.name in self.site_of and rng is not None:
+                key = jax.random.fold_in(rng, self.site_of[node.name])
+
+            if node.op == "call_module":
+                sub = self.gm.get_submodule(node.target)
+                env[node.name] = self._run_module(
+                    node.target, sub, params, args, kwargs, key, train)
+            elif node.op == "call_method":
+                fn = _method_table().get(node.target)
+                if fn is None:
+                    raise NotImplementedError(
+                        f"torch method {node.target!r} (node {node.name}) "
+                        "has no jax mapping; add it to "
+                        "horovod_tpu/torch/compile.py _method_table")
+                env[node.name] = fn(*args, **kwargs)
+            elif node.op == "call_function":
+                fn = self.fn_table.get(node.target)
+                if fn == "sdpa":
+                    env[node.name] = _sdpa(key, train, *args, **kwargs)
+                elif fn == "dropout":
+                    x = args[0]
+                    p = kwargs.get("p", args[1] if len(args) > 1 else 0.5)
+                    training = kwargs.get(
+                        "training", args[2] if len(args) > 2 else True)
+                    env[node.name] = _dropout(
+                        x, p, train and training, key)
+                elif fn is None:
+                    raise NotImplementedError(
+                        f"torch function {node.target} (node {node.name}) "
+                        "has no jax mapping; add it to "
+                        "horovod_tpu/torch/compile.py "
+                        "_build_function_table")
+                else:
+                    env[node.name] = fn(*args, **kwargs)
+            else:
+                raise NotImplementedError(f"fx op {node.op}")
+        raise RuntimeError("graph had no output node")
+
+    def _run_module(self, path, sub, params, args, kwargs, key, train):
+        nn = self.torch.nn
+
+        def p(leaf):
+            name = f"{path}.{leaf}"
+            return params.get(self.aliases.get(name, name))
+
+        if isinstance(sub, nn.Linear):
+            return _linear(args[0], p("weight"), p("bias"))
+        if isinstance(sub, nn.LayerNorm):
+            return _layer_norm(args[0], sub.normalized_shape,
+                               p("weight"), p("bias"), sub.eps)
+        if isinstance(sub, nn.Embedding):
+            return _embedding(p("weight"), args[0], sub.padding_idx)
+        if isinstance(sub, nn.Dropout):
+            return _dropout(args[0], sub.p, train, key)
+        if isinstance(sub, nn.CrossEntropyLoss):
+            return _cross_entropy(args[0], args[1],
+                                  ignore_index=sub.ignore_index,
+                                  reduction=sub.reduction,
+                                  label_smoothing=sub.label_smoothing)
+        if isinstance(sub, (nn.GELU,)):
+            return _gelu(args[0], getattr(sub, "approximate", "none"))
+        if isinstance(sub, nn.ReLU):
+            import jax
+            return jax.nn.relu(args[0])
+        if isinstance(sub, nn.Tanh):
+            return _jnp().tanh(args[0])
+        if isinstance(sub, nn.Softmax):
+            return _softmax(args[0], dim=sub.dim)
+        if isinstance(sub, nn.Identity):
+            return args[0]
+        # HF Conv1D (GPT-2 style): x @ weight + bias, weight (in, out).
+        if type(sub).__name__ == "Conv1D" and hasattr(sub, "nf"):
+            return _jnp().matmul(args[0], p("weight")) + p("bias")
+        raise NotImplementedError(
+            f"torch module {type(sub).__name__} at {path!r} has no jax "
+            "mapping; add it to horovod_tpu/torch/compile.py "
+            "_JaxInterpreter._run_module")
+
+
+class CompiledModule:
+    """A torch module compiled to a jitted JAX callable.
+
+    ``params``/``buffers`` are flat name->jax-array dicts (the pytree the
+    train step updates). Forward calls are jitted per (train, input-names)
+    signature."""
+
+    def __init__(self, gm, params, buffers, loss_key="loss", aliases=None,
+                 compute_dtype=None):
+        import jax
+        self._interp = _JaxInterpreter(gm, aliases=aliases)
+        self.params = params
+        self.buffers = buffers
+        self.loss_key = loss_key
+        self.compute_dtype = compute_dtype
+        self._jitted = {}
+        self._jax = jax
+
+    def apply(self, params, inputs, rng=None, train=False):
+        """Pure functional forward (differentiable w.r.t. ``params``).
+
+        With ``compute_dtype`` set (the torch-xla XLA_USE_BF16 analog),
+        float params are cast on entry — master weights and gradients
+        stay fp32, matmuls ride the MXU in bf16; LayerNorm/softmax/CE
+        already compute in fp32 internally."""
+        if self.compute_dtype is not None:
+            jnp = _jnp()
+            params = {
+                k: (v.astype(self.compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in params.items()}
+        return self._interp.run(params, self.buffers, inputs,
+                                rng=rng, train=train)
+
+    def __call__(self, rng=None, train=False, **inputs):
+        import jax
+        sig = (train, rng is not None, tuple(sorted(inputs)))
+        if sig not in self._jitted:
+            def fwd(params, buffers, inputs, rng):
+                return self._interp.run(params, buffers, inputs,
+                                        rng=rng, train=train)
+            self._jitted[sig] = jax.jit(fwd)
+        inputs = {k: self._coerce(v) for k, v in inputs.items()}
+        return self._jitted[sig](self.params, self.buffers, inputs, rng)
+
+    @staticmethod
+    def _coerce(v):
+        import jax.numpy as jnp
+        if hasattr(v, "detach"):  # torch tensor
+            return _t2j(v)
+        return jnp.asarray(v) if not hasattr(v, "devices") else v
+
+    def loss_fn(self):
+        """(params, batch, rng) -> scalar loss, for make_train_step-style
+        wiring. ``batch`` is the inputs dict; the model output must carry
+        ``self.loss_key`` (dict key or attribute)."""
+        def fn(params, batch, rng=None):
+            out = self.apply(params, batch, rng=rng, train=True)
+            if isinstance(out, dict):
+                return out[self.loss_key]
+            return getattr(out, self.loss_key)
+        return fn
+
+    def make_train_step(self, optimizer, process_set=None):
+        """Build a jitted distributed train step: forward+backward on the
+        chip, gradient allreduce through the JAX binding's in-jit
+        collectives, optax update. Returns ``step(batch, rng=None) ->
+        loss`` (params/opt state live inside, torch-optimizer style —
+        the torch frontend expects stateful steps)."""
+        import jax
+        from .. import jax as hvd_jax
+
+        dist_opt = optimizer
+        if not hasattr(dist_opt, "inner"):  # bare optax transform
+            dist_opt = hvd_jax.DistributedOptimizer(
+                optimizer, **({"process_set": process_set}
+                              if process_set else {}))
+        loss = self.loss_fn()
+
+        # Dropout keys ride the batch: a (n, 2) PRNGKey block sharded with
+        # it gives each device its own key (per-rank dropout, the torch DP
+        # semantic); a bare (2,) key could not shard along the axis.
+        step = hvd_jax.make_train_step(
+            lambda p, b: loss(p, b[0],
+                              rng=(None if b[1] is None else b[1][0])),
+            dist_opt)
+        opt_state = dist_opt.init(self.params)
+        state = {"opt": opt_state}
+        from .. import basics
+
+        def run(batch, rng=None):
+            batch = {k: self._coerce(v) for k, v in batch.items()}
+            n = basics.size()
+            for name, v in batch.items():
+                if hasattr(v, "shape") and (v.ndim == 0
+                                            or v.shape[0] % n):
+                    raise ValueError(
+                        f"batch[{name!r}] leading axis {v.shape} must be "
+                        f"divisible by hvd.size()={n}: the step shards "
+                        "the batch across devices (single-controller "
+                        "mode: your batch is the GLOBAL batch)")
+            if rng is not None:
+                rng = jax.random.split(rng, n)
+            new_params, new_opt, loss_val = step(
+                self.params, state["opt"], (batch, rng))
+            self.params = new_params
+            state["opt"] = new_opt
+            return loss_val
+
+        return run
+
+    def copy_params_to_module(self, module):
+        """Write the (possibly updated) jax parameters back into the torch
+        module, so torch-side checkpointing/eval sees trained weights."""
+        import torch
+        with torch.no_grad():
+            for name, p in module.named_parameters():
+                if name in self.params:
+                    # .copy(): device_get can return a read-only view
+                    # torch would warn about aliasing.
+                    arr = np.array(
+                        self._jax.device_get(self.params[name]),
+                        dtype=np.float32)
+                    p.copy_(torch.from_numpy(arr).to(p.dtype))
+
+
+def tpu_compile(module, input_names=None, example_inputs=None,
+                loss_key="loss", compute_dtype=None):
+    """Compile a torch module for TPU execution via fx→JAX.
+
+    HF transformers models are traced with ``transformers.utils.fx``
+    (pass ``input_names``); plain ``torch.nn.Module``s go through
+    ``torch.fx.symbolic_trace``. Returns a :class:`CompiledModule`.
+    """
+    import torch
+
+    gm = None
+    if input_names is not None:
+        try:
+            from transformers.utils import fx as hf_fx
+            gm = hf_fx.symbolic_trace(module, input_names=list(input_names))
+        except (ImportError, ValueError, TypeError):
+            gm = None
+    if gm is None:
+        gm = torch.fx.symbolic_trace(module)
+
+    params = {n: _t2j(p) for n, p in module.named_parameters()}
+    buffers = {n: _t2j(b) for n, b in module.named_buffers()}
+    # Tied weights: named_parameters() deduplicates shared tensors; map
+    # every non-canonical path to the first-seen name so lookups resolve
+    # and the tie is preserved as a single trainable leaf.
+    canonical = {}
+    aliases = {}
+    for n, p in module.named_parameters(remove_duplicate=False):
+        key = id(p)
+        if key in canonical:
+            aliases[n] = canonical[key]
+        else:
+            canonical[key] = n
+    # fx tracing of HF models can introduce fresh buffers on the traced
+    # copy (e.g. tensor constants) absent from the original module.
+    for n, b in gm.named_buffers():
+        if n not in buffers and n not in aliases:
+            buffers[n] = _t2j(b)
+    for n, p in gm.named_parameters():
+        if n not in params and n not in aliases:
+            params[n] = _t2j(p)
+    return CompiledModule(gm, params, buffers, loss_key=loss_key,
+                          aliases=aliases, compute_dtype=compute_dtype)

@@ -73,6 +73,10 @@ STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 AUTOTUNE = "AUTOTUNE"
 AUTOTUNE_LOG = "AUTOTUNE_LOG"
+# Min buffer bytes before allreduce takes the two-level intra-host/
+# cross-host path on multi-host jobs; 0 disables (reference knob analog:
+# HOROVOD_HIERARCHICAL_ALLREDUCE).
+HIERARCHICAL_THRESHOLD = "HIERARCHICAL_THRESHOLD"
 ELASTIC = "ELASTIC"
 
 # Launcher-set topology env (analog of HOROVOD_RANK/SIZE/...; reference:

@@ -26,6 +26,47 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Modules dominated by launcher-spawned subprocess jobs (the reference's
+# horovodrun-under-CI pattern): minutes each. `pytest -m "not slow"`
+# keeps the in-process suites — the fast iteration loop.
+_SLOW_MODULES = {
+    "test_spmd", "test_examples", "test_cluster", "test_frameworks",
+    "test_elastic", "test_xla_global",
+}
+# Individual subprocess-spawning tests inside otherwise-fast modules
+# (spawned workers may contend for the real chip; the fast lane stays
+# in-process on the CPU mesh).
+_SLOW_NAMES = {
+    "test_autotune_spmd_convergence",
+    "test_fit_on_parquet_np2",
+    "test_fit_on_parquet_torch_np2",
+    "test_fit_on_parquet_lightning_np2",
+    "test_launch_two_ranks_end_to_end",
+    "test_run_command_spmd_worker",
+    "test_hvdrun_console_entry",
+    "test_output_filename_captures_per_rank",
+    "test_run_programmatic",
+    "test_failed_rank_fails_job",
+    "test_run_command_multi_host_topology",
+    # In-process but compile-heavy (~20s each): keep the fast lane <3min.
+    "test_resnet_remat_variants_run",
+    "test_space_to_depth_stem_equivalent",
+    "test_transformer_remat_variants_run",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: launcher-spawned multi-process test (minutes); "
+        "deselect with -m 'not slow'")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = getattr(item.module, "__name__", "")
+        if mod in _SLOW_MODULES or item.name.split("[")[0] in _SLOW_NAMES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def hvd():

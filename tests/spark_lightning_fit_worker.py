@@ -1,0 +1,80 @@
+"""Worker for the Lightning estimator training-loop test (np=2, launched
+by test_spark_estimator.py) — the LightningEstimator.fit executor body
+without Spark, using a protocol-satisfying module (no pytorch_lightning
+in TPU images; a real pl.LightningModule satisfies the same surface)."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import torch  # noqa: E402
+
+
+class LinearLightning(torch.nn.Module):
+    """LightningModule protocol: training_step/validation_step/
+    configure_optimizers on a plain nn.Module. Module-level so
+    torch.save's pickle can resolve it by qualified name."""
+
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Linear(4, 1)
+        self.epoch_ends = 0
+
+    def forward(self, x):
+        return self.net(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        loss = torch.nn.functional.mse_loss(
+            self(x).squeeze(-1), y.to(torch.float32))
+        return {"loss": loss}
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(
+            self(x).squeeze(-1), y.to(torch.float32))
+
+    def configure_optimizers(self):
+        opt = torch.optim.Adam(self.parameters(), lr=0.05)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=3,
+                                                gamma=0.5)
+        return {"optimizer": opt,
+                "lr_scheduler": {"scheduler": sched}}
+
+    def on_train_epoch_end(self):
+        self.epoch_ends += 1
+
+
+def build_module():
+    return LinearLightning
+
+
+def main():
+    from horovod_tpu.spark.lightning import fit_on_parquet_lightning
+    from horovod_tpu.spark.torch import serialize_torch
+
+    torch.manual_seed(int(os.environ["HVDTPU_RANK"]) + 1)
+    # Rank-divergent init: broadcast_parameters must sync rank 0's.
+    module = LinearLightning()
+
+    history = fit_on_parquet_lightning(
+        store_prefix=os.environ["STORE_PREFIX"],
+        run_id="plrun",
+        module_bytes=serialize_torch(module),
+        feature_cols=["features"],
+        label_cols=["label"],
+        batch_size=16,
+        epochs=5,
+        validation=0.25,
+    )
+    assert history["loss"][-1] < history["loss"][0], history
+    assert "val_loss" in history, list(history)
+    print("HISTORY " + json.dumps(history), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -199,3 +199,64 @@ def test_fit_on_parquet_torch_np2(tmp_path):
     assert store.exists(store.get_checkpoint_path("torchrun"))
     preds = tm.predict([np.zeros((3, 4))])
     assert preds.shape == (3, 1)
+
+
+def test_fit_on_parquet_lightning_np2(tmp_path):
+    """Lightning estimator body at np=2: configure_optimizers runs on the
+    worker (no optimizer round-trip), scheduler steps per epoch,
+    validation_step drives val_loss, checkpoint round-trips."""
+    store, _ = _run_fit_workers(tmp_path, "spark_lightning_fit_worker.py")
+
+    from horovod_tpu.spark.lightning import LightningEstimator
+    lm = LightningEstimator.load(store, "plrun",
+                                 feature_cols=["features"],
+                                 label_cols=["label"])
+    assert store.exists(store.get_checkpoint_path("plrun"))
+    # torch.load of the worker-defined class needs its module importable.
+    sys.path.insert(0, HERE)
+    try:
+        import spark_lightning_fit_worker  # noqa: F401
+        import __main__
+        __main__.LinearLightning = \
+            spark_lightning_fit_worker.build_module()
+        preds = lm.predict([np.zeros((3, 4))])
+    finally:
+        sys.path.remove(HERE)
+    assert preds.shape == (3, 1)
+
+
+def test_lightning_resolve_optimizer_shapes():
+    import torch
+    from horovod_tpu.spark.lightning import _resolve_optimizers
+
+    class M(torch.nn.Module):
+        def __init__(self, cfg):
+            super().__init__()
+            self.lin = torch.nn.Linear(2, 2)
+            self._cfg = cfg
+
+        def configure_optimizers(self):
+            return self._cfg(self)
+
+    opt = lambda m: torch.optim.SGD(m.parameters(), lr=0.1)  # noqa: E731
+    o, s = _resolve_optimizers(M(opt))
+    assert isinstance(o, torch.optim.SGD) and s == []
+    o, s = _resolve_optimizers(M(lambda m: [opt(m)]))
+    assert isinstance(o, torch.optim.SGD)
+    o, s = _resolve_optimizers(M(
+        lambda m: ([opt(m)],
+                   [torch.optim.lr_scheduler.StepLR(opt(m), 1)])))
+    assert len(s) == 1
+    o, s = _resolve_optimizers(M(lambda m: {"optimizer": opt(m)}))
+    assert isinstance(o, torch.optim.SGD)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="exactly one optimizer"):
+        _resolve_optimizers(M(lambda m: [opt(m), opt(m)]))
+
+
+def test_lightning_estimator_rejects_non_protocol_model():
+    import torch
+    from horovod_tpu.spark.lightning import LightningEstimator
+    with pytest.raises(ValueError, match="LightningModule protocol"):
+        LightningEstimator(model=torch.nn.Linear(2, 2), store="/tmp/x",
+                           feature_cols=["f"], label_cols=["l"])

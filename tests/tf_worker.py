@@ -131,6 +131,75 @@ def main():
     np.testing.assert_allclose(
         sbn.moving_mean.numpy(), 0.1 * mean, rtol=1e-4, atol=1e-6)
 
+    # -- dtype x op matrix (reference: test_tensorflow.py:128+ sweeps) -----
+    float_dtypes = [tf.float16, tf.float32, tf.float64, tf.bfloat16]
+    int_dtypes = [tf.uint8, tf.int8, tf.int32, tf.int64]
+    for dt in float_dtypes + int_dtypes:
+        base = tf.reshape(tf.range(1, 7), (2, 3))
+        x = tf.cast(base * (r + 1), dt)
+        ops = [("sum", hvd.Sum), ("min", hvd.Min), ("max", hvd.Max),
+               ("prod", hvd.Product)]
+        if dt in float_dtypes:
+            ops.append(("avg", hvd.Average))
+        for opname, op in ops:
+            out = hvd.allreduce(x, op=op, name=f"mx.{dt.name}.{opname}")
+            assert out.dtype == dt, (dt, opname, out.dtype)
+            b64 = tf.cast(base, tf.float64)
+            expect = {
+                "sum": b64 * sum(range(1, n + 1)),
+                "avg": b64 * sum(range(1, n + 1)) / n,
+                "min": b64,
+                "max": b64 * n,
+                "prod": b64 ** n * float(np.prod(range(1, n + 1))),
+            }[opname]
+            np.testing.assert_allclose(
+                tf.cast(out, tf.float64).numpy(), expect.numpy(),
+                rtol=1e-2)
+        gth = hvd.allgather(x, name=f"mg.{dt.name}")
+        assert gth.dtype == dt and gth.shape == (2 * n, 3)
+        np.testing.assert_allclose(
+            tf.cast(gth, tf.float64).numpy()[2 * r:2 * r + 2],
+            tf.cast(x, tf.float64).numpy(), rtol=1e-3)
+    # bool: logical or/and via max/min.
+    flags = tf.constant([r == 0, True, False])
+    any_ = hvd.allreduce(flags, op=hvd.Max, name="mx.bool.or")
+    all_ = hvd.allreduce(flags, op=hvd.Min, name="mx.bool.and")
+    assert any_.dtype == tf.bool and all_.dtype == tf.bool
+    np.testing.assert_array_equal(any_.numpy(), [True, True, False])
+    np.testing.assert_array_equal(all_.numpy(), [False, True, False])
+
+    # -- 0-d scalars --------------------------------------------------------
+    sc = hvd.allreduce(tf.constant(float(r + 1)), op=hvd.Sum, name="sc")
+    assert sc.shape == ()
+    np.testing.assert_allclose(float(sc), sum(range(1, n + 1)))
+
+    # -- process-set variants ----------------------------------------------
+    from horovod_tpu import process_sets as ps_mod
+    mine = ps_mod.add_process_set([r])
+    solo = hvd.allreduce(tf.ones([3]) * (r + 1), op=hvd.Sum,
+                         name="ps.solo", process_set=mine)
+    np.testing.assert_allclose(solo.numpy(), r + 1)
+    sb = hvd.broadcast(tf.fill([2], float(r)), root_rank=r, name="ps.b",
+                       process_set=mine)
+    np.testing.assert_allclose(sb.numpy(), float(r))
+    ps_mod.remove_process_set(mine)
+
+    # -- failure UX: cross-rank validation names the offending ranks --------
+    try:
+        hvd.allreduce(tf.ones([3 + r]), op=hvd.Sum, name="bad.shape")
+        raise AssertionError("shape mismatch not detected")
+    except Exception as e:  # noqa: BLE001
+        msg = str(e)
+        assert "mismatched shapes" in msg and "rank" in msg, msg
+    try:
+        bad = tf.ones([3], tf.float32 if r == 0 else tf.int32)
+        hvd.allreduce(bad, op=hvd.Sum, name="bad.dtype")
+        raise AssertionError("dtype mismatch not detected")
+    except Exception as e:  # noqa: BLE001
+        assert "mismatched data types" in str(e), e
+    ok = hvd.allreduce(tf.ones([2]), op=hvd.Sum, name="after.bad")
+    np.testing.assert_allclose(ok.numpy(), float(n))
+
     print(f"rank {r}/{n}: TF-BINDING OK", flush=True)
     hvd.shutdown()
 

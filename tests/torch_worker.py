@@ -165,6 +165,81 @@ def main():
     assert cr.dtype == torch.float32
     np.testing.assert_allclose(cr.numpy(), sum(range(1, n + 1)), rtol=1e-2)
 
+    # -- dtype x op matrix (reference: test_torch.py:128+ sweeps) -----------
+    float_dtypes = [torch.float16, torch.float32, torch.float64,
+                    torch.bfloat16]
+    int_dtypes = [torch.uint8, torch.int8, torch.int32, torch.int64]
+    for dt in float_dtypes + int_dtypes:
+        base = torch.arange(1, 7).reshape(2, 3)
+        x = (base * (r + 1)).to(dt)
+        ops = [("sum", hvd.Sum), ("min", hvd.Min), ("max", hvd.Max),
+               ("prod", hvd.Product)]
+        if dt in float_dtypes:
+            ops.append(("avg", hvd.Average))
+        for opname, op in ops:
+            out = hvd.allreduce(x, op=op, name=f"mx.{dt}.{opname}")
+            assert out.dtype == dt, (dt, opname, out.dtype)
+            b = base.double()
+            expect = {
+                "sum": b * sum(range(1, n + 1)),
+                "avg": b * sum(range(1, n + 1)) / n,
+                "min": b * 1,
+                "max": b * n,
+                "prod": b ** n * int(np.prod(range(1, n + 1))),
+            }[opname]
+            np.testing.assert_allclose(out.double().numpy(),
+                                       expect.numpy(), rtol=1e-2)
+        g = hvd.allgather(x, name=f"mg.{dt}")
+        assert g.dtype == dt and g.shape == (2 * n, 3)
+        np.testing.assert_allclose(g.double().numpy()[2 * r:2 * r + 2],
+                                   x.double().numpy(), rtol=1e-3)
+    # bool: logical or/and via max/min.
+    flags = torch.tensor([r == 0, True, False])
+    any_ = hvd.allreduce(flags, op=hvd.Max, name="mx.bool.or")
+    all_ = hvd.allreduce(flags, op=hvd.Min, name="mx.bool.and")
+    assert any_.dtype == torch.bool and all_.dtype == torch.bool
+    np.testing.assert_array_equal(any_.numpy(), [True, True, False])
+    np.testing.assert_array_equal(all_.numpy(), [False, True, False])
+
+    # -- 0-d scalars --------------------------------------------------------
+    sc = hvd.allreduce(torch.tensor(float(r + 1)), op=hvd.Sum, name="sc")
+    assert sc.shape == ()
+    np.testing.assert_allclose(float(sc), sum(range(1, n + 1)))
+
+    # -- process-set variants ----------------------------------------------
+    from horovod_tpu import process_sets as ps_mod
+    mine = ps_mod.add_process_set([r])          # one singleton set per rank
+    solo = hvd.allreduce(torch.ones(3) * (r + 1), op=hvd.Sum,
+                         name="ps.solo", process_set=mine)
+    np.testing.assert_allclose(solo.numpy(), r + 1)  # no peers -> identity
+    sg = hvd.allgather(torch.full((2,), float(r)), name="ps.g",
+                       process_set=mine)
+    assert sg.shape == (2,)
+    bb = torch.full((2,), float(r))
+    hvd.broadcast_(bb, root_rank=r, name="ps.b", process_set=mine)
+    np.testing.assert_allclose(bb.numpy(), float(r))
+    ps_mod.remove_process_set(mine)
+
+    # -- failure UX: cross-rank validation names the offending ranks --------
+    try:
+        hvd.allreduce(torch.ones(3 + r), op=hvd.Sum, name="bad.shape")
+        raise AssertionError("shape mismatch not detected")
+    except Exception as e:  # noqa: BLE001
+        msg = str(e)
+        assert "mismatched shapes" in msg and "rank" in msg, msg
+    try:
+        # (fp64 would be narrowed to fp32 under JAX x64-off and match;
+        # int-vs-float is a mismatch the plane preserves.)
+        bad = torch.ones(3, dtype=torch.float32 if r == 0
+                         else torch.int32)
+        hvd.allreduce(bad, op=hvd.Sum, name="bad.dtype")
+        raise AssertionError("dtype mismatch not detected")
+    except Exception as e:  # noqa: BLE001
+        assert "mismatched data types" in str(e), e
+    # The plane must still be healthy after rejected ops.
+    ok = hvd.allreduce(torch.ones(2), op=hvd.Sum, name="after.bad")
+    np.testing.assert_allclose(ok.numpy(), float(n))
+
     # -- TorchState commit/restore -----------------------------------------
     from horovod_tpu.torch.elastic import TorchState
     state = TorchState(model=model, optimizer=opt, epoch=3)
