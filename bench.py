@@ -104,7 +104,7 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
     # BERT-large dimensions as a causal decoder LM (the reference's BERT
     # target, BASELINE.md): 365M params. The pallas flash kernel (causal
     # block-skip + 1024-tiles + unpadded d=64) beats XLA's fused einsum
-    # attention at seq 512 (87.2 vs 71.6 samples/s): skipping
+    # attention at seq 512 (88.1 vs 71.6 samples/s): skipping
     # above-diagonal tiles halves attention FLOPs, big tiles amortize the
     # online-softmax bookkeeping, and the freed O(s^2) logits memory
     # admits batch 24 without remat (docs/PERF.md round-3 sweep).
@@ -186,8 +186,10 @@ def main():
     # TPU-only: off-TPU the small stand-in config would rerun the same
     # seq-64 workload under a mislabeled seq-2048 metric name.
     if on_tpu:
+        # Batch 6 measured fastest at the 1024-token tiles (r3 sweep:
+        # b4 17.04, b6 17.53, b8 15.95 samples/s — docs/PERF.md).
         print(json.dumps(_bench_transformer(
-            hvd, hvd_jax, on_tpu, seq_tpu=2048, batch_tpu=4,
+            hvd, hvd_jax, on_tpu, seq_tpu=2048, batch_tpu=6,
             metric="transformer_lm_365m_seq2048_flash_train_samples"
                    "_per_sec_per_chip")), flush=True)
     # Headline last (the driver records the final line); metric name kept

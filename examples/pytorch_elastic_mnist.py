@@ -1,0 +1,91 @@
+"""Elastic PyTorch training (reference: examples/elastic/pytorch/
+pytorch_mnist_elastic.py): the torch binding's TorchState commits
+model+optimizer+epoch between steps, survives membership changes, and
+re-rendezvouses without losing progress.
+
+Run:  hvdrun -np 2 --min-np 1 --host-discovery-script ./discover.sh \
+          python examples/pytorch_elastic_mnist.py
+Also runs under a static launch (the elastic loop simply never resets):
+      hvdrun -np 2 python examples/pytorch_elastic_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu.torch as hvd
+from horovod_tpu.torch.elastic import TorchState
+import horovod_tpu.elastic as elastic
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps-per-epoch", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.05)
+    return p.parse_args()
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(64, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    torch.manual_seed(1234)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    # Synthetic MNIST-shaped shard per rank.
+    rng = np.random.RandomState(42 + hvd.rank())
+    X = torch.from_numpy(rng.randn(512, 64).astype(np.float32))
+    w = np.random.RandomState(7).randn(64, 10)
+    y = torch.from_numpy((X.numpy() @ w).argmax(1))
+
+    state = TorchState(model=model, optimizer=optimizer, epoch=0,
+                       batch=0)
+
+    @elastic.run
+    def train(state):
+        for epoch in range(state.epoch, args.epochs):
+            for step in range(state.batch, args.steps_per_epoch):
+                idx = torch.randint(0, len(X), (args.batch_size,))
+                optimizer.zero_grad()
+                loss = F.cross_entropy(model(X[idx]), y[idx])
+                loss.backward()
+                optimizer.step()
+                state.batch = step + 1
+                if step % 4 == 0:
+                    state.commit()
+            state.batch = 0
+            state.epoch = epoch + 1
+            state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {epoch}: loss={float(loss):.4f}",
+                      flush=True)
+
+    train(state)
+    if hvd.rank() == 0:
+        print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Ray elastic executor example (reference: examples/ray/ray_train.py +
+elastic docs): actor-backed fault-tolerant training on a Ray cluster.
+
+TPU images ship without ray — the example gates with a clear message
+(the integration itself is exercised against an in-process Ray fake in
+tests/test_ray_elastic.py).
+
+Run (on a machine with ray):  python examples/ray_elastic.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def train_fn():
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    x = np.ones(4, np.float32) * (hvd.rank() + 1)
+    out = hvd.allreduce(x, op=hvd.Sum, name="ray.demo")
+    hvd.shutdown()
+    return float(np.asarray(out).reshape(-1)[0])
+
+
+def main():
+    try:
+        import ray
+    except ImportError:
+        print("ray is not installed in this image; skipping "
+              "(pip install ray on a Ray cluster to run). done",
+              flush=True)
+        return
+
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    ray.init(ignore_reinit_error=True)
+    ex = ElasticRayExecutor(min_np=1, max_np=2, cpus_per_worker=1)
+    ex.start()
+    results = ex.run(train_fn)
+    print(f"per-rank allreduce results: {results}; done", flush=True)
+    ex.shutdown()
+
+
+if __name__ == "__main__":
+    main()

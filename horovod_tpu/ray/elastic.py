@@ -45,6 +45,7 @@ class RayHostDiscovery:
     def find_available_hosts(self):
         ray = _ray()
         hosts = []
+        budget = self.max_np if self.max_np is not None else float("inf")
         for node in ray.nodes():
             if not node.get("Alive"):
                 continue
@@ -53,8 +54,12 @@ class RayHostDiscovery:
             if self.gpus_per_worker:
                 slots = min(slots, int(res.get("GPU", 0)
                                        // self.gpus_per_worker))
+            # Cap discovery at max_np so the driver never even sees
+            # (and spawns toward) slots beyond the job's ceiling.
+            slots = int(min(slots, budget))
             if slots <= 0:
                 continue
+            budget -= slots
             hosts.append(HostInfo(node["NodeManagerAddress"], slots))
         return hosts
 
@@ -132,6 +137,7 @@ class _RayElasticDriver(ElasticDriver):
         self._placement = placement
         self._worker_cls = None
         self.results = {}          # worker_id -> return value
+        self.final_rank = {}       # worker_id -> rank at completion
 
     def _spawn(self, worker_id, host, slot_index):
         ray = _ray()
@@ -166,14 +172,18 @@ class _RayElasticDriver(ElasticDriver):
                                           proc)
 
     def _sweep_exits(self):
-        # Capture results of workers that finished this sweep (the base
-        # class pops them from self.workers).
+        # Capture results AND final ranks of workers finishing this sweep
+        # (the base class pops successes from both self.workers and
+        # self.rank_order, so snapshot the order first).
         before = {wid: w.proc for wid, w in self.workers.items()}
+        order_before = list(self.rank_order)
         changed = super()._sweep_exits()
         for wid in self.succeeded:
             proc = before.get(wid)
             if proc is not None and wid not in self.results:
                 self.results[wid] = proc.result
+                if wid in order_before:
+                    self.final_rank[wid] = order_before.index(wid)
         return changed
 
 
@@ -225,8 +235,14 @@ class ElasticRayExecutor:
         if self.use_placement_group:
             n = self.elastic.max_np or self.elastic.min_np
             hosts = len(self.discovery.find_available_hosts()) or 1
+            # Host counts are dynamic in an elastic job: round down to
+            # the largest divisor of n so pack bundles stay legal no
+            # matter how many nodes happen to be alive right now.
+            num_hosts = min(hosts, n)
+            while self.pack and n % num_hosts:
+                num_hosts -= 1
             strat = strategy_for(
-                self.pack, n, num_hosts=min(hosts, n),
+                self.pack, n, num_hosts=num_hosts,
                 cpus_per_worker=self.elastic.base.cpus_per_worker,
                 gpus_per_worker=self.elastic.base.gpus_per_worker)
             self._pg = strat.create_placement_group(
@@ -245,9 +261,11 @@ class ElasticRayExecutor:
         if rc != 0:
             raise RuntimeError(
                 "elastic ray job failed (no worker cohort succeeded)")
-        ordered = [wid for wid in driver.rank_order
-                   if wid in driver.results]
-        ordered += [wid for wid in driver.results if wid not in ordered]
+        # Final rank order as recorded at each worker's completion (the
+        # driver removes finished workers from its live rank_order, so
+        # the order must come from the completion-time snapshot).
+        ordered = sorted(driver.results,
+                         key=lambda w: driver.final_rank.get(w, 1 << 30))
         return [driver.results[wid] for wid in ordered]
 
     def shutdown(self):

@@ -6,10 +6,19 @@ The shard unit is the parquet part file: rank r trains on files
 ``files[r::size]`` — deterministic, disjoint, and independent of any
 Spark runtime, so the same reader serves Spark executors, hvdrun
 workers, and tests.
+
+``AsyncShardBatchLoader`` is the estimator-path analog of the
+reference's petastorm async data loaders
+(horovod/spark/data_loaders/pytorch_data_loaders.py:71): batch
+assembly (index, stack, framework-tensor conversion) runs on a
+background thread with a bounded queue, overlapping the next batch's
+host work with the current training step.
 """
 
 import numpy as np
 import pyarrow.parquet as pq
+
+from ..data.data_loader_base import AsyncDataLoaderMixin, BaseDataLoader
 
 
 def stack_column(col):
@@ -78,3 +87,30 @@ class ParquetShard:
             if self.num_rows < batch_size:
                 # Tiny shard: emit the whole shard rather than nothing.
                 yield dict(self.columns)
+
+
+class ShardBatchLoader(BaseDataLoader):
+    """One EPOCH of transformed batches from a ParquetShard: exactly
+    ``steps`` batches through ``transform`` (the estimator's
+    numpy->framework-tensor conversion). A fresh underlying generator
+    position is kept across epochs so data doesn't repeat."""
+
+    def __init__(self, shard, batch_size, steps, transform=None, seed=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._gen = shard.batches(batch_size, seed=seed)
+        self.steps = steps
+        self.transform = transform or (lambda b: b)
+
+    def __len__(self):
+        return self.steps
+
+    def __iter__(self):
+        for _ in range(self.steps):
+            yield self.transform(next(self._gen))
+
+
+class AsyncShardBatchLoader(AsyncDataLoaderMixin, ShardBatchLoader):
+    """Background-thread variant: each epoch's iteration spawns a
+    producer prefetching up to ``async_loader_queue_size`` transformed
+    batches while the training step runs."""

@@ -125,9 +125,14 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
         return (xs[0] if len(xs) == 1 else tuple(xs),
                 ys[0] if len(ys) == 1 else tuple(ys))
 
-    def train_gen():
-        for batch in shard.batches(batch_size, seed=shuffle_seed + rank):
-            yield to_xy(batch)
+    # Async batch assembly overlapping fit steps (reference:
+    # pytorch_data_loaders.py:71; see spark/data.py). Keras pulls one
+    # continuous stream across epochs; +2 covers its lookahead prefetch.
+    from .data import AsyncShardBatchLoader
+    loader = AsyncShardBatchLoader(shard=shard, batch_size=batch_size,
+                                   steps=steps * epochs + 2,
+                                   transform=to_xy,
+                                   seed=shuffle_seed + rank)
 
     fit_kwargs = {}
     if val_batch is not None:
@@ -137,8 +142,9 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
            hvd.callbacks.MetricAverageCallback()]
     cbs += list(callbacks or [])
 
-    history = model.fit(train_gen(), steps_per_epoch=steps, epochs=epochs,
+    history = model.fit(iter(loader), steps_per_epoch=steps, epochs=epochs,
                         callbacks=cbs, verbose=verbose, **fit_kwargs)
+    loader.close()
 
     if rank == 0:
         store.write(store.get_checkpoint_path(run_id),

@@ -145,7 +145,12 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
         return (xs[0] if len(xs) == 1 else xs,
                 ys[0] if len(ys) == 1 else ys)
 
-    gen = shard.batches(batch_size, seed=shuffle_seed + rank)
+    # Async batch assembly overlapping the step (reference:
+    # pytorch_data_loaders.py:71; see spark/data.py).
+    from .data import AsyncShardBatchLoader
+    loader = AsyncShardBatchLoader(shard=shard, batch_size=batch_size,
+                                   steps=steps, transform=to_batch,
+                                   seed=shuffle_seed + rank)
     history = {"loss": []}
     if val_batch is not None:
         history["val_loss"] = []
@@ -154,8 +159,7 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
     global_step = 0
     for epoch in range(epochs):
         total = 0.0
-        for _ in range(steps):
-            batch = to_batch(next(gen))
+        for batch in loader:
             optimizer.zero_grad()
             loss = _step_loss(module.training_step(batch, global_step))
             loss.backward()
@@ -202,6 +206,7 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
                 f"{k}={v[-1]:.4f}" for k, v in history.items()),
                 flush=True)
 
+    loader.close()
     if rank == 0:
         store.write(store.get_checkpoint_path(run_id),
                     serialize_torch(module))

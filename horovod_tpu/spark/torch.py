@@ -139,7 +139,12 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
         return (xs[0] if len(xs) == 1 else xs,
                 ys[0] if len(ys) == 1 else ys)
 
-    gen = shard.batches(batch_size, seed=shuffle_seed + rank)
+    # Async batch assembly: stacking + tensor conversion overlap the
+    # training step (reference: pytorch_data_loaders.py:71 async loader).
+    from .data import AsyncShardBatchLoader
+    loader = AsyncShardBatchLoader(shard=shard, batch_size=batch_size,
+                                   steps=steps, transform=to_xy,
+                                   seed=shuffle_seed + rank)
     history = {"loss": []}
     if val_batch is not None:
         history["val_loss"] = []
@@ -147,8 +152,7 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
     model.train()
     for epoch in range(epochs):
         total = 0.0
-        for _ in range(steps):
-            x, y = to_xy(next(gen))
+        for x, y in loader:
             optimizer.zero_grad()
             loss_val = loss_fn(model(x), y)
             loss_val.backward()
@@ -180,6 +184,7 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
                 f"{k}={v[-1]:.4f}" for k, v in history.items()),
                 flush=True)
 
+    loader.close()
     if rank == 0:
         store.write(store.get_checkpoint_path(run_id),
                     serialize_torch(model))

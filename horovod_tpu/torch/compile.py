@@ -637,16 +637,24 @@ class CompiledModule:
 
         def run(batch, rng=None):
             batch = {k: self._coerce(v) for k, v in batch.items()}
-            n = basics.size()
+            rt = basics.runtime()
+            # The step shards the batch over the RUNTIME MESH: all local
+            # devices in single-controller mode (your batch is global),
+            # one device per process under hvdrun (your batch is this
+            # rank's local batch — no divisibility constraint beyond
+            # the local mesh).
+            n = int(rt.mesh.shape[hvd_jax.HVD_AXIS])
             for name, v in batch.items():
                 if hasattr(v, "shape") and (v.ndim == 0
                                             or v.shape[0] % n):
                     raise ValueError(
                         f"batch[{name!r}] leading axis {v.shape} must be "
-                        f"divisible by hvd.size()={n}: the step shards "
-                        "the batch across devices (single-controller "
-                        "mode: your batch is the GLOBAL batch)")
+                        f"divisible by the local mesh size {n}: the step "
+                        "shards the batch across this runtime's devices")
             if rng is not None:
+                # Decorrelate dropout across PROCESSES first (each rank
+                # folds its rank in), then across local mesh devices.
+                rng = jax.random.fold_in(rng, rt.topology.rank)
                 rng = jax.random.split(rng, n)
             new_params, new_opt, loss_val = step(
                 self.params, state["opt"], (batch, rng))

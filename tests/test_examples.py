@@ -100,3 +100,31 @@ def test_jax_synthetic_benchmark_tiny():
          "--image-size", "32", "--batch-size", "2", "--num-iters", "1",
          "--num-batches-per-iter", "1", "--num-warmup-batches", "1"])
     assert "/sec" in out
+
+
+def test_pytorch_elastic_mnist():
+    pytest.importorskip("torch")
+    out = _run_example(["pytorch_elastic_mnist.py", "--epochs", "2",
+                        "--steps-per-epoch", "4"])
+    assert "done" in out
+
+
+def test_spark_lightning_estimator_example(tmp_path):
+    pytest.importorskip("torch")
+    env_extra = {"STORE_PREFIX": str(tmp_path)}
+    import os as _os
+    old = dict(_os.environ)
+    _os.environ.update(env_extra)
+    try:
+        out = _run_example(["spark_lightning_estimator.py"])
+    finally:
+        _os.environ.clear()
+        _os.environ.update(old)
+    assert "done" in out
+
+
+def test_ray_elastic_example_gates_cleanly():
+    # ray is absent in TPU images: the example must exit 0 with a
+    # message (when present, it runs the elastic executor for real).
+    out = _run_example(["ray_elastic.py"], np=1)
+    assert "done" in out

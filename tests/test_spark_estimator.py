@@ -260,3 +260,37 @@ def test_lightning_estimator_rejects_non_protocol_model():
     with pytest.raises(ValueError, match="LightningModule protocol"):
         LightningEstimator(model=torch.nn.Linear(2, 2), store="/tmp/x",
                            feature_cols=["f"], label_cols=["l"])
+
+
+def test_async_shard_batch_loader_matches_sync(tmp_path):
+    """AsyncShardBatchLoader yields the same transformed batches as
+    direct iteration (same seed), per epoch, with the producer thread
+    overlapping; exceptions in the transform surface on the consumer."""
+    store = LocalStore(str(tmp_path))
+    _write_parquet_dataset(store.get_train_data_path(), n_files=2,
+                           rows_per_file=32)
+    from horovod_tpu.spark.data import (AsyncShardBatchLoader,
+                                        ShardBatchLoader)
+    files = store.list_parquet_files(store.get_train_data_path())
+    mk = lambda cls, **kw: cls(  # noqa: E731
+        shard=ParquetShard(store, files, ["features", "label"]),
+        batch_size=16, steps=3, transform=lambda b: b["label"].sum(),
+        seed=7, **kw)
+    sync = list(mk(ShardBatchLoader))
+    a = mk(AsyncShardBatchLoader)
+    async_1 = list(a)
+    async_2 = list(a)   # second epoch: fresh producer, next data
+    assert len(sync) == len(async_1) == len(async_2) == 3
+    np.testing.assert_allclose(async_1, sync)
+    assert not np.allclose(async_2, async_1)  # advanced, not repeated
+    a.close()
+
+    def boom(b):
+        raise RuntimeError("transform failed")
+
+    bad = AsyncShardBatchLoader(
+        shard=ParquetShard(store, files, ["label"]), batch_size=16,
+        steps=2, transform=boom)
+    with pytest.raises(RuntimeError, match="transform failed"):
+        list(bad)
+    bad.close()
