@@ -786,4 +786,105 @@ Status HierarchicalAllreduce(Transport* t, void* vbuf, int64_t count,
   return RingAllgatherv(&local, shard.data(), buf, counts, dtype);
 }
 
+Status HierarchicalAllgatherv(Transport* t, const void* sendbuf,
+                              void* recvbuf,
+                              const std::vector<int64_t>& counts,
+                              DataType dtype,
+                              const std::vector<int>& host_of) {
+  const int size = t->size();
+  const int rank = t->rank();
+  if (static_cast<int>(host_of.size()) != size ||
+      static_cast<int>(counts.size()) != size)
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "host_of/counts size != transport size");
+  if (size == 1) {
+    if (counts[0] > 0)
+      std::memcpy(recvbuf, sendbuf, counts[0] * DataTypeSize(dtype));
+    return Status::OK();
+  }
+
+  // One-pass host grouping (see HierarchicalAllreduce).
+  std::map<int, int> host_slot;
+  std::vector<std::vector<int>> by_host;
+  for (int r = 0; r < size; ++r) {
+    auto it = host_slot.find(host_of[r]);
+    if (it == host_slot.end()) {
+      it = host_slot.emplace(host_of[r],
+                             static_cast<int>(by_host.size())).first;
+      by_host.emplace_back();
+    }
+    by_host[it->second].push_back(r);
+  }
+  const int num_hosts = static_cast<int>(by_host.size());
+  const std::vector<int>& my_local = by_host[host_slot[host_of[rank]]];
+  const int k = static_cast<int>(my_local.size());
+  if (num_hosts == 1 || k == size)
+    return RingAllgatherv(t, sendbuf, recvbuf, counts, dtype);
+
+  const size_t esize = DataTypeSize(dtype);
+  auto offsets = PrefixOffsets(counts);  // rank-order output offsets
+  uint8_t* out = static_cast<uint8_t*>(recvbuf);
+  int li = 0;
+  while (my_local[li] != rank) ++li;
+  const int leader = my_local[0];
+
+  // 1. Gather to the host leader (local members in local order).
+  if (li != 0) {
+    Status st = t->Send(leader, sendbuf, counts[rank] * esize);
+    if (!st.ok()) return st;
+  } else {
+    // Leader builds this host's bundle: members' blocks back to back.
+    std::vector<uint8_t> bundle;
+    int64_t bundle_elems = 0;
+    for (int r : my_local) bundle_elems += counts[r];
+    bundle.reserve(static_cast<size_t>(bundle_elems) * esize);
+    std::vector<uint8_t> incoming;
+    for (int r : my_local) {
+      if (r == rank) {
+        const uint8_t* p = static_cast<const uint8_t*>(sendbuf);
+        bundle.insert(bundle.end(), p, p + counts[r] * esize);
+      } else {
+        Status st = t->Recv(r, &incoming);
+        if (!st.ok()) return st;
+        if (incoming.size() != static_cast<size_t>(counts[r]) * esize)
+          return Status::Error(StatusCode::kUnknownError,
+                               "hier allgather bundle size mismatch");
+        bundle.insert(bundle.end(), incoming.begin(), incoming.end());
+      }
+    }
+
+    // 2. Ring allgatherv of bundles among leaders (cross-host plane).
+    std::vector<int> leaders;
+    std::vector<int64_t> bundle_counts;
+    int ci = -1;
+    for (const auto& group : by_host) {
+      if (group[0] == rank) ci = static_cast<int>(leaders.size());
+      leaders.push_back(group[0]);
+      int64_t c = 0;
+      for (int r : group) c += counts[r];
+      bundle_counts.push_back(c);
+    }
+    SubsetTransport xhost(t, leaders, ci);
+    auto boff = PrefixOffsets(bundle_counts);
+    std::vector<uint8_t> all(static_cast<size_t>(boff[num_hosts]) * esize);
+    Status st = RingAllgatherv(&xhost, bundle.data(), all.data(),
+                               bundle_counts, dtype);
+    if (!st.ok()) return st;
+
+    // 3a. Scatter bundle blocks into rank-order output offsets.
+    for (int h = 0; h < num_hosts; ++h) {
+      size_t pos = static_cast<size_t>(boff[h]) * esize;
+      for (int r : by_host[h]) {
+        std::memcpy(out + offsets[r] * esize, all.data() + pos,
+                    counts[r] * esize);
+        pos += counts[r] * esize;
+      }
+    }
+  }
+
+  // 3b. Leader broadcasts the assembled output to local members.
+  SubsetTransport local(t, my_local, li);
+  return TreeBroadcast(&local, out, offsets[size], dtype, 0);
+}
+
 }  // namespace hvdcore

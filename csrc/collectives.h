@@ -60,6 +60,18 @@ Status HierarchicalAllreduce(Transport* t, void* buf, int64_t count,
                              DataType dtype, RedOp op,
                              const std::vector<int>& host_of);
 
+// Two-level allgatherv (reference: mpi_operations.cc:331 shared-mem
+// hierarchical allgather): gather to the host leader, ring-allgather
+// per-host bundles among leaders only, leader scatters blocks to rank
+// offsets and broadcasts locally. Cross-host connections drop from
+// all-pairs to leaders-only; works for ANY host grouping (no equal
+// ranks-per-host requirement — bundles are variable-size).
+Status HierarchicalAllgatherv(Transport* t, const void* sendbuf,
+                              void* recvbuf,
+                              const std::vector<int64_t>& counts,
+                              DataType dtype,
+                              const std::vector<int>& host_of);
+
 // Gather variable-size blocks: rank r contributes counts[r] elements from
 // sendbuf; recvbuf (sum(counts) elements) receives blocks ordered by rank.
 Status RingAllgatherv(Transport* t, const void* sendbuf, void* recvbuf,

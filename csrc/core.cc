@@ -217,6 +217,25 @@ void Core::SetTopology(const std::vector<int>& host_of, int64_t threshold) {
   hierarchical_threshold_ = threshold;
 }
 
+std::vector<int> Core::HierViewHosts(const PsState& ps, int64_t nbytes) {
+  std::vector<int> topo;
+  int64_t threshold;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    topo = host_of_;
+    threshold = hierarchical_threshold_;
+  }
+  std::vector<int> view_hosts;
+  if (threshold <= 0 || nbytes < threshold || topo.empty())
+    return view_hosts;
+  view_hosts.reserve(ps.members.size());
+  for (int g : ps.members) {
+    if (g < 0 || g >= static_cast<int>(topo.size())) return {};
+    view_hosts.push_back(topo[g]);
+  }
+  return view_hosts;
+}
+
 void Core::CompleteHandle(int64_t handle, HandleState state,
                           const std::string& error) {
   auto it = handles_.find(handle);
@@ -433,29 +452,11 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
       if (!fused && resp.prescale != 1.0)
         ScaleBuffer(buf, total, resp.dtype, resp.prescale);
       // Two-level path: engaged for large buffers on a known multi-host
-      // topology (SetTopology). host_of_ indexes GLOBAL ranks; the view
-      // ranks are process-set-local, so remap through ps.members.
-      // Snapshot under mu_: SetTopology is runtime-settable (autotune)
-      // and the cycle thread must not read the vector mid-reassignment.
-      std::vector<int> topo_snapshot;
-      int64_t hier_threshold;
-      {
-        std::lock_guard<std::mutex> g(mu_);
-        topo_snapshot = host_of_;
-        hier_threshold = hierarchical_threshold_;
-      }
+      // topology (SetTopology; see HierViewHosts).
       std::vector<int> view_hosts;
-      if (resp.op != RedOp::kAdasum && hier_threshold > 0 &&
-          static_cast<int64_t>(total * esize) >= hier_threshold &&
-          !topo_snapshot.empty()) {
-        view_hosts.reserve(ps.members.size());
-        bool ok = true;
-        for (int g : ps.members) {
-          ok = ok && g >= 0 && g < static_cast<int>(topo_snapshot.size());
-          if (ok) view_hosts.push_back(topo_snapshot[g]);
-        }
-        if (!ok) view_hosts.clear();
-      }
+      if (resp.op != RedOp::kAdasum)
+        view_hosts =
+            HierViewHosts(ps, static_cast<int64_t>(total * esize));
       const bool hier = !view_hosts.empty();
       if (timeline_)
         timeline_->ActivityStart(resp.names[0],
@@ -521,7 +522,20 @@ void Core::ExecuteResponse(PsState& ps, const Response& resp, int* completed) {
         scratch.assign(static_cast<size_t>(counts[view->rank()]) * esize, 0);
         sendbuf = scratch.data();
       }
-      st = RingAllgatherv(view, sendbuf, out.data(), counts, resp.dtype);
+      // Two-level path mirrors the allreduce gate (see HierViewHosts).
+      std::vector<int> view_hosts =
+          HierViewHosts(ps, static_cast<int64_t>(total * esize));
+      if (!view_hosts.empty()) {
+        if (timeline_)
+          timeline_->ActivityStart(resp.names[0],
+                                   "HIERARCHICAL_ALLGATHER");
+        st = HierarchicalAllgatherv(view, sendbuf, out.data(), counts,
+                                    resp.dtype, view_hosts);
+        if (timeline_) timeline_->ActivityEnd(resp.names[0]);
+      } else {
+        st = RingAllgatherv(view, sendbuf, out.data(), counts,
+                            resp.dtype);
+      }
       if (st.ok() && e) {
         e->output = std::move(out);
         e->out_shape = e->req.shape;

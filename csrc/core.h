@@ -191,6 +191,11 @@ class Core {
   void DelegateResponse(int ps_id, PsState& ps, const Response& resp);
   void CompleteHandle(int64_t handle, HandleState state,
                       const std::string& error);
+  // Hierarchical-collective gate: process-set-local host indices when the
+  // two-level path should engage for a buffer of `nbytes` (empty vector =
+  // stay flat). Snapshots topology under mu_ (SetTopology is
+  // runtime-settable).
+  std::vector<int> HierViewHosts(const PsState& ps, int64_t nbytes);
 
   CoreOptions opts_;
   std::unique_ptr<MuxTransport> mux_;

@@ -125,6 +125,18 @@ def init(comm=None, process_sets=None):
             ps_mod._setup(_runtime, process_sets or [])
             return _runtime
 
+        # Honor an EXPLICIT platform request: site plugins (e.g. the axon
+        # TPU tunnel) may force-select themselves over JAX_PLATFORMS at
+        # import time, which would make every worker of a CPU-plane test
+        # job initialize (and serialize on) the real chip. A no-op when
+        # the backend is already committed.
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:  # noqa: BLE001 — backend already initialized
+                pass
+
         log = get_logger()
         if envparse.get_bool(envparse.ELASTIC):
             # Elastic workers are spawned WITHOUT rank env: ranks come from

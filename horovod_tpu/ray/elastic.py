@@ -43,9 +43,12 @@ class RayHostDiscovery:
         self.max_np = max_np
 
     def find_available_hosts(self):
+        # No max_np capping HERE: the elastic driver caps at max_np
+        # AFTER blacklist filtering (_discover_targets) — a discovery-
+        # side budget would let a blacklisted host starve healthy
+        # replacements of slots. ``max_np`` is kept only as metadata.
         ray = _ray()
         hosts = []
-        budget = self.max_np if self.max_np is not None else float("inf")
         for node in ray.nodes():
             if not node.get("Alive"):
                 continue
@@ -54,12 +57,8 @@ class RayHostDiscovery:
             if self.gpus_per_worker:
                 slots = min(slots, int(res.get("GPU", 0)
                                        // self.gpus_per_worker))
-            # Cap discovery at max_np so the driver never even sees
-            # (and spawns toward) slots beyond the job's ceiling.
-            slots = int(min(slots, budget))
             if slots <= 0:
                 continue
-            budget -= slots
             hosts.append(HostInfo(node["NodeManagerAddress"], slots))
         return hosts
 
@@ -235,14 +234,10 @@ class ElasticRayExecutor:
         if self.use_placement_group:
             n = self.elastic.max_np or self.elastic.min_np
             hosts = len(self.discovery.find_available_hosts()) or 1
-            # Host counts are dynamic in an elastic job: round down to
-            # the largest divisor of n so pack bundles stay legal no
-            # matter how many nodes happen to be alive right now.
-            num_hosts = min(hosts, n)
-            while self.pack and n % num_hosts:
-                num_hosts -= 1
+            # Uneven pack splits are handled by strategy_for (elastic
+            # host counts are dynamic; divisibility is not required).
             strat = strategy_for(
-                self.pack, n, num_hosts=num_hosts,
+                self.pack, n, num_hosts=hosts,
                 cpus_per_worker=self.elastic.base.cpus_per_worker,
                 gpus_per_worker=self.elastic.base.gpus_per_worker)
             self._pg = strat.create_placement_group(

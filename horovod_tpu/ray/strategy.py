@@ -51,27 +51,38 @@ class PlacementStrategy:
 
 
 class ColocatedStrategy(PlacementStrategy):
-    """One bundle per host holding ``workers_per_host`` workers' combined
+    """One bundle per host holding that host's workers' combined
     resources; STRICT_PACK keeps each bundle on one node (reference:
-    strategy.py ColocatedStrategy — equal-distribution layout)."""
+    strategy.py ColocatedStrategy — equal-distribution layout).
 
-    def __init__(self, num_hosts, workers_per_host, cpus_per_worker=1,
-                 gpus_per_worker=0, resources_per_worker=None):
-        super().__init__(num_hosts * workers_per_host, cpus_per_worker,
+    ``workers_by_host`` allows uneven layouts (e.g. 7 workers on 2
+    hosts as 4+3); by default workers spread evenly."""
+
+    def __init__(self, num_hosts, workers_per_host=None, cpus_per_worker=1,
+                 gpus_per_worker=0, resources_per_worker=None,
+                 workers_by_host=None):
+        if workers_by_host is None:
+            workers_by_host = [workers_per_host] * num_hosts
+        super().__init__(sum(workers_by_host), cpus_per_worker,
                          gpus_per_worker, resources_per_worker)
         self.num_hosts = num_hosts
         self.workers_per_host = workers_per_host
+        self.workers_by_host = list(workers_by_host)
 
     def bundles(self):
         per = self._worker_resources()
-        bundle = {k: v * self.workers_per_host for k, v in per.items()}
-        return [dict(bundle) for _ in range(self.num_hosts)]
+        return [{k: v * count for k, v in per.items()}
+                for count in self.workers_by_host]
 
     def ray_strategy(self):
         return "STRICT_PACK" if self.num_hosts == 1 else "PACK"
 
     def bundle_index_for_worker(self, worker_index):
-        return worker_index // self.workers_per_host
+        for i, count in enumerate(self.workers_by_host):
+            if worker_index < count:
+                return i
+            worker_index -= count
+        raise IndexError("worker_index beyond num_workers")
 
 
 class SpreadStrategy(PlacementStrategy):
@@ -93,16 +104,18 @@ class SpreadStrategy(PlacementStrategy):
 def strategy_for(pack, num_workers, num_hosts=None, cpus_per_worker=1,
                  gpus_per_worker=0, resources_per_worker=None):
     """Reference-flag adapter: ``use_current_placement_group``/``pack``
-    style booleans to a strategy object."""
+    style booleans to a strategy object. Pack layouts split uneven
+    worker counts as evenly as possible (ceil on the first remainder
+    hosts) — elastic jobs have dynamic host counts, so divisibility
+    must not be a startup requirement."""
     if pack:
-        hosts = num_hosts or 1
-        if num_workers % hosts:
-            raise ValueError(
-                f"pack strategy needs num_workers ({num_workers}) "
-                f"divisible by num_hosts ({hosts})")
-        return ColocatedStrategy(hosts, num_workers // hosts,
-                                 cpus_per_worker, gpus_per_worker,
-                                 resources_per_worker)
+        hosts = min(num_hosts or 1, num_workers)
+        base, rem = divmod(num_workers, hosts)
+        by_host = [base + (1 if i < rem else 0) for i in range(hosts)]
+        return ColocatedStrategy(hosts, cpus_per_worker=cpus_per_worker,
+                                 gpus_per_worker=gpus_per_worker,
+                                 resources_per_worker=resources_per_worker,
+                                 workers_by_host=by_host)
     return SpreadStrategy(num_workers, cpus_per_worker, gpus_per_worker,
                           resources_per_worker)
 

@@ -66,9 +66,14 @@ def parse_args(argv=None):
                         default=None)
     parser.add_argument("--stall-shutdown-time-seconds", type=float,
                         default=None)
+    parser.add_argument("--check-build", action="store_true",
+                        help="print framework/backend support and exit "
+                             "(reference: horovodrun --check-build)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the training command to run on every slot")
     args = parser.parse_args(argv)
+    if args.check_build:
+        return args
     if not args.command:
         parser.error("no command given")
     if args.command[0] == "--":
@@ -174,8 +179,41 @@ def _knob_env(args):
     return env
 
 
+def check_build():
+    """Print available frameworks/backends (reference: horovodrun
+    --check-build, horovod/runner/launch.py check_build)."""
+    from .. import basics
+
+    def probe(mod):
+        try:
+            __import__(mod)
+            return True
+        except ImportError:
+            return False
+
+    lines = ["horovod_tpu build/runtime support:", "", "Frameworks:"]
+    for name, mod in [("jax", "jax"), ("tensorflow", "tensorflow"),
+                      ("keras", "keras"), ("pytorch", "torch"),
+                      ("mxnet", "mxnet")]:
+        lines.append(f"    [{'X' if probe(mod) else ' '}] {name}")
+    lines += ["", "Data planes:"]
+    xla = probe("jax")
+    for name, ok in [("XLA collectives (single + delegated)", xla),
+                     ("TCP ring collectives (native core)", True),
+                     ("MPI", basics.mpi_built()),
+                     ("NCCL", basics.nccl_built())]:
+        lines.append(f"    [{'X' if ok else ' '}] {name}")
+    lines += ["", "Integrations:"]
+    for name, mod in [("spark", "pyspark"), ("ray", "ray")]:
+        lines.append(f"    [{'X' if probe(mod) else ' '}] {name}")
+    print("\n".join(lines), flush=True)
+    return 0
+
+
 def run_commandline(argv=None):
     args = parse_args(argv)
+    if args.check_build:
+        return check_build()
     settings = Settings(
         num_proc=args.num_proc, hosts=args.hosts, hostfile=args.hostfile,
         start_timeout=args.start_timeout, verbose=args.verbose,

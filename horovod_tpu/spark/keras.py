@@ -142,9 +142,12 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
            hvd.callbacks.MetricAverageCallback()]
     cbs += list(callbacks or [])
 
-    history = model.fit(iter(loader), steps_per_epoch=steps, epochs=epochs,
-                        callbacks=cbs, verbose=verbose, **fit_kwargs)
-    loader.close()
+    try:
+        history = model.fit(iter(loader), steps_per_epoch=steps,
+                            epochs=epochs, callbacks=cbs, verbose=verbose,
+                            **fit_kwargs)
+    finally:
+        loader.close()
 
     if rank == 0:
         store.write(store.get_checkpoint_path(run_id),

@@ -157,56 +157,58 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
 
     module.train()
     global_step = 0
-    for epoch in range(epochs):
-        total = 0.0
-        for batch in loader:
-            optimizer.zero_grad()
-            loss = _step_loss(module.training_step(batch, global_step))
-            loss.backward()
-            optimizer.step()
-            total += float(loss.detach())
-            global_step += 1
-        for sched in schedulers:
-            sched.step()
-        avg = float(hvd.allreduce(
-            torch.tensor([total / steps]), name=f"ep{epoch}.loss"))
-        history["loss"].append(avg)
-        if val_batch is not None:
-            module.eval()
-            n_val = len(next(iter(val_batch.values())))
-            vl_sum, vl_n = 0.0, 0
-            with torch.no_grad():
-                for start in range(0, n_val, batch_size):
-                    chunk = {c: v[start:start + batch_size]
-                             for c, v in val_batch.items()}
-                    vb = to_batch(chunk)
-                    rows = len(next(iter(chunk.values())))
-                    # Real pl.LightningModule defines a validation_step
-                    # STUB returning None on the base class, so hasattr
-                    # alone cannot detect an override — a None loss means
-                    # "not implemented here", fall back to training_step.
-                    vloss = None
-                    if hasattr(module, "validation_step"):
-                        out = module.validation_step(
-                            vb, start // batch_size)
-                        vloss = (out.get("loss")
-                                 if isinstance(out, dict) else out)
-                    if vloss is None:
-                        vloss = _step_loss(module.training_step(
-                            vb, start // batch_size))
-                    vl_sum += float(vloss) * rows
-                    vl_n += rows
-            module.train()
-            history["val_loss"].append(float(hvd.allreduce(
-                torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
-        if hasattr(module, "on_train_epoch_end"):
-            module.on_train_epoch_end()
-        if verbose and rank == 0:
-            print(f"epoch {epoch}: " + ", ".join(
-                f"{k}={v[-1]:.4f}" for k, v in history.items()),
-                flush=True)
+    try:
+        for epoch in range(epochs):
+            total = 0.0
+            for batch in loader:
+                optimizer.zero_grad()
+                loss = _step_loss(module.training_step(batch, global_step))
+                loss.backward()
+                optimizer.step()
+                total += float(loss.detach())
+                global_step += 1
+            for sched in schedulers:
+                sched.step()
+            avg = float(hvd.allreduce(
+                torch.tensor([total / steps]), name=f"ep{epoch}.loss"))
+            history["loss"].append(avg)
+            if val_batch is not None:
+                module.eval()
+                n_val = len(next(iter(val_batch.values())))
+                vl_sum, vl_n = 0.0, 0
+                with torch.no_grad():
+                    for start in range(0, n_val, batch_size):
+                        chunk = {c: v[start:start + batch_size]
+                                 for c, v in val_batch.items()}
+                        vb = to_batch(chunk)
+                        rows = len(next(iter(chunk.values())))
+                        # Real pl.LightningModule defines a validation_step
+                        # STUB returning None on the base class, so hasattr
+                        # alone cannot detect an override — a None loss means
+                        # "not implemented here", fall back to training_step.
+                        vloss = None
+                        if hasattr(module, "validation_step"):
+                            out = module.validation_step(
+                                vb, start // batch_size)
+                            vloss = (out.get("loss")
+                                     if isinstance(out, dict) else out)
+                        if vloss is None:
+                            vloss = _step_loss(module.training_step(
+                                vb, start // batch_size))
+                        vl_sum += float(vloss) * rows
+                        vl_n += rows
+                module.train()
+                history["val_loss"].append(float(hvd.allreduce(
+                    torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
+            if hasattr(module, "on_train_epoch_end"):
+                module.on_train_epoch_end()
+            if verbose and rank == 0:
+                print(f"epoch {epoch}: " + ", ".join(
+                    f"{k}={v[-1]:.4f}" for k, v in history.items()),
+                    flush=True)
 
-    loader.close()
+    finally:
+        loader.close()
     if rank == 0:
         store.write(store.get_checkpoint_path(run_id),
                     serialize_torch(module))

@@ -150,41 +150,43 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
         history["val_loss"] = []
 
     model.train()
-    for epoch in range(epochs):
-        total = 0.0
-        for x, y in loader:
-            optimizer.zero_grad()
-            loss_val = loss_fn(model(x), y)
-            loss_val.backward()
-            optimizer.step()
-            total += float(loss_val.detach())
-        # Cross-rank metric averaging (the MetricAverageCallback analog).
-        avg = float(hvd.allreduce(
-            torch.tensor([total / steps]), name=f"ep{epoch}.loss"))
-        history["loss"].append(avg)
-        if val_batch is not None:
-            # Batched eval: one whole-split forward would allocate
-            # activations for 25% of a host-RAM-sized shard at once.
-            model.eval()
-            n_val = len(next(iter(val_batch.values())))
-            vl_sum, vl_n = 0.0, 0
-            with torch.no_grad():
-                for start in range(0, n_val, batch_size):
-                    chunk = {c: v[start:start + batch_size]
-                             for c, v in val_batch.items()}
-                    vx, vy = to_xy(chunk)
-                    rows = len(next(iter(chunk.values())))
-                    vl_sum += float(loss_fn(model(vx), vy)) * rows
-                    vl_n += rows
-            model.train()
-            history["val_loss"].append(float(hvd.allreduce(
-                torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
-        if verbose and rank == 0:
-            print(f"epoch {epoch}: " + ", ".join(
-                f"{k}={v[-1]:.4f}" for k, v in history.items()),
-                flush=True)
+    try:
+        for epoch in range(epochs):
+            total = 0.0
+            for x, y in loader:
+                optimizer.zero_grad()
+                loss_val = loss_fn(model(x), y)
+                loss_val.backward()
+                optimizer.step()
+                total += float(loss_val.detach())
+            # Cross-rank metric averaging (the MetricAverageCallback analog).
+            avg = float(hvd.allreduce(
+                torch.tensor([total / steps]), name=f"ep{epoch}.loss"))
+            history["loss"].append(avg)
+            if val_batch is not None:
+                # Batched eval: one whole-split forward would allocate
+                # activations for 25% of a host-RAM-sized shard at once.
+                model.eval()
+                n_val = len(next(iter(val_batch.values())))
+                vl_sum, vl_n = 0.0, 0
+                with torch.no_grad():
+                    for start in range(0, n_val, batch_size):
+                        chunk = {c: v[start:start + batch_size]
+                                 for c, v in val_batch.items()}
+                        vx, vy = to_xy(chunk)
+                        rows = len(next(iter(chunk.values())))
+                        vl_sum += float(loss_fn(model(vx), vy)) * rows
+                        vl_n += rows
+                model.train()
+                history["val_loss"].append(float(hvd.allreduce(
+                    torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
+            if verbose and rank == 0:
+                print(f"epoch {epoch}: " + ", ".join(
+                    f"{k}={v[-1]:.4f}" for k, v in history.items()),
+                    flush=True)
 
-    loader.close()
+    finally:
+        loader.close()
     if rank == 0:
         store.write(store.get_checkpoint_path(run_id),
                     serialize_torch(model))

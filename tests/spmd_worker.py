@@ -8,6 +8,7 @@ gen-pipeline.sh:231). Asserts rank-locally; any failure exits non-zero.
 
 import os
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -187,25 +188,36 @@ def main():
         assert raised, "adasum at non-power-of-two size must error"
 
     # -- duplicate name rejection -------------------------------------------
-    h1 = hvd.allreduce_async(jnp.ones(1024, jnp.float32), name="dup")
-    try:
+    # Deterministic in-flight window: rank 0's "dup" cannot complete
+    # until every rank submits it, and the peers submit LATE — so the
+    # duplicate submit below is guaranteed to find the name pending.
+    # (Back-to-back submits on all ranks would race the cycle thread:
+    # a fast negotiation can finish between the two Python calls.)
+    if rank == 0:
+        h1 = hvd.allreduce_async(jnp.ones(1024, jnp.float32), name="dup")
         try:
-            hvd.allreduce_async(jnp.ones(1024, jnp.float32), name="dup")
-            raised = False
-        except hvd.DuplicateNameError:
-            raised = True
-        assert raised, "duplicate name must be rejected"
-    finally:
-        hvd.synchronize(h1)
+            try:
+                hvd.allreduce_async(jnp.ones(1024, jnp.float32),
+                                    name="dup")
+                raised = False
+            except hvd.DuplicateNameError:
+                raised = True
+            assert raised, "duplicate name must be rejected"
+        finally:
+            hvd.synchronize(h1)
+    else:
+        time.sleep(0.3)
+        hvd.allreduce(jnp.ones(1024, jnp.float32), name="dup")
 
     # -- cross-rank validation error ----------------------------------------
     bad_shape = (3,) if rank == 0 else (4,)
+    err_text = None
     try:
         hvd.allreduce(jnp.zeros(bad_shape, jnp.float32), name="bad")
-        failed = False
     except hvd.HorovodInternalError as e:
-        failed = "mismatched" in str(e)
-    assert failed, "shape mismatch must fail on every rank"
+        err_text = str(e)
+    assert err_text is not None and "mismatched" in err_text, \
+        f"shape mismatch must fail on every rank; got {err_text!r}"
 
     # -- process sets --------------------------------------------------------
     # A strict subset (a set equal to the global one is rejected, matching

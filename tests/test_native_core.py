@@ -237,3 +237,89 @@ def test_hierarchical_min_op(tmp_path):
         4, [0, 0, 1, 1], threshold=1024, tmp_path=tmp_path,
         job="pytest-hier4", op=native.RED_MIN,
         expect=lambda n: np.arange(n, dtype=np.float32) * 1)
+
+
+def test_hierarchical_allgatherv_two_hosts(tmp_path):
+    """Ragged allgather over a two-host topology takes the leader-bundle
+    path (timeline-visible) and matches rank-order semantics (reference:
+    mpi_operations.cc:331 hierarchical allgather)."""
+    import json
+
+    size, host_of = 4, [0, 0, 1, 1]
+    paths = {r: str(tmp_path / f"hag.{r}.json") for r in range(size)}
+    errors = []
+
+    def worker(rank):
+        core = native.NativeCore(rank, size, transport="local",
+                                 peers="pytest-hier-ag",
+                                 timeline_path=paths[rank])
+        try:
+            core.set_topology(host_of, 64)
+            # Ragged: rank r contributes r+1 rows of 64 floats.
+            x = np.full((rank + 1, 64), float(rank), np.float32)
+            h = core.enqueue(0, "ag", native.REQ_ALLGATHER, x)
+            drive(core, h)
+            assert core.poll(h) == 1, core.error(h)
+            out = core.output(h, np.float32).reshape(-1, 64)
+            expect = np.concatenate(
+                [np.full((r + 1, 64), float(r), np.float32)
+                 for r in range(size)])
+            np.testing.assert_allclose(out, expect)
+            core.release(h)
+            core.request_shutdown()
+            while not core.shutdown_complete():
+                if core.run_cycle() < 0:
+                    break
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            core.close()
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, f"rank failures: {errors}"
+    for r in range(size):
+        names = {e.get("name") for e in json.load(open(paths[r]))}
+        assert "HIERARCHICAL_ALLGATHER" in names, names
+
+
+def test_hierarchical_allgatherv_uneven_hosts(tmp_path):
+    """3+1 split: the allgather path has NO equal-ranks-per-host
+    requirement (bundles are variable size)."""
+    size, host_of = 4, [0, 0, 0, 1]
+    errors = []
+
+    def worker(rank):
+        core = native.NativeCore(rank, size, transport="local",
+                                 peers="pytest-hier-ag2")
+        try:
+            core.set_topology(host_of, 64)
+            x = np.arange(128, dtype=np.float32) + 1000 * rank
+            h = core.enqueue(0, "ag", native.REQ_ALLGATHER, x)
+            drive(core, h)
+            assert core.poll(h) == 1, core.error(h)
+            out = core.output(h, np.float32).reshape(4, 128)
+            for r in range(size):
+                np.testing.assert_allclose(
+                    out[r], np.arange(128, dtype=np.float32) + 1000 * r)
+            core.release(h)
+            core.request_shutdown()
+            while not core.shutdown_complete():
+                if core.run_cycle() < 0:
+                    break
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            core.close()
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, f"rank failures: {errors}"

@@ -190,12 +190,20 @@ def test_spread_strategy_bundles():
     assert s.bundle_index_for_worker(2) == 2
 
 
-def test_strategy_for_divisibility():
+def test_strategy_for_uneven_pack_split():
     from horovod_tpu.ray.strategy import strategy_for
-    with pytest.raises(ValueError, match="divisible"):
-        strategy_for(True, 5, num_hosts=2)
+    # Elastic host counts are dynamic: non-divisible packs split as
+    # evenly as possible instead of failing at startup.
+    s = strategy_for(True, 5, num_hosts=2, cpus_per_worker=2)
+    assert s.workers_by_host == [3, 2]
+    assert s.bundles() == [{"CPU": 6}, {"CPU": 4}]
+    assert [s.bundle_index_for_worker(i) for i in range(5)] == \
+        [0, 0, 0, 1, 1]
     s = strategy_for(True, 4, num_hosts=2)
-    assert s.workers_per_host == 2
+    assert s.workers_by_host == [2, 2]
+    # More hosts than workers: empty bundles are dropped by clamping.
+    s = strategy_for(True, 2, num_hosts=4)
+    assert s.workers_by_host == [1, 1]
 
 
 # ---------------------------------------------------------------------------
