@@ -713,6 +713,28 @@ Status DisseminationBarrier(Transport* t) {
   return Status::OK();
 }
 
+namespace {
+// Group ranks by host in ONE pass (this runs per collective on the
+// cycle thread — keep it O(size)). Rank order within a host defines
+// the local order; hosts are numbered by first appearance, identically
+// on every rank. Returns the groups + the index of `rank`'s group.
+int GroupByHost(const std::vector<int>& host_of, int rank,
+                std::vector<std::vector<int>>* by_host) {
+  std::map<int, int> host_slot;  // host id -> dense host index
+  const int size = static_cast<int>(host_of.size());
+  for (int r = 0; r < size; ++r) {
+    auto it = host_slot.find(host_of[r]);
+    if (it == host_slot.end()) {
+      it = host_slot.emplace(host_of[r],
+                             static_cast<int>(by_host->size())).first;
+      by_host->emplace_back();
+    }
+    (*by_host)[it->second].push_back(r);
+  }
+  return host_slot[host_of[rank]];
+}
+}  // namespace
+
 Status HierarchicalAllreduce(Transport* t, void* vbuf, int64_t count,
                              DataType dtype, RedOp op,
                              const std::vector<int>& host_of) {
@@ -723,21 +745,9 @@ Status HierarchicalAllreduce(Transport* t, void* vbuf, int64_t count,
                          "host_of size != transport size");
   if (size == 1 || count == 0) return Status::OK();
 
-  // Group ranks by host in ONE pass (this runs per collective on the
-  // cycle thread — keep it O(size)). Rank order within a host defines
-  // the local order; hosts are numbered by first appearance.
-  std::map<int, int> host_slot;        // host id -> dense host index
   std::vector<std::vector<int>> by_host;
-  for (int r = 0; r < size; ++r) {
-    auto it = host_slot.find(host_of[r]);
-    if (it == host_slot.end()) {
-      it = host_slot.emplace(host_of[r],
-                             static_cast<int>(by_host.size())).first;
-      by_host.emplace_back();
-    }
-    by_host[it->second].push_back(r);
-  }
-  const std::vector<int>& my_local = by_host[host_slot[host_of[rank]]];
+  const std::vector<int>& my_local =
+      by_host[GroupByHost(host_of, rank, &by_host)];
   const int k = static_cast<int>(my_local.size());
   const int num_hosts = static_cast<int>(by_host.size());
   if (k == 1 || num_hosts == 1)
@@ -803,20 +813,10 @@ Status HierarchicalAllgatherv(Transport* t, const void* sendbuf,
     return Status::OK();
   }
 
-  // One-pass host grouping (see HierarchicalAllreduce).
-  std::map<int, int> host_slot;
   std::vector<std::vector<int>> by_host;
-  for (int r = 0; r < size; ++r) {
-    auto it = host_slot.find(host_of[r]);
-    if (it == host_slot.end()) {
-      it = host_slot.emplace(host_of[r],
-                             static_cast<int>(by_host.size())).first;
-      by_host.emplace_back();
-    }
-    by_host[it->second].push_back(r);
-  }
+  const std::vector<int>& my_local =
+      by_host[GroupByHost(host_of, rank, &by_host)];
   const int num_hosts = static_cast<int>(by_host.size());
-  const std::vector<int>& my_local = by_host[host_slot[host_of[rank]]];
   const int k = static_cast<int>(my_local.size());
   if (num_hosts == 1 || k == size)
     return RingAllgatherv(t, sendbuf, recvbuf, counts, dtype);

@@ -1,5 +1,7 @@
 #include "core.h"
 
+#include <map>
+
 #include <algorithm>
 #include <chrono>
 #include <cstring>
@@ -219,20 +221,26 @@ void Core::SetTopology(const std::vector<int>& host_of, int64_t threshold) {
 
 std::vector<int> Core::HierViewHosts(const PsState& ps, int64_t nbytes) {
   std::vector<int> topo;
-  int64_t threshold;
   {
     std::lock_guard<std::mutex> g(mu_);
+    // Scalar checks first: the common small-tensor path must not pay an
+    // O(world) vector copy just to discover the gate is closed.
+    if (hierarchical_threshold_ <= 0 || nbytes < hierarchical_threshold_ ||
+        host_of_.empty())
+      return {};
     topo = host_of_;
-    threshold = hierarchical_threshold_;
   }
   std::vector<int> view_hosts;
-  if (threshold <= 0 || nbytes < threshold || topo.empty())
-    return view_hosts;
   view_hosts.reserve(ps.members.size());
   for (int g : ps.members) {
     if (g < 0 || g >= static_cast<int>(topo.size())) return {};
     view_hosts.push_back(topo[g]);
   }
+  // Only worth engaging (and only honest to timeline as HIERARCHICAL_*)
+  // when the view spans >1 host AND some host holds >1 rank.
+  std::map<int, int> counts;
+  for (int h : view_hosts) ++counts[h];
+  if (counts.size() < 2 || counts.size() == view_hosts.size()) return {};
   return view_hosts;
 }
 

@@ -126,15 +126,18 @@ def init(comm=None, process_sets=None):
             return _runtime
 
         # Honor an EXPLICIT platform request: site plugins (e.g. the axon
-        # TPU tunnel) may force-select themselves over JAX_PLATFORMS at
+        # TPU tunnel) force-select themselves into jax_platforms at
         # import time, which would make every worker of a CPU-plane test
-        # job initialize (and serialize on) the real chip. A no-op when
-        # the backend is already committed.
+        # job initialize (and serialize on) the real chip. Only override
+        # when the CURRENT config still carries the plugin's self-
+        # selection and the env asks for something else — a config the
+        # program itself set (e.g. a conftest pinning cpu) wins.
         plat = os.environ.get("JAX_PLATFORMS")
-        if plat:
+        cur = getattr(jax.config, "jax_platforms", None) or ""
+        if plat and "axon" in cur and "axon" not in plat:
             try:
                 jax.config.update("jax_platforms", plat)
-            except Exception:  # noqa: BLE001 — backend already initialized
+            except Exception:  # noqa: BLE001 — backend already committed
                 pass
 
         log = get_logger()

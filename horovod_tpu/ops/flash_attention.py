@@ -82,6 +82,21 @@ def _block_skip(causal, q_start, k_start, kv_len, qb, kb, block_q,
     return skip
 
 
+def _tile_interior(causal, q_start, k_start, kv_len, qb, kb, block_q,
+                   block_k):
+    """True when NO element of the (qb, kb) tile is masked: every key
+    col is valid and (causal) the whole tile lies on/below the
+    diagonal. Such tiles skip the iota/compare/where mask construction
+    — per-element VPU work comparable to the exp itself, and at long
+    context most tiles are interior."""
+    inside = (kb + 1) * block_k <= kv_len
+    if causal:
+        min_row = q_start + qb * block_q
+        max_col = k_start + kb * block_k + block_k - 1
+        inside = jnp.logical_and(inside, max_col <= min_row)
+    return inside
+
+
 def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
                 block_k, n_k):
@@ -97,33 +112,36 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(jnp.logical_not(_block_skip(
-        causal, q_start, k_start, kv_len, qb, kb, block_q, block_k)))
-    def _():
-        q = q_ref[0]                  # (block_q, d)
-        k = k_ref[0]                  # (block_k, d)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+    skip = _block_skip(causal, q_start, k_start, kv_len, qb, kb,
+                       block_q, block_k)
+    interior = _tile_interior(causal, q_start, k_start, kv_len, qb, kb,
+                              block_q, block_k)
 
-        rows = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = cols < kv_len          # mask key padding
-        if causal:
-            mask = jnp.logical_and(mask,
-                                   (q_start + rows) >= (k_start + cols))
-        s = jnp.where(mask, s, _NEG_INF)
+    def tile_update(masked):
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        mask = None
+        if masked:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = cols < kv_len      # mask key padding
+            if causal:
+                mask = jnp.logical_and(
+                    mask, (q_start + rows) >= (k_start + cols))
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]         # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)        # (block_q, block_k) fp32
-        # Fully-masked rows: m_new stays _NEG_INF and p would be
-        # exp(0)=1 — zero those contributions so l stays 0 for them.
-        p = jnp.where(mask, p, 0.0)
+        if mask is not None:
+            # Fully-masked rows: m_new stays _NEG_INF and p would be
+            # exp(0)=1 — zero those contributions so l stays 0 for them.
+            p = jnp.where(mask, p, 0.0)
 
         l_prev = l_scr[:, :1]
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
@@ -132,6 +150,15 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip), interior))
+    def _():
+        tile_update(False)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip),
+                             jnp.logical_not(interior)))
+    def _():
+        tile_update(True)
 
     @pl.when(kb == n_k - 1)
     def _():
@@ -209,9 +236,12 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(jnp.logical_not(_block_skip(
-        causal, q_start, k_start, kv_len, qb, kb, block_q, block_k)))
-    def _():
+    skip = _block_skip(causal, q_start, k_start, kv_len, qb, kb,
+                       block_q, block_k)
+    interior = _tile_interior(causal, q_start, k_start, kv_len, qb, kb,
+                              block_q, block_k)
+
+    def tile_update(masked):
         q = q_ref[0]                  # (block_q, d)
         k = k_ref[0]                  # (block_k, d)
         v = v_ref[0]
@@ -222,15 +252,19 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
-        rows = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = cols < kv_len
-        if causal:
-            mask = jnp.logical_and(mask,
-                                   (q_start + rows) >= (k_start + cols))
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk) fp32
+        if masked:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = cols < kv_len
+            if causal:
+                mask = jnp.logical_and(
+                    mask, (q_start + rows) >= (k_start + cols))
+            p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        else:
+            # Interior tile: no element masked (see _tile_interior).
+            p = jnp.exp(s - lse[:, None])        # (bq, bk) fp32
 
         # MXU operands in the input dtype (bf16 in training; identity for
         # fp32 inputs), fp32 accumulation. fp32 operands would run the
@@ -246,6 +280,15 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip), interior))
+    def _():
+        tile_update(False)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip),
+                             jnp.logical_not(interior)))
+    def _():
+        tile_update(True)
 
     @pl.when(qb == n_q - 1)
     def _():
@@ -266,9 +309,12 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(jnp.logical_not(_block_skip(
-        causal, q_start, k_start, kv_len, qb, kb, block_q, block_k)))
-    def _():
+    skip = _block_skip(causal, q_start, k_start, kv_len, qb, kb,
+                       block_q, block_k)
+    interior = _tile_interior(causal, q_start, k_start, kv_len, qb, kb,
+                              block_q, block_k)
+
+    def tile_update(masked):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -279,15 +325,18 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        rows = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = cols < kv_len
-        if causal:
-            mask = jnp.logical_and(mask,
-                                   (q_start + rows) >= (k_start + cols))
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        if masked:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = cols < kv_len
+            if causal:
+                mask = jnp.logical_and(
+                    mask, (q_start + rows) >= (k_start + cols))
+            p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[:, None])  # interior: nothing masked
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -296,6 +345,15 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip), interior))
+    def _():
+        tile_update(False)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip),
+                             jnp.logical_not(interior)))
+    def _():
+        tile_update(True)
 
     @pl.when(kb == n_k - 1)
     def _():

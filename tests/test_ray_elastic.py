@@ -152,6 +152,18 @@ class FakeRay(types.ModuleType):
         return pg
 
 
+@pytest.fixture(autouse=True)
+def _env_guard():
+    """Fake actors run as THREADS, so the worker's os.environ.update
+    (correct behavior in a real ray actor process) lands in the pytest
+    process; restore the environment afterwards or every launcher test
+    that runs later inherits HVDTPU_ELASTIC + a dead rendezvous addr."""
+    saved = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(saved)
+
+
 @pytest.fixture()
 def fake_ray(monkeypatch):
     fake = FakeRay()
