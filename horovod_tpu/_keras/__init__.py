@@ -50,6 +50,12 @@ def create_distributed_optimizer(keras, optimizer, name=None,
     """Dynamic subclass of the optimizer whose apply() averages gradients
     across ranks first (reference: horovod/_keras/__init__.py:36
     create_distributed_optimizer)."""
+    if getattr(optimizer, "_hvd_wrapped", False):
+        # Idempotent: the wrapper is named after the wrapped class (for
+        # serialization), so users cannot tell an already-wrapped
+        # optimizer apart — e.g. after hvd.load_model. Re-wrapping would
+        # sync every gradient twice.
+        return optimizer
     cls = type(optimizer)
     backend = keras.backend.backend()
     log = get_logger()
@@ -103,6 +109,14 @@ def create_distributed_optimizer(keras, optimizer, name=None,
             return cls.apply_gradients(
                 self, list(zip(grads, [v for _, v in gv])), **kwargs)
 
+    # Serialization round-trip: keras saves the optimizer under its class
+    # name. Naming the wrapper after the wrapped class makes saved
+    # configs say e.g. "SGD", which stock keras can deserialize —
+    # load_model() then re-wraps (the reference's _keras/__init__.py
+    # load-model trick works the same way).
+    _Distributed.__name__ = cls.__name__
+    _Distributed.__qualname__ = cls.__qualname__
+    _Distributed.__module__ = cls.__module__
     optimizer.__class__ = _Distributed
     if spmd_active():
         log.info("keras DistributedOptimizer (%s backend) wrapping %s "

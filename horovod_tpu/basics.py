@@ -125,16 +125,21 @@ def init(comm=None, process_sets=None):
             ps_mod._setup(_runtime, process_sets or [])
             return _runtime
 
-        # Honor an EXPLICIT platform request: site plugins (e.g. the axon
-        # TPU tunnel) force-select themselves into jax_platforms at
-        # import time, which would make every worker of a CPU-plane test
-        # job initialize (and serialize on) the real chip. Only override
-        # when the CURRENT config still carries the plugin's self-
+        # Honor an EXPLICIT platform request: some site plugins
+        # force-select themselves into jax_platforms at import time,
+        # which would make every worker of a CPU-plane test job
+        # initialize (and serialize on) the real chip. Only override
+        # when the CURRENT config still carries a known plugin's self-
         # selection and the env asks for something else — a config the
-        # program itself set (e.g. a conftest pinning cpu) wins.
+        # program itself set (e.g. a conftest pinning cpu) wins. There
+        # is no general way to tell plugin-set from program-set config,
+        # so force-selecting plugins are listed here; extend the tuple
+        # when deploying under a new one.
+        _FORCED_PLATFORM_MARKERS = ("axon",)
         plat = os.environ.get("JAX_PLATFORMS")
         cur = getattr(jax.config, "jax_platforms", None) or ""
-        if plat and "axon" in cur and "axon" not in plat:
+        if plat and any(m in cur and m not in plat
+                        for m in _FORCED_PLATFORM_MARKERS):
             try:
                 jax.config.update("jax_platforms", plat)
             except Exception:  # noqa: BLE001 — backend already committed

@@ -65,6 +65,40 @@ def main():
     out = hvd.allreduce(np.ones(3, np.float32) * (r + 1), average=False)
     np.testing.assert_allclose(np.asarray(out), sum(range(1, n + 1)))
 
+    # dtype x op matrix through the keras binding's eager collectives
+    # (reference sweeps, test_tensorflow.py:128+ analog).
+    for dt in (np.float16, np.float32, np.float64, np.int32, np.int64,
+               np.uint8):
+        base = np.arange(1, 7).reshape(2, 3)
+        x = (base * (r + 1)).astype(dt)
+        summed = hvd.allreduce(x, average=False, name=f"k.{np.dtype(dt)}")
+        expect = base.astype(np.float64) * sum(range(1, n + 1))
+        if dt == np.uint8:
+            expect = np.mod(expect, 256)  # wraps at larger world sizes
+        np.testing.assert_allclose(
+            np.asarray(summed, np.float64), expect, rtol=1e-2)
+        g = hvd.allgather(x, name=f"kg.{np.dtype(dt)}")
+        assert np.asarray(g).shape == (2 * n, 3)
+    sc = hvd.allreduce(np.float32(r + 1), average=False, name="k.scalar")
+    np.testing.assert_allclose(float(np.asarray(sc)),
+                               sum(range(1, n + 1)))
+
+    # load_model round-trip restores the distributed optimizer wrapper.
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.keras")
+        model.save(path)
+        loaded = hvd.load_model(path)
+        pred_a = model.predict(X[:4], verbose=0)
+        pred_b = loaded.predict(X[:4], verbose=0)
+        np.testing.assert_allclose(pred_a, pred_b, rtol=1e-5, atol=1e-6)
+        # The re-wrap is the point of hvd.load_model: assert it happened
+        # and is idempotent (wrapping again must not double-sync).
+        assert getattr(loaded.optimizer, "_hvd_wrapped", False)
+        assert hvd.DistributedOptimizer(loaded.optimizer) \
+            is loaded.optimizer
+        loaded.fit(X[:32], y[:32], batch_size=16, epochs=1, verbose=0)
+
     print(f"rank {r}/{n}: KERAS-BINDING OK (backend="
           f"{keras.backend.backend()})", flush=True)
     hvd.shutdown()
