@@ -51,10 +51,21 @@ def create_distributed_optimizer(keras, optimizer, name=None,
     across ranks first (reference: horovod/_keras/__init__.py:36
     create_distributed_optimizer)."""
     if getattr(optimizer, "_hvd_wrapped", False):
-        # Idempotent: the wrapper is named after the wrapped class (for
-        # serialization), so users cannot tell an already-wrapped
-        # optimizer apart — e.g. after hvd.load_model. Re-wrapping would
-        # sync every gradient twice.
+        # Idempotent for the default recipe: the wrapper is named after
+        # the wrapped class (for serialization), so users cannot tell an
+        # already-wrapped optimizer apart — e.g. after hvd.load_model.
+        # Re-wrapping would sync every gradient twice. But a re-wrap
+        # with NON-default settings cannot be honored (the existing
+        # wrapper's closure keeps its own) — fail loudly, like the torch
+        # binding's double-wrap error.
+        if (op != reduce_ops.Average or gradient_predivide_factor != 1.0
+                or backward_passes_per_step != 1
+                or not average_aggregated_gradients):
+            raise ValueError(
+                "optimizer is already wrapped by DistributedOptimizer "
+                "(e.g. by hvd.load_model); the requested non-default "
+                "settings cannot be applied to the existing wrapper. "
+                "Rebuild the optimizer from its config and wrap once.")
         return optimizer
     cls = type(optimizer)
     backend = keras.backend.backend()

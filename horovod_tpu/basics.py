@@ -38,6 +38,11 @@ from .utils.logging_util import get_logger
 MODE_SINGLE = "single"
 MODE_SPMD = "spmd"
 
+# JAX site plugins known to force-select themselves into jax_platforms at
+# import time (see init()); module-level so deployments under a new
+# force-selecting plugin can extend it without editing init logic.
+FORCED_PLATFORM_MARKERS = ("axon",)
+
 
 class Topology:
     """Process-level topology (reference: rank/size/local/cross getters,
@@ -133,13 +138,12 @@ def init(comm=None, process_sets=None):
         # selection and the env asks for something else — a config the
         # program itself set (e.g. a conftest pinning cpu) wins. There
         # is no general way to tell plugin-set from program-set config,
-        # so force-selecting plugins are listed here; extend the tuple
-        # when deploying under a new one.
-        _FORCED_PLATFORM_MARKERS = ("axon",)
+        # so force-selecting plugins are listed in the module-level
+        # FORCED_PLATFORM_MARKERS tuple.
         plat = os.environ.get("JAX_PLATFORMS")
         cur = getattr(jax.config, "jax_platforms", None) or ""
         if plat and any(m in cur and m not in plat
-                        for m in _FORCED_PLATFORM_MARKERS):
+                        for m in FORCED_PLATFORM_MARKERS):
             try:
                 jax.config.update("jax_platforms", plat)
             except Exception:  # noqa: BLE001 — backend already committed

@@ -16,6 +16,7 @@ values beyond 2^31 and float64 precision are not preserved end to end.
 import numpy as np
 
 from .. import basics
+from ..utils.logging_util import get_logger
 from ..ops import collectives as _c
 from ..ops import reduce_ops
 from ..ops.compression import Compression
@@ -42,14 +43,37 @@ def _torch():
     return torch
 
 
+_warned_single_mode = [False]
+
+
+def _warn_single_mode_once():
+    """In single-controller mode basics.size() counts virtual devices
+    while this binding's world is launcher processes — mixing
+    hvd.rank() with hvd.torch.rank() in one script would silently give
+    two different worlds. Warn once so the split is visible."""
+    rt = basics.runtime()
+    if (not _warned_single_mode[0] and rt.mode == basics.MODE_SINGLE
+            and rt.size > 1):
+        _warned_single_mode[0] = True
+        get_logger().warning(
+            "horovod_tpu.torch: single-controller mode with %d virtual "
+            "devices — torch rank()/size() are PROCESS-level (1 process "
+            "here), while horovod_tpu.rank()/size() count virtual "
+            "devices. Launch under hvdrun for per-process torch "
+            "semantics, or use hvd.tpu_compile to train across the "
+            "local devices.", rt.size)
+
+
 def rank():
     """Process-level rank — deliberately NOT basics.rank()-aliased: in
     single-controller mode basics.size() counts virtual devices, while
     this binding's world is launcher processes."""
+    _warn_single_mode_once()
     return basics.runtime().topology.rank
 
 
 def size():
+    _warn_single_mode_once()
     return basics.runtime().topology.size
 
 
