@@ -50,22 +50,22 @@ def create_distributed_optimizer(keras, optimizer, name=None,
     """Dynamic subclass of the optimizer whose apply() averages gradients
     across ranks first (reference: horovod/_keras/__init__.py:36
     create_distributed_optimizer)."""
+    requested = (op, gradient_predivide_factor, backward_passes_per_step,
+                 average_aggregated_gradients)
     if getattr(optimizer, "_hvd_wrapped", False):
-        # Idempotent for the default recipe: the wrapper is named after
+        # Idempotent when the settings match: the wrapper is named after
         # the wrapped class (for serialization), so users cannot tell an
-        # already-wrapped optimizer apart — e.g. after hvd.load_model.
-        # Re-wrapping would sync every gradient twice. But a re-wrap
-        # with NON-default settings cannot be honored (the existing
+        # already-wrapped optimizer apart — e.g. after hvd.load_model —
+        # and re-wrapping would sync every gradient twice. A re-wrap
+        # with DIFFERENT settings cannot be honored (the existing
         # wrapper's closure keeps its own) — fail loudly, like the torch
         # binding's double-wrap error.
-        if (op != reduce_ops.Average or gradient_predivide_factor != 1.0
-                or backward_passes_per_step != 1
-                or not average_aggregated_gradients):
+        if getattr(optimizer, "_hvd_settings", None) != requested:
             raise ValueError(
                 "optimizer is already wrapped by DistributedOptimizer "
-                "(e.g. by hvd.load_model); the requested non-default "
-                "settings cannot be applied to the existing wrapper. "
-                "Rebuild the optimizer from its config and wrap once.")
+                "(e.g. by hvd.load_model) with different settings "
+                f"({optimizer._hvd_settings} vs requested {requested}); "
+                "rebuild the optimizer from its config and wrap once.")
         return optimizer
     cls = type(optimizer)
     backend = keras.backend.backend()
@@ -129,6 +129,7 @@ def create_distributed_optimizer(keras, optimizer, name=None,
     _Distributed.__qualname__ = cls.__qualname__
     _Distributed.__module__ = cls.__module__
     optimizer.__class__ = _Distributed
+    optimizer._hvd_settings = requested  # re-wrap guard compares these
     if spmd_active():
         log.info("keras DistributedOptimizer (%s backend) wrapping %s "
                  "over %d ranks", backend, cls.__name__, size())

@@ -32,10 +32,6 @@ Product = reduce_ops.Product
 init = basics.init
 shutdown = basics.shutdown
 is_initialized = basics.is_initialized
-local_rank = basics.local_rank
-local_size = basics.local_size
-cross_rank = basics.cross_rank
-cross_size = basics.cross_size
 
 
 def _torch():
@@ -67,7 +63,10 @@ def _warn_single_mode_once():
 def rank():
     """Process-level rank — deliberately NOT basics.rank()-aliased: in
     single-controller mode basics.size() counts virtual devices, while
-    this binding's world is launcher processes."""
+    this binding's world is launcher processes. The local/cross getters
+    below are topology-backed for the same reason (a virtual-device
+    local_size exceeding a process-level size() would be incoherent
+    within one binding)."""
     _warn_single_mode_once()
     return basics.runtime().topology.rank
 
@@ -75,6 +74,26 @@ def rank():
 def size():
     _warn_single_mode_once()
     return basics.runtime().topology.size
+
+
+def local_rank():
+    _warn_single_mode_once()
+    return basics.runtime().topology.local_rank
+
+
+def local_size():
+    _warn_single_mode_once()
+    return basics.runtime().topology.local_size
+
+
+def cross_rank():
+    _warn_single_mode_once()
+    return basics.runtime().topology.cross_rank
+
+
+def cross_size():
+    _warn_single_mode_once()
+    return basics.runtime().topology.cross_size
 
 
 def _spmd():
