@@ -1,20 +1,33 @@
 """Keras 3 MNIST-style training with horovod_tpu (reference:
 examples/keras/keras_mnist.py — same structure; synthetic MNIST-shaped
 data since this environment has no dataset egress). Works on any eager
-Keras backend (torch / tensorflow / jax-eager).
+Keras backend (torch / tensorflow / jax-eager) under hvdrun; on the jax
+backend in single-controller mode it compiles model.fit onto the TPU mesh
+(set_data_parallel — batch sharded, gradient reduction native in XLA).
 
-Run:  KERAS_BACKEND=torch hvdrun -np 2 python examples/keras_mnist.py
+Run:  KERAS_BACKEND=jax python examples/keras_mnist.py          # on-chip
+      KERAS_BACKEND=torch hvdrun -np 2 python examples/keras_mnist.py
 """
+
+import os
+import sys
 
 import numpy as np
 
-import horovod_tpu.keras as hvd
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu.keras as hvd  # noqa: E402
 
 
 def main():
     import keras
 
     hvd.init()
+    jax_backend = keras.backend.backend() == "jax"
+    if jax_backend and hvd.size() == 1:
+        # Single-controller mode: one process drives every local chip with
+        # a compiled train step; ranks stay 1, the mesh does the scaling.
+        hvd.set_data_parallel()
 
     rng = np.random.RandomState(42 + hvd.rank())
     x = rng.rand(512, 28, 28, 1).astype(np.float32)
@@ -32,9 +45,13 @@ def main():
     # Scale LR by world size; warmup ramps it in (reference pattern).
     opt = hvd.DistributedOptimizer(
         keras.optimizers.SGD(0.01 * hvd.size()))
+    # jax backend under hvdrun (multi-process host plane): the jitted
+    # train step cannot reach the eager collective — per-process sync
+    # needs run_eagerly (the compiled path is set_data_parallel above).
     model.compile(optimizer=opt,
                   loss="sparse_categorical_crossentropy",
-                  metrics=["accuracy"])
+                  metrics=["accuracy"],
+                  run_eagerly=jax_backend and hvd.size() > 1)
 
     model.fit(
         x, y, batch_size=64, epochs=3,

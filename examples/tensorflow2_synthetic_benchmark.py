@@ -3,12 +3,20 @@
 same structure: keras.applications model, synthetic data, timed batches).
 
 This is BASELINE config #2 ("ResNet-50 ImageNet, TF2 DistributedOptimizer")
-runnable end to end. TF has no TPU tunnel in this image, so it benchmarks
-the binding's collective plumbing on CPU; the TPU-resident ResNet number
-comes from bench.py's JAX path.
+runnable end to end. Two engines:
+
+- default: TF eager/graph per-process training with the binding's
+  collective plumbing (the reference's execution model, CPU TF here).
+- ``--engine tpu``: the model math runs ON THE CHIP — the train step is
+  rebuilt as one jitted XLA program via ``hvd.tpu_compile`` (graph→JAX,
+  horovod_tpu/tensorflow/compile.py) with the gradient reduction lowered
+  natively into the program.
 
 Run:  hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py \
           --model ResNet50 --batch-size 32
+On-chip:
+      python examples/tensorflow2_synthetic_benchmark.py --engine tpu \
+          --model ResNet50 --batch-size 256
 Smoke (tiny, CI-sized):
       hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py --tiny
 """
@@ -36,6 +44,10 @@ def parse_args():
     p.add_argument("--num-warmup-batches", type=int, default=2)
     p.add_argument("--tiny", action="store_true",
                    help="tiny conv net + 32px images (CI smoke)")
+    p.add_argument("--engine", choices=["tf", "tpu"], default="tf",
+                   help="tf: eager TF step with host-plane collectives; "
+                        "tpu: graph compiled to one XLA program via "
+                        "hvd.tpu_compile")
     return p.parse_args()
 
 
@@ -69,15 +81,36 @@ def main():
         rng.randint(0, 10 if args.tiny else 1000,
                     size=(args.batch_size,)), dtype=tf.int64)
 
-    @tf.function
-    def benchmark_step():
-        with tf.GradientTape() as tape:
-            probs = model(data, training=True)
-            loss = loss_fn(target, probs)
-        tape = hvd.DistributedGradientTape(tape)
-        grads = tape.gradient(loss, model.trainable_variables)
-        opt.apply_gradients(zip(grads, model.trainable_variables))
-        return loss
+    if args.engine == "tpu":
+        import optax
+
+        # Sync initial weights BEFORE the compile snapshots them into the
+        # jax params dict (under hvdrun each rank builds its own init).
+        hvd.broadcast_variables(model.variables, root_rank=0)
+
+        def tf_loss(x, y):
+            return loss_fn(y, model(x, training=True))
+
+        compiled = hvd.tpu_compile(tf_loss,
+                                   example_inputs=(data.numpy(),
+                                                   target.numpy()))
+        step = compiled.make_train_step(optax.sgd(0.01 * hvd.size()))
+        batch = (data.numpy(), target.numpy())
+
+        def benchmark_step():
+            # float() forces completion: jax dispatch is async and the
+            # timing would otherwise only measure enqueue.
+            return float(step(batch))
+    else:
+        @tf.function
+        def benchmark_step():
+            with tf.GradientTape() as tape:
+                probs = model(data, training=True)
+                loss = loss_fn(target, probs)
+            tape = hvd.DistributedGradientTape(tape)
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            return loss
 
     def log(s):
         if hvd.rank() == 0:

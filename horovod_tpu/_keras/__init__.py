@@ -1,9 +1,24 @@
 """Shared Keras-binding implementation (reference:
-horovod/_keras/__init__.py:207). Keras 3 is multi-backend; gradients are
-synchronized through the process-level SPMD plane regardless of which
-backend (tensorflow / torch / jax-eager) computes them. The jit-compiled
-keras-on-jax path belongs to ``horovod_tpu.jax`` (in-jit psum) instead —
-a host-side eager collective cannot run inside a jitted train step.
+horovod/_keras/__init__.py:207). Keras 3 is multi-backend; the wrapper
+synchronizes gradients through whichever plane matches how the step runs:
+
+- **jax backend, compiled (the TPU path)**: with a keras distribution
+  active (``horovod_tpu.keras.set_data_parallel``) the jitted train step
+  is ONE XLA program over the device mesh — the batch is sharded, the
+  variables are replicated, and XLA's SPMD partitioner lowers the gradient
+  reduction natively into the program. The wrapper's sync is an identity
+  there by design: this is the TPU-native answer to the reference's
+  XLA custom-call bridge (reference:
+  horovod/tensorflow/xla_mpi_ops.cc:174-232), with no host round-trip.
+- **tensorflow backend**: symbolic grads route through the TF binding's
+  py_function bridge.
+- **eager backends (torch / jax-eager)**: concrete grads ride the
+  process-level grouped-allreduce plane.
+
+Local gradient aggregation (``backward_passes_per_step``) delegates to
+Keras 3's native ``gradient_accumulation_steps`` engine, which is
+cond-based and therefore graph-safe on every backend (the reference's
+graph-state design: horovod/tensorflow/gradient_aggregation.py:16).
 """
 
 import numpy as np
@@ -42,6 +57,12 @@ def _reduce_numpy_grads(grads, op, prescale, postscale, name):
     return result
 
 
+def _any_jax_tracer(grads):
+    import jax
+    return any(isinstance(g, jax.core.Tracer)
+               for g in grads if g is not None)
+
+
 def create_distributed_optimizer(keras, optimizer, name=None,
                                  op=reduce_ops.Average,
                                  gradient_predivide_factor=1.0,
@@ -49,7 +70,23 @@ def create_distributed_optimizer(keras, optimizer, name=None,
                                  average_aggregated_gradients=True):
     """Dynamic subclass of the optimizer whose apply() averages gradients
     across ranks first (reference: horovod/_keras/__init__.py:36
-    create_distributed_optimizer)."""
+    create_distributed_optimizer).
+
+    ``backward_passes_per_step > 1`` enables local gradient aggregation via
+    Keras's native ``gradient_accumulation_steps`` (cond-based, graph-safe):
+    the parameter update runs every k-th ``apply``. Rank-sync happens per
+    micro-batch — for the linear Sum/Average reductions this is
+    mathematically identical to the reference's aggregate-then-reduce
+    (reference: horovod/tensorflow/gradient_aggregation.py:16); on the
+    compiled jax path the sync is free (it lowers into the program), on the
+    eager planes it trades the reference's comm saving for simplicity.
+    ``average_aggregated_gradients=False`` applies the micro-batch *sum*
+    (implemented by prescaling each micro-batch gradient by k so Keras's
+    built-in /k division cancels).
+    """
+    k = int(backward_passes_per_step)
+    if k < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
     requested = (op, gradient_predivide_factor, backward_passes_per_step,
                  average_aggregated_gradients)
     if getattr(optimizer, "_hvd_wrapped", False):
@@ -67,12 +104,34 @@ def create_distributed_optimizer(keras, optimizer, name=None,
                 f"({optimizer._hvd_settings} vs requested {requested}); "
                 "rebuild the optimizer from its config and wrap once.")
         return optimizer
+    if k > 1:
+        if op == reduce_ops.Adasum:
+            raise ValueError(
+                "backward_passes_per_step > 1 with Adasum is unsupported: "
+                "Adasum is nonlinear, so per-micro-batch reduction is not "
+                "equivalent to aggregate-then-Adasum. Aggregate in the "
+                "training loop instead.")
+        if getattr(optimizer, "built", False):
+            raise ValueError(
+                "backward_passes_per_step > 1 requires wrapping the "
+                "optimizer before it is built (accumulator slots are "
+                "created at build time).")
+        current = getattr(optimizer, "gradient_accumulation_steps", None)
+        if current not in (None, k):
+            raise ValueError(
+                f"optimizer already has gradient_accumulation_steps="
+                f"{current}, conflicting with backward_passes_per_step={k}")
+        optimizer.gradient_accumulation_steps = k
     cls = type(optimizer)
     backend = keras.backend.backend()
     log = get_logger()
 
     def _sync(grads):
         if not spmd_active():
+            # Single-controller mode: under a keras distribution
+            # (set_data_parallel) the jitted step is one XLA program over
+            # the mesh and the partitioner inserts the reduction; without
+            # one, world size is 1. Either way: identity.
             return grads
         if backend == "tensorflow":
             # Symbolic under tf.function: route through the TF binding's
@@ -94,6 +153,23 @@ def create_distributed_optimizer(keras, optimizer, name=None,
             for i, o in zip(dense_idx, outs):
                 result[i] = o
             return result
+        if backend == "jax" and _any_jax_tracer(grads):
+            # Jitted train step in multi-process SPMD mode. With a keras
+            # distribution over the jax.distributed global mesh the step
+            # compiles as one global-SPMD program and the partitioner
+            # already reduces the gradients of replicated variables —
+            # nothing to do. Without one, the host-plane collective cannot
+            # run under trace: fail with guidance instead of silently
+            # skipping the sync.
+            if keras.distribution.distribution() is not None:
+                return grads
+            raise RuntimeError(
+                "DistributedOptimizer cannot sync gradients inside a "
+                "jit-compiled keras train step over the host (TCP) data "
+                "plane. Either activate the compiled path with "
+                "horovod_tpu.keras.set_data_parallel() (jax backend, "
+                "collectives lower into the XLA program), or compile the "
+                "model with run_eagerly=True.")
         np_grads = [None if g is None
                     else np.asarray(keras.ops.convert_to_numpy(g))
                     for g in grads]
@@ -107,16 +183,28 @@ def create_distributed_optimizer(keras, optimizer, name=None,
         return [None if o is None else keras.ops.convert_to_tensor(o)
                 for o in outs]
 
+    unaveraged = k > 1 and not average_aggregated_gradients
+
+    def _prepare(grads):
+        grads = _sync(list(grads))
+        if unaveraged:
+            # Keras's accumulation engine applies (sum g_i)/k; the
+            # reference's average_aggregated_gradients=False applies the
+            # raw sum — prescale each micro-batch gradient by k so the
+            # division cancels.
+            grads = [None if g is None else g * k for g in grads]
+        return grads
+
     class _Distributed(cls):
         _hvd_wrapped = True
 
         def apply(self, grads, trainable_variables=None, **kwargs):
-            grads = _sync(list(grads))
+            grads = _prepare(grads)
             return cls.apply(self, grads, trainable_variables, **kwargs)
 
         def apply_gradients(self, grads_and_vars, **kwargs):
             gv = list(grads_and_vars)
-            grads = _sync([g for g, _ in gv])
+            grads = _prepare([g for g, _ in gv])
             return cls.apply_gradients(
                 self, list(zip(grads, [v for _, v in gv])), **kwargs)
 

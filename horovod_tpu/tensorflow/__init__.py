@@ -245,11 +245,14 @@ class DistributedGradientTape:
     def __init__(self, gradtape, device_dense="", device_sparse="",
                  compression=None, sparse_as_dense=True, op=Average,
                  gradient_predivide_factor=1.0,
-                 num_groups=0, process_set=global_process_set):
+                 num_groups=0, groups=None,
+                 process_set=global_process_set):
         self._tape = gradtape
         self._op = op
         self._process_set = process_set
         self._predivide = gradient_predivide_factor
+        self._num_groups = num_groups
+        self._groups = groups
 
     def __getattr__(self, name):
         return getattr(self._tape, name)
@@ -265,11 +268,41 @@ class DistributedGradientTape:
         grads = self._tape.gradient(target, sources, output_gradients)
         if not _spmd():
             return grads
+        ngroups, group_ids = _resolve_groups(
+            list(sources), self._num_groups, self._groups)
         return _reduce_grads(grads, self._op, self._process_set,
-                             self._predivide)
+                             self._predivide, ngroups, group_ids)
 
 
-def _reduce_grads(grads, op, process_set, predivide=1.0):
+def _grouping(n, num_groups, group_ids):
+    """Split n gradient slots into fusion buckets (reference:
+    horovod/tensorflow/__init__.py:627+ honors num_groups; groups= maps
+    variables to explicit buckets). Returns a list of index lists."""
+    if group_ids is not None:
+        by_gid = {}
+        rest = []
+        for i in range(n):
+            gid = group_ids[i]
+            if gid is None:
+                rest.append([i])
+            else:
+                by_gid.setdefault(gid, []).append(i)
+        return list(by_gid.values()) + rest
+    if num_groups and num_groups > 0:
+        k = min(int(num_groups), n)
+        # Contiguous near-even buckets, like the reference's split.
+        size, extra = divmod(n, k)
+        buckets, start = [], 0
+        for j in range(k):
+            end = start + size + (1 if j < extra else 0)
+            buckets.append(list(range(start, end)))
+            start = end
+        return buckets
+    return [list(range(n))]
+
+
+def _reduce_grads(grads, op, process_set, predivide=1.0, num_groups=0,
+                  group_ids=None):
     tf = _tf()
     dense_idx, dense = [], []
     for i, g in enumerate(grads):
@@ -283,13 +316,46 @@ def _reduce_grads(grads, op, process_set, predivide=1.0):
         return grads
     pre = 1.0 / predivide if predivide != 1.0 else 1.0
     post = predivide / 1.0 if predivide != 1.0 else 1.0
-    outs = grouped_allreduce(dense, op=op, prescale_factor=pre,
-                             postscale_factor=post,
-                             name="grad_reduce", process_set=process_set)
     result = list(grads)
-    for i, o in zip(dense_idx, outs):
-        result[i] = o
+    sub_ids = None if group_ids is None else \
+        [group_ids[i] for i in dense_idx]
+    for b, bucket in enumerate(_grouping(len(dense), num_groups, sub_ids)):
+        outs = grouped_allreduce([dense[j] for j in bucket], op=op,
+                                 prescale_factor=pre, postscale_factor=post,
+                                 name=f"grad_reduce.g{b}",
+                                 process_set=process_set)
+        for j, o in zip(bucket, outs):
+            result[dense_idx[j]] = o
     return result
+
+
+def tpu_compile(fn, example_inputs=None, input_signature=None,
+                dynamic_batch=True):
+    """Compile a TF2 callable to a jitted JAX function so the model math
+    runs on the TPU (see horovod_tpu/tensorflow/compile.py — the graph→JAX
+    redesign of the reference's device-kernel registration,
+    horovod/tensorflow/mpi_ops.cc:486-493 / xla_mpi_ops.cc:174-232)."""
+    from .compile import tpu_compile as _impl
+    return _impl(fn, example_inputs=example_inputs,
+                 input_signature=input_signature,
+                 dynamic_batch=dynamic_batch)
+
+
+def _resolve_groups(tvars, num_groups, groups):
+    """Normalize the reference's two grouping spellings (reference:
+    horovod/tensorflow/__init__.py:627+): ``num_groups`` (int bucket
+    count) or ``groups`` (int, or list of lists of variables). Returns
+    (num_groups, group_ids) where group_ids maps each grad slot to a
+    bucket id (None = ungrouped)."""
+    if groups is None:
+        return num_groups, None
+    if isinstance(groups, int):
+        return groups, None
+    by_ref = {}
+    for gid, bucket in enumerate(groups):
+        for v in bucket:
+            by_ref[v.ref()] = gid
+    return 0, [by_ref.get(v.ref()) for v in tvars]
 
 
 def DistributedOptimizer(optimizer, name=None, use_locking=False,
@@ -303,7 +369,23 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
     """Wrap a tf.keras optimizer so apply_gradients() averages gradients
     across ranks first, with optional local aggregation over
     ``backward_passes_per_step`` (reference:
-    horovod/tensorflow/__init__.py:627)."""
+    horovod/tensorflow/__init__.py:627).
+
+    Aggregation is graph-state based — a tf.Variable counter and
+    accumulator slots driven by tf.cond — so it is exact inside
+    ``tf.function`` train steps, where a Python-side counter would
+    freeze at its trace-time value (reference design:
+    horovod/tensorflow/gradient_aggregation.py:16). The rank-sync and
+    the inner apply happen only on every k-th call; skip calls just
+    accumulate. ``num_groups``/``groups`` bound the gradient fusion
+    buckets like the reference."""
+    k = int(backward_passes_per_step)
+    if k < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    if k > 1 and op == Adasum:
+        raise ValueError(
+            "backward_passes_per_step > 1 with Adasum is unsupported "
+            "(nonlinear reduction); aggregate in the training loop.")
     cls = type(optimizer)
     log = get_logger()
 
@@ -313,39 +395,85 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
         def __init__(self):  # pragma: no cover — state is copied below
             pass
 
+        def _hvd_ensure_state(self, tf, grads):
+            if self._hvd_counter is not None:
+                return
+            # init_scope lifts creation out of tf.function tracing, so
+            # the variables are created exactly once (first trace) and
+            # persist across calls — the reference's graph-state design.
+            with tf.init_scope():
+                self._hvd_counter = tf.Variable(
+                    0, trainable=False, dtype=tf.int64,
+                    name="hvd_agg_counter")
+                self._hvd_acc = [
+                    None if g is None else tf.Variable(
+                        tf.zeros(g.shape, g.dtype), trainable=False,
+                        name=f"hvd_agg_{i}")
+                    for i, g in enumerate(grads)]
+
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            tf = _tf()
             gv = list(grads_and_vars)
             grads = [g for g, _ in gv]
             tvars = [v for _, v in gv]
-            self._hvd_counter += 1
-            if backward_passes_per_step > 1:
-                if self._hvd_agg is None:
-                    self._hvd_agg = [None] * len(grads)
-                for i, g in enumerate(grads):
-                    if g is None:
-                        continue
-                    self._hvd_agg[i] = g if self._hvd_agg[i] is None \
-                        else self._hvd_agg[i] + g
-                if self._hvd_counter % backward_passes_per_step != 0:
-                    return None
-                grads = self._hvd_agg
-                self._hvd_agg = None
-                if average_aggregated_gradients:
-                    grads = [None if g is None
-                             else g / backward_passes_per_step
-                             for g in grads]
-            if _spmd():
-                grads = _reduce_grads(grads, op, process_set,
-                                      gradient_predivide_factor)
-            return cls.apply_gradients(self, list(zip(grads, tvars)),
-                                       *args, **kwargs)
+            ngroups, group_ids = _resolve_groups(tvars, num_groups,
+                                                 groups)
+
+            def reduce_and_apply(grads):
+                if _spmd():
+                    # _reduce_grads densifies IndexedSlices only here, on
+                    # the sync path — single-rank sparse gradients keep
+                    # the inner optimizer's sparse application.
+                    grads = _reduce_grads(grads, op, process_set,
+                                          gradient_predivide_factor,
+                                          ngroups, group_ids)
+                return cls.apply_gradients(self, list(zip(grads, tvars)),
+                                           *args, **kwargs)
+
+            if k == 1:
+                return reduce_and_apply(grads)
+
+            # Accumulator slots are dense: aggregation materializes
+            # sparse gradients by construction.
+            grads = [None if g is None
+                     else tf.convert_to_tensor(g) if isinstance(
+                         g, tf.IndexedSlices) else g
+                     for g in grads]
+            self._hvd_ensure_state(tf, grads)
+            if len(grads) != len(self._hvd_acc):
+                raise ValueError(
+                    f"backward_passes_per_step aggregation was built for "
+                    f"{len(self._hvd_acc)} gradients but this "
+                    f"apply_gradients call passed {len(grads)}; the "
+                    "variable list must stay fixed across calls.")
+            self._hvd_counter.assign_add(1)
+            for acc, g in zip(self._hvd_acc, grads):
+                if g is not None:
+                    acc.assign_add(g)
+            do_step = tf.equal(self._hvd_counter % k, 0)
+
+            def _apply():
+                agg = [None if acc is None else
+                       (acc.read_value() / k if average_aggregated_gradients
+                        else acc.read_value())
+                       for acc in self._hvd_acc]
+                reduce_and_apply(agg)
+                for acc in self._hvd_acc:
+                    if acc is not None:
+                        acc.assign(tf.zeros_like(acc))
+                return tf.constant(True)
+
+            def _skip():
+                return tf.constant(False)
+
+            return tf.cond(do_step, _apply, _skip)
 
     # Rebrand the instance in place (the reference builds a dynamic
     # subclass the same way, horovod/_keras/__init__.py:36).
     opt = optimizer
     opt.__class__ = _Distributed
-    opt._hvd_counter = 0
-    opt._hvd_agg = None
+    opt._hvd_counter = None
+    opt._hvd_acc = None
     if _spmd():
         log.info("tensorflow DistributedOptimizer wrapping %s over %d "
                  "ranks", cls.__name__, size())

@@ -1,0 +1,899 @@
+"""tf.function → JAX compiler: run TensorFlow-2 model math on the TPU.
+
+The reference runs TF model math on the accelerator by registering its
+collective kernels for device execution (reference:
+horovod/tensorflow/mpi_ops.cc:486-493) and can compile collectives into
+XLA programs through paired custom calls (reference:
+horovod/tensorflow/xla_mpi_ops.cc:174-232). This image's TF is CPU-only,
+so a kernel-registration port would leave the model on the host. The
+TPU-first answer mirrors the torch binding's round-3 design
+(horovod_tpu/torch/compile.py): treat the TF program as the model
+*definition* — trace it once with ``tf.function``, walk the
+ConcreteFunction graph, and rebuild it as a pure JAX function over a flat
+variable dict. The chip then runs XLA end-to-end: jit, shard_map
+collectives, optax, the Pallas kernels.
+
+    compiled = tpu_compile(loss_fn, example_inputs=(x, y))
+    loss = compiled(x, y)                                # jitted forward
+    step = compiled.make_train_step(optax.adam(1e-3))    # fwd+bwd+update
+    loss = step((x, y))                                  # on the chip
+    compiled.copy_params_to_variables()                  # sync back to TF
+
+Supported surface: the forward op set of TF2 models (conv/pool/matmul/
+batch-norm/embedding/activations/reductions/shape ops, the softmax cross
+entropies, stateless function calls). Gradients never need translating —
+JAX differentiates the rebuilt function. Unsupported ops raise with the
+node name so coverage gaps are explicit, not silent. Variable writes
+(``AssignAddVariableOp`` — e.g. batch-norm moving stats) are captured
+functionally and applied to the compiled module's buffers after each
+train step.
+
+Caveats: runs under JAX x64-off — int64 becomes int32, float64 becomes
+float32. Shapes are static (trace with concrete example inputs).
+Data-dependent TF control flow (``tf.while_loop``/``tf.cond`` on traced
+values) is out of scope — the same restriction XLA itself imposes on TPU.
+"""
+
+import math
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jdt(tf_dtype):
+    """tf dtype -> jax dtype under x64-off semantics."""
+    import jax.numpy as jnp
+    name = tf_dtype.name if hasattr(tf_dtype, "name") else str(tf_dtype)
+    table = {
+        "float64": jnp.float32, "float32": jnp.float32,
+        "float16": jnp.float16, "bfloat16": jnp.bfloat16,
+        "int64": jnp.int32, "int32": jnp.int32, "int16": jnp.int16,
+        "int8": jnp.int8, "uint8": jnp.uint8, "uint16": jnp.uint16,
+        "uint32": jnp.uint32, "bool": jnp.bool_,
+        "complex64": jnp.complex64,
+    }
+    if name not in table:
+        raise NotImplementedError(f"tf dtype {name} has no jax mapping")
+    return table[name]
+
+
+def _np_narrow(arr):
+    """Narrow 64-bit numpy arrays the way JAX x64-off would."""
+    arr = np.asarray(arr)
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        return arr.astype(np.int32)
+    if arr.dtype == np.uint64:
+        return arr.astype(np.uint32)
+    return arr
+
+
+class _Var:
+    """Resource-handle token flowing through the interpreted graph."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+
+def _is_static(x):
+    return isinstance(x, (int, float, bool, np.ndarray, np.generic,
+                          list, tuple))
+
+
+def _static_ints(x, what):
+    """Shape-like operand -> python int list (must be trace-static)."""
+    if hasattr(x, "aval"):  # jax tracer
+        raise NotImplementedError(
+            f"{what} must be trace-static (shapes are static under XLA); "
+            "got a traced value")
+    return [int(v) for v in np.asarray(x).reshape(-1)]
+
+
+def _axis_list(x, what):
+    return _static_ints(x, what)
+
+
+def _pool(x, ksize, strides, padding, kind):
+    import jax.lax as lax
+    jnp = _jnp()
+    if isinstance(padding, bytes):
+        padding = padding.decode()
+    window = tuple(int(k) for k in ksize)
+    strides = tuple(int(s) for s in strides)
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    summed = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add,
+                               window, strides, padding)
+    if padding == "VALID":
+        count = float(np.prod(window))
+        return (summed / count).astype(x.dtype)
+    ones = jnp.ones(x.shape, jnp.float32)
+    count = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+    return (summed / count).astype(x.dtype)
+
+
+def _strided_slice(x, begin, end, strides, begin_mask, end_mask,
+                   ellipsis_mask, new_axis_mask, shrink_axis_mask):
+    """Full tf.strided_slice semantics over a jax array or numpy value."""
+    begin = _static_ints(begin, "StridedSlice begin")
+    end = _static_ints(end, "StridedSlice end")
+    strides = _static_ints(strides, "StridedSlice strides")
+    spec = []
+    n_spec = len(begin)
+    # Expand ellipsis into full-dim slices.
+    n_new = bin(new_axis_mask).count("1")
+    for i in range(n_spec):
+        if ellipsis_mask & (1 << i):
+            n_explicit = n_spec - 1 - n_new
+            for _ in range(np.ndim(x) - n_explicit
+                           if hasattr(x, "ndim") else 0):
+                spec.append(slice(None))
+        elif new_axis_mask & (1 << i):
+            spec.append(None)
+        elif shrink_axis_mask & (1 << i):
+            spec.append(begin[i])
+        else:
+            b = None if begin_mask & (1 << i) else begin[i]
+            e = None if end_mask & (1 << i) else end[i]
+            s = strides[i]
+            spec.append(slice(b, e, s))
+    return x[tuple(spec)]
+
+
+def _sparse_softmax_ce(logits, labels):
+    import jax
+    jnp = _jnp()
+    lf = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lf, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    grad = jax.nn.softmax(lf, axis=-1) - jax.nn.one_hot(
+        labels, logits.shape[-1], dtype=jnp.float32)
+    return nll, grad
+
+
+def _softmax_ce(logits, labels):
+    import jax
+    jnp = _jnp()
+    lf = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lf, axis=-1)
+    loss = -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
+    grad = jax.nn.softmax(lf, axis=-1) - labels.astype(jnp.float32)
+    return loss, grad
+
+
+def _conv2d(x, w, strides, padding, dilations, data_format,
+            explicit_paddings=()):
+    import jax.lax as lax
+    if isinstance(data_format, bytes):
+        data_format = data_format.decode()
+    if data_format != "NHWC":
+        raise NotImplementedError(
+            f"Conv2D data_format {data_format}: the TPU path is NHWC")
+    if isinstance(padding, bytes):
+        padding = padding.decode()
+    if padding == "EXPLICIT":
+        pads = list(explicit_paddings)
+        padding = [(pads[2], pads[3]), (pads[4], pads[5])]
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides[1:3]), padding=padding,
+        rhs_dilation=tuple(dilations[1:3]),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _depthwise_conv2d(x, w, strides, padding, dilations, data_format):
+    import jax.lax as lax
+    if isinstance(data_format, bytes):
+        data_format = data_format.decode()
+    if data_format != "NHWC":
+        raise NotImplementedError("DepthwiseConv2d: NHWC only")
+    if isinstance(padding, bytes):
+        padding = padding.decode()
+    h, kw, cin, mult = w.shape
+    w = w.reshape(h, kw, 1, cin * mult)
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides[1:3]), padding=padding,
+        rhs_dilation=tuple(dilations[1:3]),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=cin)
+
+
+def _fused_batch_norm(interp, op, x, scale, offset, mean, var):
+    jnp = _jnp()
+    eps = op.get_attr("epsilon")
+    training = op.get_attr("is_training")
+    fmt = op.get_attr("data_format")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    if fmt != "NHWC":
+        raise NotImplementedError("FusedBatchNorm: NHWC only")
+    xf = x.astype(jnp.float32)
+    if training:
+        bmean = jnp.mean(xf, axis=(0, 1, 2))
+        bvar = jnp.var(xf, axis=(0, 1, 2))
+    else:
+        bmean, bvar = mean.astype(jnp.float32), var.astype(jnp.float32)
+    inv = 1.0 / jnp.sqrt(bvar + eps)
+    y = ((xf - bmean) * inv * scale.astype(jnp.float32)
+         + offset.astype(jnp.float32)).astype(x.dtype)
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    # TF's "reserve" outputs feed the fused backward kernel; JAX
+    # differentiates the forward math instead, so any tensor works —
+    # batch stats keep shapes consistent. Unbiased variance matches the
+    # moving-variance update TF emits.
+    uvar = bvar * (n / max(n - 1, 1)) if training else bvar
+    return (y, bmean, uvar, bmean, bvar, jnp.zeros_like(bvar))
+
+
+def _einsum_handler(op, args):
+    eq = op.get_attr("equation")
+    eq = eq.decode() if isinstance(eq, bytes) else eq
+    return _jnp().einsum(eq, *args)
+
+
+def _matmul(a, b, transpose_a=False, transpose_b=False):
+    jnp = _jnp()
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+def _bias_add(x, b, data_format=b"NHWC"):
+    fmt = data_format.decode() if isinstance(data_format, bytes) \
+        else data_format
+    if fmt == "NCHW" and x.ndim == 4:
+        return x + b.reshape(1, -1, 1, 1)
+    return x + b
+
+
+def _reduction(fn_name):
+    def handler(interp, op, x, axes):
+        keep = op.get_attr("keep_dims")
+        # TF lowers axis=None to an explicit all-dims const; axis=[] (an
+        # empty axes tensor) means "reduce nothing", which numpy/jnp
+        # express the same way. Static operands stay numpy: under
+        # omnistaging a jnp call would stage even a constant into the
+        # trace, poisoning downstream shape math.
+        ax = tuple(_axis_list(axes, f"{op.type} axes"))
+        if isinstance(x, (np.ndarray, np.generic)):
+            return np.asarray(getattr(np, fn_name)(x, axis=ax,
+                                                   keepdims=keep))
+        return getattr(_jnp(), fn_name)(x, axis=ax, keepdims=keep)
+    return handler
+
+
+def _concat(args, interp, op):
+    *values, axis = args
+    axis = int(np.asarray(axis))
+    if all(isinstance(v, (np.ndarray, np.generic, int, float))
+           for v in values):
+        return np.concatenate([np.asarray(v) for v in values], axis=axis)
+    return _jnp().concatenate(values, axis=axis)
+
+
+def _pack(args, axis):
+    if all(_is_static(a) for a in args):
+        return np.stack([np.asarray(a) for a in args], axis=axis)
+    return _jnp().stack(args, axis=axis)
+
+
+class _GraphInterpreter:
+    """Execute a ConcreteFunction graph with jax values.
+
+    Values are keyed by tensor name ("node:idx"). Resource handles flow as
+    :class:`_Var` tokens; ``ReadVariableOp``/``ResourceGather`` resolve
+    them against the params/buffers dicts, ``Assign*VariableOp`` records a
+    functional update instead of mutating. Random ops draw from a fold_in
+    of one PRNG key per site (deterministic given the key)."""
+
+    def __init__(self, graph, capture_values, fdef_library):
+        self.graph = graph
+        self.capture_values = capture_values  # placeholder name -> value
+        self.fdefs = fdef_library
+        self.rng_sites = {}
+        self._number_rng_sites(graph, prefix="")
+
+    def _number_rng_sites(self, graph, prefix):
+        for opr in graph.get_operations():
+            if opr.type in _RANDOM_OPS:
+                self.rng_sites[prefix + opr.name] = len(self.rng_sites)
+
+    def run(self, params, buffers, inputs, rng=None, training=False):
+        """inputs: list matching graph.inputs' non-capture prefix.
+        Returns (flat_outputs, buffer_updates)."""
+        self.params = params
+        self.buffers = buffers
+        self.rng = rng
+        self.training = training
+        self.updates = {}
+        env = {}
+        n_args = len(inputs)
+        for i, t in enumerate(self.graph.inputs):
+            if i < n_args:
+                env[t.name] = inputs[i]
+            elif t.name in self.capture_values:
+                env[t.name] = self.capture_values[t.name]
+            else:
+                raise KeyError(f"graph input {t.name} has no binding")
+        out_env = self._run_graph(self.graph, env, prefix="")
+        flat = [out_env[t.name] for t in self.graph.outputs]
+        return flat, self.updates
+
+    def _run_graph(self, graph, env, prefix):
+        for opr in graph.get_operations():
+            if opr.type in ("Placeholder", "Arg", "_Arg"):
+                continue  # bound by caller
+            if opr.type == "NoOp":
+                continue
+            args = [env[t.name] for t in opr.inputs]
+            outs = self._dispatch(opr, args, prefix)
+            if outs is _SKIP:
+                continue
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for t, v in zip(opr.outputs, outs):
+                env[t.name] = v
+        return env
+
+    def _rng_key(self, opr, prefix):
+        import jax
+        if self.rng is None:
+            raise ValueError(
+                f"graph contains random op {opr.name} ({opr.type}); pass "
+                "rng= (a jax PRNG key) to the compiled call")
+        return jax.random.fold_in(self.rng,
+                                  self.rng_sites[prefix + opr.name])
+
+    def _resolve_var(self, token, what):
+        if not isinstance(token, _Var):
+            raise NotImplementedError(
+                f"{what} on a non-variable resource")
+        if token.name in self.params:
+            return self.params[token.name]
+        if token.name in self.buffers:
+            # A buffer may have a pending in-graph update (e.g. BN moving
+            # stats assigned then read); reads see the latest write, like
+            # TF's resource ordering.
+            return self.updates.get(token.name, self.buffers[token.name])
+        raise KeyError(f"variable {token.name} not found")
+
+    def _call_function(self, opr, args, prefix):
+        attr = opr.node_def.attr["f"].func.name
+        fdef = self.fdefs.get(attr)
+        if fdef is None:
+            raise NotImplementedError(
+                f"function {attr!r} called by {opr.name} not in library")
+        from tensorflow.python.framework import function_def_to_graph
+        fg = function_def_to_graph.function_def_to_graph(fdef)
+        sub_prefix = prefix + opr.name + "/"
+        if sub_prefix not in getattr(self, "_numbered", set()):
+            self._numbered = getattr(self, "_numbered", set())
+            self._numbered.add(sub_prefix)
+            self._number_rng_sites(fg, sub_prefix)
+        env = {}
+        for t, v in zip(fg.inputs, args):
+            env[t.name] = v
+        out_env = self._run_graph(fg, env, sub_prefix)
+        return tuple(out_env[t.name] for t in fg.outputs)
+
+    def _dispatch(self, opr, args, prefix):
+        import jax
+        jnp = _jnp()
+        t = opr.type
+
+        if t == "Const":
+            import tensorflow as tf
+            val = _np_narrow(tf.make_ndarray(opr.get_attr("value")))
+            return val
+        if t in ("Identity", "PreventGradient", "EnsureShape",
+                 "CheckNumerics", "Snapshot"):
+            return args[0]
+        if t == "IdentityN":
+            return tuple(args)
+        if t == "StopGradient":
+            import jax.lax as lax
+            return lax.stop_gradient(args[0])
+        if t == "ReadVariableOp":
+            return self._resolve_var(args[0], "ReadVariableOp")
+        if t == "ResourceGather":
+            table = self._resolve_var(args[0], "ResourceGather")
+            return jnp.take(table, args[1].astype(jnp.int32)
+                            if hasattr(args[1], "astype") else args[1],
+                            axis=0)
+        if t in ("AssignVariableOp", "AssignAddVariableOp",
+                 "AssignSubVariableOp"):
+            token, value = args[0], args[1]
+            if not isinstance(token, _Var):
+                raise NotImplementedError(f"{t} on non-variable resource")
+            if token.name in self.params:
+                raise NotImplementedError(
+                    f"{t} writes trainable variable {token.name} inside "
+                    "the compiled function; train through "
+                    "make_train_step instead")
+            cur = self.updates.get(token.name,
+                                   self.buffers.get(token.name))
+            if t == "AssignVariableOp":
+                self.updates[token.name] = value
+            elif t == "AssignAddVariableOp":
+                self.updates[token.name] = cur + value
+            else:
+                self.updates[token.name] = cur - value
+            return _SKIP
+        if t in ("PartitionedCall", "StatefulPartitionedCall"):
+            return self._call_function(opr, args, prefix)
+
+        if t == "StatelessRandomGetKeyCounter":
+            # TF's seed->key/counter derivation; our randomness comes from
+            # the caller's jax PRNG key (fold_in per site), so these are
+            # inert placeholders consumed by the StatelessRandom*V2 ops.
+            return (np.zeros([1], np.uint32), np.zeros([2], np.uint32))
+        if t == "StatelessRandomGetAlg":
+            return np.int32(1)
+        if t in _RANDOM_OPS:
+            key = self._rng_key(opr, prefix)
+            shape = tuple(_static_ints(args[0], f"{t} shape"))
+            dt = _jdt(opr.get_attr("dtype"))
+            if "Uniform" in t:
+                return jax.random.uniform(key, shape, dtype=dt)
+            return jax.random.normal(key, shape, dtype=dt)
+
+        if t == "Shape":
+            return np.asarray(np.shape(args[0]), np.int32)
+        if t == "ShapeN":
+            return tuple(np.asarray(np.shape(a), np.int32) for a in args)
+        if t == "Size":
+            return np.int32(np.prod(np.shape(args[0])))
+        if t == "Rank":
+            return np.int32(np.ndim(args[0]))
+        if t == "Reshape":
+            shape = _static_ints(args[1], "Reshape shape")
+            x = args[0]
+            return (np.reshape(x, shape) if isinstance(x, np.ndarray)
+                    else x.reshape(shape))
+        if t == "Squeeze":
+            dims = [int(d) for d in opr.get_attr("squeeze_dims")]
+            return jnp.squeeze(args[0], axis=tuple(dims) if dims else None)
+        if t == "ExpandDims":
+            ax = int(np.asarray(args[1]))
+            x = args[0]
+            return (np.expand_dims(x, ax) if isinstance(x, np.ndarray)
+                    else jnp.expand_dims(x, ax))
+        if t == "Transpose":
+            perm = _static_ints(args[1], "Transpose perm")
+            return jnp.transpose(args[0], perm)
+        if t == "Pack":
+            return _pack(args, int(opr.get_attr("axis")))
+        if t == "Unpack":
+            ax = int(opr.get_attr("axis"))
+            n = int(opr.get_attr("num"))
+            parts = jnp.split(args[0], n, axis=ax)
+            return tuple(jnp.squeeze(p, axis=ax) for p in parts)
+        if t == "ConcatV2":
+            return _concat(args, self, opr)
+        if t == "Split":
+            ax = int(np.asarray(args[0]))
+            n = int(opr.get_attr("num_split"))
+            return tuple(jnp.split(args[1], n, axis=ax))
+        if t == "SplitV":
+            sizes = _static_ints(args[1], "SplitV sizes")
+            ax = int(np.asarray(args[2]))
+            idx = np.cumsum(sizes)[:-1]
+            return tuple(jnp.split(args[0], idx, axis=ax))
+        if t == "StridedSlice":
+            return _strided_slice(
+                args[0], args[1], args[2], args[3],
+                opr.get_attr("begin_mask"), opr.get_attr("end_mask"),
+                opr.get_attr("ellipsis_mask"),
+                opr.get_attr("new_axis_mask"),
+                opr.get_attr("shrink_axis_mask"))
+        if t == "Slice":
+            begin = _static_ints(args[1], "Slice begin")
+            size = _static_ints(args[2], "Slice size")
+            spec = tuple(slice(b, None if s == -1 else b + s)
+                         for b, s in zip(begin, size))
+            return args[0][spec]
+        if t == "Tile":
+            reps = _static_ints(args[1], "Tile multiples")
+            return jnp.tile(args[0], reps)
+        if t == "Fill":
+            shape = tuple(_static_ints(args[0], "Fill dims"))
+            return jnp.full(shape, args[1])
+        if t == "ZerosLike":
+            return jnp.zeros_like(args[0])
+        if t == "OnesLike":
+            return jnp.ones_like(args[0])
+        if t == "Range":
+            s, l, d = (np.asarray(a) for a in args[:3])
+            if all(_is_static(a) for a in args[:3]):
+                return np.arange(int(s), int(l), int(d),
+                                 dtype=_jdt(opr.get_attr("Tidx")))
+            return jnp.arange(args[0], args[1], args[2])
+        if t == "BroadcastTo":
+            shape = tuple(_static_ints(args[1], "BroadcastTo shape"))
+            return jnp.broadcast_to(args[0], shape)
+        if t == "GatherV2":
+            ax = int(np.asarray(args[2]))
+            batch_dims = int(opr.get_attr("batch_dims"))
+            if batch_dims:
+                return jnp.take_along_axis(args[0], args[1], axis=ax)
+            return jnp.take(args[0], args[1], axis=ax)
+        if t == "Pad":
+            pads = [tuple(p) for p in
+                    np.asarray(args[1], np.int64).tolist()]
+            return jnp.pad(args[0], pads)
+        if t == "PadV2":
+            pads = [tuple(p) for p in
+                    np.asarray(args[1], np.int64).tolist()]
+            return jnp.pad(args[0], pads, constant_values=args[2])
+        if t == "Cumsum":
+            return jnp.cumsum(args[0], axis=int(np.asarray(args[1])))
+        if t == "OneHot":
+            depth = int(np.asarray(args[1]))
+            ax = int(opr.get_attr("axis"))
+            on, off = args[2], args[3]
+            oh = jax.nn.one_hot(args[0], depth,
+                                axis=ax if ax != -1 else -1)
+            return oh * on + (1 - oh) * off
+        if t in ("Select", "SelectV2"):
+            return jnp.where(args[0], args[1], args[2])
+        if t == "Cast":
+            dst = _jdt(opr.get_attr("DstT"))
+            x = args[0]
+            if isinstance(x, np.ndarray) or np.isscalar(x):
+                return np.asarray(x).astype(dst)
+            return x.astype(dst)
+
+        if t == "MatMul":
+            return _matmul(args[0], args[1],
+                           opr.get_attr("transpose_a"),
+                           opr.get_attr("transpose_b"))
+        if t in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
+            return _matmul(args[0], args[1],
+                           opr.get_attr("adj_x"), opr.get_attr("adj_y"))
+        if t == "Einsum":
+            return _einsum_handler(opr, args)
+        if t == "BiasAdd":
+            return _bias_add(args[0], args[1],
+                             opr.get_attr("data_format"))
+        if t == "Conv2D":
+            try:
+                explicit = opr.get_attr("explicit_paddings")
+            except ValueError:
+                explicit = ()
+            return _conv2d(args[0], args[1], opr.get_attr("strides"),
+                           opr.get_attr("padding"),
+                           opr.get_attr("dilations"),
+                           opr.get_attr("data_format"), explicit)
+        if t == "DepthwiseConv2dNative":
+            return _depthwise_conv2d(
+                args[0], args[1], opr.get_attr("strides"),
+                opr.get_attr("padding"), opr.get_attr("dilations"),
+                opr.get_attr("data_format"))
+        if t == "MaxPool":
+            return _pool(args[0], opr.get_attr("ksize"),
+                         opr.get_attr("strides"),
+                         opr.get_attr("padding"), "max")
+        if t == "AvgPool":
+            return _pool(args[0], opr.get_attr("ksize"),
+                         opr.get_attr("strides"),
+                         opr.get_attr("padding"), "avg")
+        if t == "FusedBatchNormV3":
+            return _fused_batch_norm(self, opr, *args[:5])
+        if t == "SparseSoftmaxCrossEntropyWithLogits":
+            return _sparse_softmax_ce(args[0], args[1])
+        if t == "SoftmaxCrossEntropyWithLogits":
+            return _softmax_ce(args[0], args[1])
+        if t == "L2Loss":
+            return jnp.sum(jnp.square(args[0])) / 2
+
+        if t in _REDUCTIONS:
+            return _REDUCTIONS[t](self, opr, args[0], args[1])
+        if t == "ArgMax":
+            return jnp.argmax(args[0], axis=int(np.asarray(args[1]))) \
+                .astype(_jdt(opr.get_attr("output_type")))
+        if t == "ArgMin":
+            return jnp.argmin(args[0], axis=int(np.asarray(args[1]))) \
+                .astype(_jdt(opr.get_attr("output_type")))
+
+        simple = _SIMPLE_OPS.get(t)
+        if simple is not None:
+            return simple(*args)
+
+        raise NotImplementedError(
+            f"tf op {t!r} (node {opr.name}) has no jax mapping; add it "
+            "to horovod_tpu/tensorflow/compile.py")
+
+
+_SKIP = object()
+
+_RANDOM_OPS = ("RandomUniform", "RandomStandardNormal",
+               "StatelessRandomUniformV2", "StatelessRandomNormalV2")
+
+_REDUCTIONS = {
+    "Mean": _reduction("mean"), "Sum": _reduction("sum"),
+    "Max": _reduction("max"), "Min": _reduction("min"),
+    "Prod": _reduction("prod"), "All": _reduction("all"),
+    "Any": _reduction("any"),
+}
+
+
+def _make_simple_ops():
+    import jax
+    jnp = _jnp()
+
+    def binop(fn, fn_static=None):
+        # Static operands (shape math) stay numpy — omnistaging would
+        # stage a jnp call on constants into the trace.
+        def h(a, b):
+            if _is_static(a) and _is_static(b):
+                return np.asarray((fn_static or fn)(np.asarray(a),
+                                                    np.asarray(b)))
+            return fn(a, b)
+        return h
+
+    return {
+        "Add": binop(lambda a, b: a + b),
+        "AddV2": binop(lambda a, b: a + b),
+        "Sub": binop(lambda a, b: a - b),
+        "Mul": binop(lambda a, b: a * b),
+        "RealDiv": binop(lambda a, b: a / b),
+        "Div": binop(lambda a, b: a / b),
+        "FloorDiv": binop(lambda a, b: a // b),
+        "FloorMod": binop(lambda a, b: a % b),
+        "Pow": binop(jnp.power, np.power),
+        "Maximum": binop(jnp.maximum, np.maximum),
+        "Minimum": binop(jnp.minimum, np.minimum),
+        "SquaredDifference": lambda a, b: jnp.square(a - b),
+        # Safe-denominator form: a plain where(b==0, 0, a/b) yields NaN
+        # *gradients* at b==0 (inf cotangent times zero), the classic
+        # JAX where-div pitfall.
+        "DivNoNan": lambda a, b: jnp.where(
+            b == 0, 0.0, a / jnp.where(b == 0, 1, b)),
+        "AddN": lambda *xs: sum(xs[1:], start=xs[0]),
+        "Square": jnp.square, "Sqrt": jnp.sqrt,
+        "Rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+        "Exp": jnp.exp, "Log": jnp.log, "Log1p": jnp.log1p,
+        "Expm1": jnp.expm1,
+        "Neg": lambda x: -x, "Abs": jnp.abs, "Sign": jnp.sign,
+        "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
+        "Rint": jnp.round,
+        "Tanh": jnp.tanh, "Sigmoid": jax.nn.sigmoid,
+        "Erf": jax.scipy.special.erf,
+        "Sin": jnp.sin, "Cos": jnp.cos,
+        "Relu": jax.nn.relu,
+        "Relu6": lambda x: jnp.clip(x, 0, 6),
+        "LeakyRelu": jax.nn.leaky_relu,
+        "Elu": jax.nn.elu, "Selu": jax.nn.selu,
+        "Softplus": jax.nn.softplus,
+        "Softsign": jax.nn.soft_sign,
+        "Softmax": lambda x: jax.nn.softmax(
+            x.astype(jnp.float32), axis=-1).astype(x.dtype),
+        "LogSoftmax": lambda x: jax.nn.log_softmax(
+            x.astype(jnp.float32), axis=-1).astype(x.dtype),
+        "Equal": binop(lambda a, b: a == b),
+        "NotEqual": binop(lambda a, b: a != b),
+        "Less": binop(lambda a, b: a < b),
+        "LessEqual": binop(lambda a, b: a <= b),
+        "Greater": binop(lambda a, b: a > b),
+        "GreaterEqual": binop(lambda a, b: a >= b),
+        "LogicalAnd": binop(lambda a, b: a & b),
+        "LogicalOr": binop(lambda a, b: a | b),
+        "LogicalNot": lambda x: ~x,
+        "ClipByValue": jnp.clip,
+        "Reciprocal": lambda x: 1.0 / x,
+        "IsFinite": jnp.isfinite,
+        "IsNan": jnp.isnan,
+        "IsInf": jnp.isinf,
+    }
+
+
+_SIMPLE_OPS = None
+
+
+def _init_tables():
+    global _SIMPLE_OPS
+    if _SIMPLE_OPS is None:
+        _SIMPLE_OPS = _make_simple_ops()
+
+
+class CompiledFunction:
+    """A tf.function compiled to a jitted JAX callable.
+
+    ``params`` holds the trainable variables (flat name->jax-array dict —
+    the pytree the train step updates); ``buffers`` holds non-trainable
+    ones (e.g. batch-norm moving stats), functionally updated from the
+    graph's Assign ops after each training call."""
+
+    def __init__(self, cf, params, buffers, capture_values, fdefs):
+        _init_tables()
+        self._cf = cf
+        self._interp = _GraphInterpreter(cf.graph, capture_values, fdefs)
+        self.params = params
+        self.buffers = buffers
+        self._jitted = {}
+
+    # -- functional core ---------------------------------------------------
+    def apply(self, params, inputs, buffers=None, rng=None,
+              training=False):
+        """Pure forward: returns (structured_output, new_buffers).
+        Differentiable w.r.t. ``params``."""
+        import tensorflow as tf
+        buffers = self.buffers if buffers is None else buffers
+        flat, updates = self._interp.run(params, buffers, list(inputs),
+                                         rng=rng, training=training)
+        out = tf.nest.pack_sequence_as(self._cf.structured_outputs, flat)
+        new_buffers = dict(buffers)
+        new_buffers.update(updates)
+        return out, new_buffers
+
+    def __call__(self, *inputs, rng=None, training=False):
+        import jax
+        sig = (training, rng is not None, len(inputs))
+        if sig not in self._jitted:
+            def fwd(params, buffers, inputs, rng):
+                out, _ = self.apply(params, inputs, buffers=buffers,
+                                    rng=rng, training=training)
+                return out
+            self._jitted[sig] = jax.jit(fwd)
+        inputs = tuple(self._coerce(v) for v in inputs)
+        return self._jitted[sig](self.params, self.buffers, inputs, rng)
+
+    @staticmethod
+    def _coerce(v):
+        import jax.numpy as jnp
+        if hasattr(v, "numpy") and not hasattr(v, "devices"):  # tf tensor
+            return jnp.asarray(_np_narrow(v.numpy()))
+        if isinstance(v, np.ndarray):
+            return jnp.asarray(_np_narrow(v))
+        return v
+
+    def make_train_step(self, optimizer, process_set=None):
+        """Jitted distributed train step: forward+backward on the chip,
+        gradient reduction through the JAX binding, optax update, buffer
+        (e.g. BN moving-stat) writes applied. The compiled function must
+        return a scalar loss (or a structure whose first flat element is
+        the scalar loss). Returns ``step(batch, rng=None) -> loss`` with
+        params/opt state living inside (TF-optimizer style)."""
+        import jax
+        from .. import basics
+        from .. import jax as hvd_jax
+
+        dist_opt = optimizer
+        if not hasattr(dist_opt, "inner"):  # bare optax transform
+            dist_opt = hvd_jax.DistributedOptimizer(
+                optimizer, **({"process_set": process_set}
+                              if process_set else {}))
+
+        def loss_fn(params, aux, batch):
+            import tensorflow as tf
+            inputs, rng = batch
+            out, new_buffers = self.apply(
+                params, inputs, buffers=aux,
+                rng=None if rng is None else rng[0], training=True)
+            flat = tf.nest.flatten(out)
+            loss = flat[0]
+            if getattr(loss, "ndim", 0) != 0:
+                raise ValueError(
+                    "make_train_step needs a scalar loss as the "
+                    f"function's (first) output; got shape "
+                    f"{getattr(loss, 'shape', None)}")
+            return loss, new_buffers
+
+        step = hvd_jax.make_train_step(loss_fn, dist_opt, has_aux=True)
+        opt_state = dist_opt.init(self.params)
+        state = {"opt": opt_state}
+
+        def run(batch, rng=None):
+            batch = tuple(self._coerce(v) for v in batch)
+            rt = basics.runtime()
+            n = int(rt.mesh.shape[hvd_jax.HVD_AXIS])
+            for i, v in enumerate(batch):
+                if hasattr(v, "shape") and (v.ndim == 0
+                                            or v.shape[0] % n):
+                    raise ValueError(
+                        f"batch[{i}] leading axis {v.shape} must be "
+                        f"divisible by the local mesh size {n}: the step "
+                        "shards the batch across this runtime's devices")
+            if rng is not None:
+                rng = jax.random.fold_in(rng, rt.topology.rank)
+                rng = jax.random.split(rng, n)
+            new_params, new_buffers, new_opt, loss_val = step(
+                self.params, self.buffers, state["opt"], (batch, rng))
+            self.params = new_params
+            self.buffers = new_buffers
+            state["opt"] = new_opt
+            return loss_val
+
+        return run
+
+    def copy_params_to_variables(self, variables=None):
+        """Write the (possibly updated) jax values back into the TF
+        variables, so TF-side checkpointing/eval sees trained weights."""
+        import jax
+        variables = self._cf.variables if variables is None else variables
+        for v in variables:
+            src = self.params.get(v.name, self.buffers.get(v.name))
+            if src is not None:
+                v.assign(np.asarray(jax.device_get(src),
+                                    dtype=v.dtype.as_numpy_dtype))
+
+
+def tpu_compile(fn, example_inputs=None, input_signature=None,
+                dynamic_batch=True):
+    """Compile a TF2 callable for TPU execution via graph→JAX.
+
+    Args:
+      fn: a python callable using TF ops, or a ``tf.function``. Model
+        variables must be captured (module attributes / closure), the TF2
+        idiom.
+      example_inputs: concrete example arguments (tensors/arrays) used to
+        trace. With ``dynamic_batch`` (default) the leading dim is traced
+        as None so ``tf.shape``-based batch math stays symbolic — the
+        train step re-specializes it per batch shard, while every other
+        dim stays static as XLA requires.
+      input_signature: alternative to example_inputs — a list of
+        ``tf.TensorSpec`` (None dims allowed; they resolve to the actual
+        jax shapes at interpretation time).
+
+    Returns a :class:`CompiledFunction`.
+    """
+    import tensorflow as tf
+
+    if not isinstance(fn, def_function_type()):
+        fn = tf.function(fn)
+    if input_signature is not None:
+        cf = fn.get_concrete_function(*input_signature)
+    elif example_inputs is not None:
+        specs = []
+        for a in example_inputs:
+            shape = list(np.shape(a))
+            if dynamic_batch and shape:
+                # Keep the batch dim symbolic: a fully-static trace would
+                # constant-fold tf.shape into the trace-time batch size,
+                # which breaks when shard_map hands each device 1/N of
+                # the batch.
+                shape[0] = None
+            specs.append(tf.TensorSpec(shape, tf.as_dtype(
+                np.asarray(a).dtype if not tf.is_tensor(a) else a.dtype)))
+        cf = fn.get_concrete_function(*specs)
+    else:
+        raise ValueError("pass example_inputs or input_signature")
+
+    params, buffers, capture_values = {}, {}, {}
+    by_handle = {}
+    for v in cf.variables:
+        if v.name in by_handle.values():
+            raise ValueError(f"duplicate variable name {v.name}")
+        by_handle[id(v.handle)] = v.name
+        target = params if v.trainable else buffers
+        target[v.name] = _jnp().asarray(_np_narrow(v.numpy()))
+    for ext, internal in cf.graph.captures:
+        if ext.dtype == tf.resource:
+            name = by_handle.get(id(ext))
+            if name is None:
+                raise NotImplementedError(
+                    f"captured resource {internal.name} is not a model "
+                    "variable (tables/iterators are out of scope)")
+            capture_values[internal.name] = _Var(name)
+        else:
+            capture_values[internal.name] = _jnp().asarray(
+                _np_narrow(ext.numpy()))
+
+    fdefs = {f.signature.name: f
+             for f in cf.graph.as_graph_def().library.function}
+    return CompiledFunction(cf, params, buffers, capture_values, fdefs)
+
+
+def def_function_type():
+    import tensorflow as tf
+    return type(tf.function(lambda: None))

@@ -34,7 +34,12 @@ def main():
         keras.layers.Dense(1),
     ])
     opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05))
-    model.compile(optimizer=opt, loss="mse")
+    # jax backend over the host (TCP) plane: the jitted train step cannot
+    # reach the eager collective — the wrapper raises with guidance, and
+    # run_eagerly is the supported per-process mode (the compiled path is
+    # set_data_parallel on the global mesh, tested in test_keras_jax.py).
+    jax_eager = keras.backend.backend() == "jax"
+    model.compile(optimizer=opt, loss="mse", run_eagerly=jax_eager)
 
     rng = np.random.RandomState(4321)
     w_true = rng.randn(8, 1).astype(np.float32)
@@ -97,6 +102,8 @@ def main():
         assert getattr(loaded.optimizer, "_hvd_wrapped", False)
         assert hvd.DistributedOptimizer(loaded.optimizer) \
             is loaded.optimizer
+        if jax_eager:
+            loaded.run_eagerly = True
         loaded.fit(X[:32], y[:32], batch_size=16, epochs=1, verbose=0)
 
     print(f"rank {r}/{n}: KERAS-BINDING OK (backend="

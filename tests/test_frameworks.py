@@ -40,6 +40,15 @@ def test_keras_binding_tensorflow_backend():
     assert all("KERAS-BINDING OK" in o for o in outs)
 
 
+def test_keras_binding_jax_backend():
+    """jax backend over the host plane: run_eagerly per-process sync
+    (the compiled on-mesh path is covered in-process by
+    test_keras_jax.py)."""
+    pytest.importorskip("keras")
+    outs = _run("keras_worker.py", {"KERAS_BACKEND": "jax"})
+    assert all("KERAS-BINDING OK" in o for o in outs)
+
+
 def test_torch_binding():
     pytest.importorskip("torch")
     outs = _run("torch_worker.py")

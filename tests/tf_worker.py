@@ -99,6 +99,32 @@ def main():
     for w in all_w2[1:]:
         np.testing.assert_allclose(w, all_w2[0], rtol=1e-5)
 
+    # -- grouped + locally-aggregated optimizer under tf.function ---------
+    # num_groups buckets the fused allreduce; backward_passes_per_step=2
+    # syncs+applies only every 2nd call (graph-state counter — exact
+    # inside tf.function). Oracle: the update lands with the cross-rank
+    # mean of the micro-batch average.
+    Wg = tf.Variable(np.zeros((2, 1), np.float32))
+    bg = tf.Variable(np.zeros((1,), np.float32))
+    opt_g = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(1.0), backward_passes_per_step=2,
+        num_groups=2)
+
+    @tf.function
+    def agg_step(scale):
+        g_w = tf.fill((2, 1), scale)
+        g_b = tf.fill((1,), scale * 2.0)
+        opt_g.apply_gradients([(g_w, Wg), (g_b, bg)])
+
+    agg_step(tf.constant(float(r + 1)))
+    np.testing.assert_allclose(Wg.numpy(), 0.0)  # skip call: no update
+    agg_step(tf.constant(float(r + 3)))
+    # micro-avg per rank = (r+1 + r+3)/2 = r+2; cross-rank mean over
+    # ranks 0..n-1 = (n+3)/2; SGD lr 1.0 -> W = -that.
+    expect = -(sum(rr + 2 for rr in range(n)) / n)
+    np.testing.assert_allclose(Wg.numpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(bg.numpy(), 2 * expect, rtol=1e-5)
+
     # -- SyncBatchNormalization: global-batch stats + synced backward ------
     from horovod_tpu.tensorflow.sync_batch_norm import \
         SyncBatchNormalization
