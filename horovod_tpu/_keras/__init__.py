@@ -154,22 +154,25 @@ def create_distributed_optimizer(keras, optimizer, name=None,
                 result[i] = o
             return result
         if backend == "jax" and _any_jax_tracer(grads):
-            # Jitted train step in multi-process SPMD mode. With a keras
-            # distribution over the jax.distributed global mesh the step
-            # compiles as one global-SPMD program and the partitioner
-            # already reduces the gradients of replicated variables —
-            # nothing to do. Without one, the host-plane collective cannot
-            # run under trace: fail with guidance instead of silently
-            # skipping the sync.
-            if keras.distribution.distribution() is not None:
+            # Jitted train step in multi-process SPMD mode. Only when the
+            # processes share the jax.distributed global mesh does a keras
+            # distribution make the step one global-SPMD program whose
+            # partitioner already reduces the gradients — identity then.
+            # A distribution over process-LOCAL devices on the TCP plane
+            # would be a silent no-sync (each process training alone), so
+            # it does NOT earn the identity: fail with guidance instead.
+            rt = basics.runtime()
+            if (keras.distribution.distribution() is not None
+                    and getattr(rt.backend, "global_mesh_spmd", False)):
                 return grads
             raise RuntimeError(
                 "DistributedOptimizer cannot sync gradients inside a "
                 "jit-compiled keras train step over the host (TCP) data "
-                "plane. Either activate the compiled path with "
-                "horovod_tpu.keras.set_data_parallel() (jax backend, "
-                "collectives lower into the XLA program), or compile the "
-                "model with run_eagerly=True.")
+                "plane. Either run the job on the jax.distributed global "
+                "mesh (HVDTPU_CPU_OPERATIONS=xla) with "
+                "horovod_tpu.keras.set_data_parallel() — collectives "
+                "then lower into the XLA program — or compile the model "
+                "with run_eagerly=True.")
         np_grads = [None if g is None
                     else np.asarray(keras.ops.convert_to_numpy(g))
                     for g in grads]

@@ -46,6 +46,40 @@ def _scale(x, factor):
     return x * jnp.asarray(factor).astype(x.dtype)
 
 
+def _pprod(x, n):
+    """Cross-replica product via ppermute: O(block) device memory (the
+    gather-then-prod alternative holds n blocks).
+
+    Binomial-tree reduce to rank 0 (log2 n rounds, one fixed association)
+    then broadcast rank 0's result — every rank returns BITWISE-identical
+    values, preserving the allreduce contract that all stacked slices are
+    equal. A rotation-order ring would multiply in a different
+    association per rank and drift at the ulp level.
+    """
+    idx = lax.axis_index(AXIS)
+    acc = x
+    shift = 1
+    while shift < n:
+        recv = lax.ppermute(acc, AXIS,
+                            [(i, (i - shift) % n) for i in range(n)])
+        take = (idx % (2 * shift) == 0) & (idx + shift < n)
+        acc = jnp.where(take, acc * recv, acc)
+        shift *= 2
+    return _psum_broadcast(acc, 0)
+
+
+def _psum_broadcast(x, root_rank):
+    """One-to-all broadcast as a masked psum: every non-root contributes
+    zeros, so per-device memory stays O(block) — no all_gather
+    materializing n blocks. Bool rides as int32."""
+    is_bool = x.dtype == jnp.bool_
+    v = x.astype(jnp.int32) if is_bool else x
+    idx = lax.axis_index(AXIS)
+    picked = jnp.where(idx == root_rank, v, jnp.zeros_like(v))
+    out = lax.psum(picked, AXIS)
+    return out.astype(jnp.bool_) if is_bool else out
+
+
 class XlaSingleBackend(Backend):
     name = "xla"
 
@@ -119,8 +153,11 @@ class XlaSingleBackend(Backend):
                     elif op == reduce_ops.Max:
                         y = lax.pmax(x, AXIS)
                     elif op == reduce_ops.Product:
-                        g = lax.all_gather(x, AXIS, axis=0, tiled=False)
-                        y = jnp.prod(g, axis=0)
+                        # ppermute-based product: O(block) memory per
+                        # device vs the O(n*block) of gather-then-prod.
+                        # Recursive doubling (log2 n steps) when n is a
+                        # power of two, ring (n-1 steps) otherwise.
+                        y = _pprod(x, n)
                     else:
                         raise ValueError(
                             f"Unsupported op {reduce_ops.op_name(op)}")
@@ -182,12 +219,19 @@ class XlaSingleBackend(Backend):
         """
         mesh = self._mesh(process_set)
         n = mesh.devices.size
+        sharding = NamedSharding(mesh, P(AXIS))
         outs = []
         for parts in per_rank_lists:
-            full = jnp.concatenate([jnp.asarray(p) for p in parts], axis=0)
-            stacked = jnp.broadcast_to(full[None], (n,) + full.shape)
-            outs.append(jax.device_put(
-                stacked, NamedSharding(mesh, P(AXIS))))
+            full = np.concatenate([np.asarray(p) for p in parts], axis=0)
+            block = full[None]
+            # Build the stacked (n, total, ...) result shard-by-shard:
+            # each device receives its (1, total, ...) block directly —
+            # never materializing the n-fold (n, total, ...) copy that
+            # broadcast_to would allocate before sharding.
+            # Every stacked slice is identical, so each device's
+            # (1, total, ...) shard IS the block, whatever its index.
+            outs.append(jax.make_array_from_callback(
+                (n,) + full.shape, sharding, lambda idx, b=block: b))
         return outs
 
     # -- broadcast ---------------------------------------------------------
@@ -199,13 +243,10 @@ class XlaSingleBackend(Backend):
 
         def build():
             def body(*xs):
-                outs = []
-                for x in xs:
-                    # Select root's block on every rank: gather then index is
-                    # lowered by XLA to a one-to-all ICI broadcast.
-                    g = lax.all_gather(x, AXIS, axis=0, tiled=True)
-                    outs.append(g[root_rank][None])
-                return tuple(outs)
+                # Masked psum instead of gather-then-index: O(block)
+                # device memory at any mesh size (the gather holds n
+                # blocks per device before indexing one).
+                return tuple(_psum_broadcast(x, root_rank) for x in xs)
             sm = jax.shard_map(body, mesh=mesh, in_specs=P(AXIS),
                                out_specs=P(AXIS))
             return jax.jit(sm)

@@ -163,6 +163,10 @@ class XlaGlobalBackend(TcpBackend):
 
     name = "xla-global"
     delegate_data_ops = True
+    # Processes share one jax.distributed global mesh: jitted programs are
+    # global-SPMD, so in-jit sharding-propagated reductions span every
+    # rank (keras binding keys its trace-time identity-sync off this).
+    global_mesh_spmd = True
 
     def __init__(self, topology):
         # Must run before the first jax backend touch in this process.

@@ -67,6 +67,14 @@ def set_data_parallel(devices=None, auto_shard_dataset=True):
             f"{keras.backend.backend()!r}. On other backends use "
             "DistributedOptimizer's per-process sync under hvdrun.")
     rt = basics.runtime()
+    if rt.mode == basics.MODE_SPMD and rt.topology.size > 1 and \
+            not getattr(rt.backend, "global_mesh_spmd", False):
+        raise RuntimeError(
+            "set_data_parallel in multi-process mode requires the "
+            "jax.distributed global mesh (HVDTPU_CPU_OPERATIONS=xla): "
+            "over the host (TCP) plane each process only sees its local "
+            "devices, so a DataParallel there would train each rank "
+            "alone. Use run_eagerly=True for per-process sync instead.")
     if devices is None:
         if rt.mode == basics.MODE_SPMD:
             import jax

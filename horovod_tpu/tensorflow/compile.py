@@ -239,11 +239,17 @@ def _einsum_handler(op, args):
     return _jnp().einsum(eq, *args)
 
 
-def _matmul(a, b, transpose_a=False, transpose_b=False):
+def _matmul(a, b, transpose_a=False, transpose_b=False, adjoint=False):
+    """MatMul transpose_a/b is a plain transpose; BatchMatMul adj_x/y is
+    the adjoint — conjugate-transpose for complex inputs."""
     jnp = _jnp()
     if transpose_a:
+        if adjoint and jnp.iscomplexobj(a):
+            a = a.conj()
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
+        if adjoint and jnp.iscomplexobj(b):
+            b = b.conj()
         b = jnp.swapaxes(b, -1, -2)
     return jnp.matmul(a, b)
 
@@ -525,6 +531,15 @@ class _GraphInterpreter:
             ax = int(np.asarray(args[2]))
             batch_dims = int(opr.get_attr("batch_dims"))
             if batch_dims:
+                # take_along_axis matches tf.gather batch semantics only
+                # when indices rank == params rank; other batched shapes
+                # would mis-broadcast silently.
+                if np.ndim(args[1]) != np.ndim(args[0]):
+                    raise NotImplementedError(
+                        f"GatherV2 (node {opr.name}) with batch_dims="
+                        f"{batch_dims} and indices rank "
+                        f"{np.ndim(args[1])} != params rank "
+                        f"{np.ndim(args[0])} has no jax mapping")
                 return jnp.take_along_axis(args[0], args[1], axis=ax)
             return jnp.take(args[0], args[1], axis=ax)
         if t == "Pad":
@@ -559,7 +574,8 @@ class _GraphInterpreter:
                            opr.get_attr("transpose_b"))
         if t in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
             return _matmul(args[0], args[1],
-                           opr.get_attr("adj_x"), opr.get_attr("adj_y"))
+                           opr.get_attr("adj_x"), opr.get_attr("adj_y"),
+                           adjoint=True)
         if t == "Einsum":
             return _einsum_handler(opr, args)
         if t == "BiasAdd":
