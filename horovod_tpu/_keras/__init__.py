@@ -201,15 +201,13 @@ def create_distributed_optimizer(keras, optimizer, name=None,
     class _Distributed(cls):
         _hvd_wrapped = True
 
+        # Only apply() is overridden: keras-3 BaseOptimizer routes every
+        # entry point (apply_gradients, stateless_apply, the trainers)
+        # through self.apply, so preparing there too would sync/prescale
+        # each gradient twice.
         def apply(self, grads, trainable_variables=None, **kwargs):
             grads = _prepare(grads)
             return cls.apply(self, grads, trainable_variables, **kwargs)
-
-        def apply_gradients(self, grads_and_vars, **kwargs):
-            gv = list(grads_and_vars)
-            grads = _prepare([g for g, _ in gv])
-            return cls.apply_gradients(
-                self, list(zip(grads, [v for _, v in gv])), **kwargs)
 
     # Serialization round-trip: keras saves the optimizer under its class
     # name. Naming the wrapper after the wrapped class makes saved

@@ -50,7 +50,8 @@ def _pprod(x, n):
     """Cross-replica product via ppermute: O(block) device memory (the
     gather-then-prod alternative holds n blocks).
 
-    Binomial-tree reduce to rank 0 (log2 n rounds, one fixed association)
+    Binomial-tree reduce to rank 0 (ceil(log2 n) rounds for ANY n — the
+    idx+shift<n mask handles partial partners; one fixed association)
     then broadcast rank 0's result — every rank returns BITWISE-identical
     values, preserving the allreduce contract that all stacked slices are
     equal. A rotation-order ring would multiply in a different
@@ -154,9 +155,9 @@ class XlaSingleBackend(Backend):
                         y = lax.pmax(x, AXIS)
                     elif op == reduce_ops.Product:
                         # ppermute-based product: O(block) memory per
-                        # device vs the O(n*block) of gather-then-prod.
-                        # Recursive doubling (log2 n steps) when n is a
-                        # power of two, ring (n-1 steps) otherwise.
+                        # device vs the O(n*block) of gather-then-prod;
+                        # binomial tree + broadcast, ~2*ceil(log2 n)
+                        # rounds for any n.
                         y = _pprod(x, n)
                     else:
                         raise ValueError(

@@ -38,12 +38,7 @@ from ..process_sets import global_process_set
 HVD_AXIS = "hvd"
 
 
-def _pvary(x, axis_name):
-    """Mark a replicated value as device-varying along axis_name."""
-    try:
-        return lax.pcast(x, axis_name, to="varying")
-    except (AttributeError, TypeError):
-        return lax.pvary(x, axis_name)
+from ..utils.jax_compat import pvary as _pvary  # noqa: E402
 
 
 def _reduce_in_axis(grads, op, axis_name, prescale=None, postscale=None):
@@ -249,7 +244,17 @@ def make_train_step(loss_fn, dist_opt, mesh=None, axis_name=HVD_AXIS,
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:
-        mesh = basics.runtime().mesh
+        rt = basics.runtime()
+        if rt.mode == basics.MODE_SPMD and rt.topology.size > 1:
+            # Multi-process job without an explicit mesh: rt.mesh holds
+            # ONE local device, so a shard_map pmean over it would be an
+            # identity and every rank would silently train alone. Use the
+            # per-process plan instead: jitted local compute, gradients
+            # reduced eagerly through the process-level data plane (the
+            # reference's execution model).
+            return _make_hostplane_train_step(loss_fn, dist_opt,
+                                              has_aux=has_aux)
+        mesh = rt.mesh
     if dist_opt.axis_name is None:
         # Clone rather than mutate: the caller's optimizer object keeps its
         # eager behavior outside this train step.
@@ -305,6 +310,57 @@ def make_train_step(loss_fn, dist_opt, mesh=None, axis_name=HVD_AXIS,
     return jax.jit(sharded, donate_argnums=donate_argnums)
 
 
+def _make_hostplane_train_step(loss_fn, dist_opt, has_aux=False):
+    """Per-process SPMD train step: jitted local compute, eager
+    cross-process gradient reduction.
+
+    This is the reference's execution model (framework computes the
+    backward pass, horovod allreduces the gradients, the optimizer
+    applies — reference: horovod/torch/optimizer.py:175-253) realized on
+    the process-level data plane (TCP fallback or the xla-global mesh):
+    ``jax.value_and_grad(loss_fn)`` is jit-compiled per process, the
+    gradient tree rides DistributedOptimizer's eager grouped-allreduce
+    (including its comm-sparing backward_passes_per_step aggregation),
+    and the optax update applies the reduced gradients. Loss and aux
+    state (batch stats) are averaged across ranks like the shard_map
+    path pmeans them."""
+    import jax as _jax
+    import optax
+
+    if dist_opt.axis_name is not None:
+        raise ValueError(
+            "DistributedOptimizer was built for in-jit axis "
+            f"{dist_opt.axis_name!r}; the multi-process host-plane step "
+            "reduces eagerly — pass axis_name=None (or supply an "
+            "explicit global mesh to make_train_step)")
+    grad_fn = _jax.jit(_jax.value_and_grad(loss_fn, has_aux=has_aux))
+
+    def _mean_tree(tree):
+        from ..ops.collectives import grouped_allreduce
+        leaves, treedef = _jax.tree.flatten(tree)
+        if not leaves:
+            return tree
+        return _jax.tree.unflatten(
+            treedef, grouped_allreduce(leaves, op=reduce_ops.Average,
+                                       name="hostplane_mean"))
+
+    if has_aux:
+        def step(params, aux, opt_state, batch):
+            (loss, new_aux), grads = grad_fn(params, aux, batch)
+            updates, new_opt = dist_opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_aux = _mean_tree(new_aux)
+            return new_params, new_aux, new_opt, _mean_tree(loss)
+        return step
+
+    def step(params, opt_state, batch):
+        loss, grads = grad_fn(params, batch)
+        updates, new_opt = dist_opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, _mean_tree(loss)
+    return step
+
+
 def make_zero_train_step(loss_fn, dist_opt, mesh=None,
                          axis_name=HVD_AXIS, donate=True):
     """ZeRO-1 variant of :func:`make_train_step`: optimizer state lives
@@ -329,7 +385,15 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:
-        mesh = basics.runtime().mesh
+        rt = basics.runtime()
+        if rt.mode == basics.MODE_SPMD and rt.topology.size > 1:
+            raise RuntimeError(
+                "make_zero_train_step has no per-process host-plane "
+                "variant: without an explicit global mesh the default "
+                "mesh holds one local device and ranks would not sync. "
+                "Use make_train_step (host-plane capable) or pass a "
+                "jax.distributed global mesh.")
+        mesh = rt.mesh
     if dist_opt.axis_name not in (None, axis_name):
         raise ValueError(
             f"DistributedOptimizer was built for axis "

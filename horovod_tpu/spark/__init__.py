@@ -71,9 +71,85 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=120,
     return [r for _, r in sorted(pairs)]
 
 
-__all__ = ["run", "ClusterJob", "cluster_task_bootstrap", "Store",
-           "LocalStore", "KerasEstimator", "KerasModel", "fit_on_parquet",
-           "TorchEstimator", "TorchModel", "fit_on_parquet_torch"]
+def _elastic_loop(run_stage, parallelism, num_proc=None, min_np=None,
+                  max_np=None, stage_retries=3, log=None):
+    """Between-stage elasticity engine (pyspark-free, unit-testable).
+
+    ``run_stage(n)`` launches one barrier stage at n workers and returns
+    its results; ``parallelism()`` reports the cluster's CURRENT
+    capacity. A failed stage is relaunched at the new capacity, bounded
+    to [min_np, max_np]; capacity below min_np aborts. This is the Spark
+    mapping of the reference's elastic driver loop (reference:
+    horovod/spark/runner.py:309 run_elastic): Spark's barrier stage pins
+    the worker set, so membership changes happen at stage boundaries —
+    Spark's dynamic allocation supplies the new workers, the relaunch
+    supplies the re-rendezvous.
+    """
+    attempts = 0
+    while True:
+        avail = parallelism()
+        n = min(x for x in (num_proc, max_np, avail) if x is not None)
+        if min_np is not None and n < min_np:
+            raise RuntimeError(
+                f"cluster parallelism {avail} fell below min_np="
+                f"{min_np}; aborting elastic job")
+        try:
+            return run_stage(n)
+        except Exception as e:  # noqa: BLE001 — stage failure is the signal
+            attempts += 1
+            if attempts > stage_retries:
+                raise
+            if log is not None:
+                log.warning(
+                    "spark elastic: stage failed (%s); relaunching "
+                    "(attempt %d/%d)", e, attempts, stage_retries)
+
+
+def run_elastic(fn, args=(), kwargs=None, num_proc=None, min_np=None,
+                max_np=None, start_timeout=120, extra_env=None,
+                stage_retries=3, verbose=True):
+    """Elastic analog of :func:`run` (reference:
+    horovod/spark/runner.py:309 ``run_elastic``).
+
+    Spark's execution model pins a barrier stage's worker set, so
+    elasticity maps to two layers:
+
+    1. **In-stage**: wrap your training loop with
+       ``horovod_tpu.elastic.run`` (State commit/restore) exactly as in a
+       non-Spark elastic job — worker-side transient failures restore
+       from the last commit without losing the stage.
+    2. **Between stages** (this function): a failed stage is relaunched
+       at the cluster's *current* parallelism, bounded to
+       [min_np, max_np] — lost executors shrink the next attempt, Spark
+       dynamic allocation can grow it back.
+
+    ``fn`` runs under the same contract as :func:`run`.
+    """
+    _pyspark()
+    from pyspark import SparkContext
+
+    log = None
+    if verbose:
+        from ..utils.logging_util import get_logger
+        log = get_logger()
+
+    def parallelism():
+        return SparkContext.getOrCreate().defaultParallelism
+
+    def run_stage(n):
+        return run(fn, args=args, kwargs=kwargs, num_proc=n,
+                   start_timeout=start_timeout, extra_env=extra_env,
+                   verbose=verbose)
+
+    return _elastic_loop(run_stage, parallelism, num_proc=num_proc,
+                         min_np=min_np, max_np=max_np,
+                         stage_retries=stage_retries, log=log)
+
+
+__all__ = ["run", "run_elastic", "ClusterJob", "cluster_task_bootstrap",
+           "Store", "LocalStore", "KerasEstimator", "KerasModel",
+           "fit_on_parquet", "TorchEstimator", "TorchModel",
+           "fit_on_parquet_torch"]
 
 
 def __getattr__(name):

@@ -1,0 +1,17 @@
+"""JAX API compatibility shims shared across modules."""
+
+from jax import lax
+
+
+def pvary(x, axis_name):
+    """Mark a value device-varying along ``axis_name`` (no-op if it
+    already is). Papers over the lax.pcast / lax.pvary API transition."""
+    try:
+        return lax.pcast(x, axis_name, to="varying")
+    except ValueError:
+        return x  # already device-varying along axis_name
+    except (AttributeError, TypeError):
+        try:
+            return lax.pvary(x, axis_name)
+        except ValueError:
+            return x

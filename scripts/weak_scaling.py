@@ -1,0 +1,102 @@
+"""Weak-scaling sanity for the SPMD data plane on the virtual CPU mesh.
+
+Runs the flagship DP train step (make_train_step: shard_map + pmean over
+'hvd') at n = 1, 2, 4, 8 devices with a FIXED per-device batch.
+
+The virtual devices SHARE one machine's cores, so per-device throughput
+must fall ~1/n by construction — that is not the signal. What the run
+does measure: TOTAL samples/s across the mesh, which on fixed silicon
+stays flat exactly when the SPMD plane (sharding, pmean collectives,
+partitioned scheduling) adds no overhead as the mesh grows. The summary
+ratio total(n_max)/total(1) is therefore a direct upper bound on the
+plane's own overhead at 8-way partitioning; real-chip scaling adds only
+the ICI collective time modeled in docs/PERF.md.
+
+Usage:
+    python scripts/weak_scaling.py [--per-device-batch 8] [--steps 6]
+
+Prints one JSON line per n and a summary line with the min/max ratio.
+(Used by docs/PERF.md's scaling section; also run by
+tests/test_weak_scaling.py with a loose CPU-noise tolerance.)
+"""
+
+import argparse
+import json
+import os
+import sys
+import timeit
+
+
+def run(per_device_batch=8, steps=6, sizes=(1, 2, 4, 8)):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count="
+                               + str(max(sizes)))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu.models import TransformerLM, TransformerConfig
+    from horovod_tpu.ops import reduce_ops
+    from horovod_tpu.ops.compression import Compression
+    from horovod_tpu.process_sets import global_process_set
+
+    cfg = TransformerConfig(vocab_size=512, hidden=128, layers=2, heads=4,
+                            max_len=64, causal=True, use_rope=True,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((1, 64), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    rng = np.random.RandomState(0)
+    results = []
+    for n in sizes:
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:n]), ("hvd",))
+        opt = hvd_jax.DistributedOptimizer(
+            optax.adam(1e-3), axis_name="hvd",
+            compression=Compression.none,
+            process_set=global_process_set, op=reduce_ops.Average)
+        step = hvd_jax.make_train_step(loss_fn, opt, mesh=mesh,
+                                       axis_name="hvd", donate=False)
+        opt_state = opt.init(params)
+        batch = (jnp.asarray(rng.randint(
+                     0, 512, size=(n * per_device_batch, 64))),
+                 jnp.asarray(rng.randint(
+                     0, 512, size=(n * per_device_batch, 64))))
+
+        def one(p=params, o=opt_state, b=batch, s=step):
+            _, _, loss = s(p, o, b)
+            jax.block_until_ready(loss)
+
+        one()  # compile
+        t = timeit.timeit(one, number=steps)
+        total = n * per_device_batch * steps / t
+        results.append({"n": n,
+                        "total_samples_per_sec": round(total, 2),
+                        "samples_per_sec_per_device":
+                            round(total / n, 2)})
+        print(json.dumps(results[-1]), flush=True)
+
+    vals = [r["total_samples_per_sec"] for r in results]
+    summary = {"spmd_plane_total_throughput_ratio":
+               round(vals[-1] / vals[0], 3)}
+    print(json.dumps(summary), flush=True)
+    return results, summary
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--per-device-batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=6)
+    args = p.parse_args()
+    run(args.per_device_batch, args.steps)

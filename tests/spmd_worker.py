@@ -235,6 +235,30 @@ def main():
         assert not ps.included()
     hvd.remove_process_set(ps)
 
+    # -- make_train_step host-plane dispatch (r4 regression) ----------------
+    # Without an explicit mesh in multi-process mode, the step must reduce
+    # gradients ACROSS PROCESSES (jitted local grad + eager allreduce), not
+    # pmean over the 1-device local mesh. Oracle: ranks start identical,
+    # train on divergent data, end identical with the cross-rank mean grad.
+    import optax
+    import horovod_tpu.jax as hvd_jax
+
+    w0 = {"w": jnp.ones((3,), jnp.float32)}
+    tsopt = hvd_jax.DistributedOptimizer(optax.sgd(1.0))
+
+    def ts_loss(p, b):
+        return jnp.sum(p["w"] * b)
+
+    ts_step = hvd_jax.make_train_step(ts_loss, tsopt)
+    bvec = jnp.full((3,), float(rank + 1), jnp.float32)
+    new_p, _, ts_l = ts_step(w0, tsopt.init(w0), bvec)
+    # grad = b per rank; mean over ranks = (n+1)/2; w = 1 - mean
+    mean_b = sum(r + 1 for r in range(size)) / size
+    np.testing.assert_allclose(np.asarray(new_p["w"]), 1.0 - mean_b,
+                               rtol=1e-5)
+    # loss averaged across ranks like the shard_map path's pmean
+    np.testing.assert_allclose(float(ts_l), 3.0 * mean_b, rtol=1e-5)
+
     # -- join with unequal work ---------------------------------------------
     if rank % 2 == 1:
         last = hvd.join()

@@ -142,6 +142,22 @@ def test_backward_passes_per_step_unaveraged(hvd_init):
         np.testing.assert_allclose(d_sum, k * d_avg, rtol=2e-4, atol=2e-5)
 
 
+def test_apply_gradients_entry_point_not_double_prepared(hvd_init):
+    """keras BaseOptimizer.apply_gradients delegates to self.apply — the
+    wrapper must prepare only once on that path (the custom-training-loop
+    idiom). Regression for the k^2 prescale bug."""
+    k = 2
+    v = keras.Variable(np.zeros((), np.float32))
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                   backward_passes_per_step=k,
+                                   average_aggregated_gradients=False)
+    opt.build([v])
+    for _ in range(k):
+        opt.apply_gradients([(keras.ops.ones(()), v)])
+    # unaveraged sum of k unit grads with lr 1.0 -> v = -k (not -k^2)
+    np.testing.assert_allclose(np.asarray(v.value), -float(k), rtol=1e-6)
+
+
 def test_backward_passes_validation(hvd_init):
     with pytest.raises(ValueError, match="Adasum"):
         hvd.DistributedOptimizer(keras.optimizers.SGD(0.01),

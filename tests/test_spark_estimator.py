@@ -19,6 +19,62 @@ from horovod_tpu.spark.store import LocalStore, Store
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+def test_elastic_loop_relaunches_at_current_capacity():
+    """Between-stage elasticity (reference: horovod/spark/runner.py:309
+    run_elastic): a failed stage relaunches at the cluster's CURRENT
+    parallelism bounded to [min_np, max_np]; capacity below min_np
+    aborts; retries are capped."""
+    from horovod_tpu.spark import _elastic_loop
+
+    calls = []
+    capacity = iter([8, 5, 4])
+
+    def run_stage(n):
+        calls.append(n)
+        if len(calls) < 3:
+            raise RuntimeError("executor lost")
+        return [f"ok@{n}"]
+
+    out = _elastic_loop(run_stage, lambda: next(capacity),
+                        max_np=6, min_np=3, stage_retries=3)
+    # 8 capped to max_np=6; shrink follows capacity; success at 4.
+    assert calls == [6, 5, 4]
+    assert out == ["ok@4"]
+
+
+def test_elastic_loop_aborts_below_min_np():
+    from horovod_tpu.spark import _elastic_loop
+
+    def run_stage(n):
+        raise RuntimeError("boom")
+
+    capacity = iter([4, 2])
+    with pytest.raises(RuntimeError, match="min_np"):
+        _elastic_loop(run_stage, lambda: next(capacity),
+                      min_np=3, stage_retries=5)
+
+
+def test_elastic_loop_retry_cap():
+    from horovod_tpu.spark import _elastic_loop
+
+    def run_stage(n):
+        raise RuntimeError("persistent failure")
+
+    with pytest.raises(RuntimeError, match="persistent"):
+        _elastic_loop(run_stage, lambda: 4, stage_retries=2)
+
+
+def test_run_elastic_gates_without_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        pytest.skip("pyspark installed; gate not applicable")
+    except ImportError:
+        pass
+    import horovod_tpu.spark as hvd_spark
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run_elastic(lambda: None, num_proc=2)
+
+
 def test_store_layout(tmp_path):
     store = Store.create(str(tmp_path))
     assert store.get_train_data_path().endswith("intermediate_train_data")
