@@ -3,12 +3,21 @@ examples/tensorflow2/tensorflow2_mnist.py — same structure, synthetic
 MNIST-shaped data since this environment has no dataset egress).
 
 Run:  hvdrun -np 2 python examples/tensorflow2_mnist.py
+On-chip (model math compiled to one XLA program via the graph→JAX
+bridge, docs/tf_on_tpu.md):
+      python examples/tensorflow2_mnist.py --engine tpu
 """
+
+import argparse
+import os
+import sys
 
 import numpy as np
 import tensorflow as tf
 
-import horovod_tpu.tensorflow as hvd
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
 
 
 def synthetic_mnist(rank, samples=512):
@@ -19,6 +28,12 @@ def synthetic_mnist(rank, samples=512):
 
 
 def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--engine", choices=["tf", "tpu"], default="tf",
+                   help="tf: eager TF step + host-plane collectives; "
+                        "tpu: model math compiled on the chip via "
+                        "hvd.tpu_compile")
+    args = p.parse_args()
     hvd.init()
 
     x, y = synthetic_mnist(hvd.rank())
@@ -33,6 +48,28 @@ def main():
         tf.keras.layers.Dense(10),
     ])
     loss_fn = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    if args.engine == "tpu":
+        import optax
+        model.build((None, 28, 28, 1))
+        hvd.broadcast_variables(model.variables, root_rank=0)
+
+        def tf_loss(images, labels):
+            return loss_fn(labels, model(images, training=True))
+
+        compiled = hvd.tpu_compile(tf_loss,
+                                   example_inputs=(x[:64], y[:64]))
+        step_fn = compiled.make_train_step(
+            optax.adam(0.001 * hvd.size()))
+        for step, (images, labels) in enumerate(dataset.take(100)):
+            loss = float(step_fn((images.numpy(), labels.numpy())))
+            if step % 20 == 0 and hvd.rank() == 0:
+                print(f"step {step}: loss={loss:.4f}")
+        compiled.copy_params_to_variables()
+        if hvd.rank() == 0:
+            print("done")
+        return
+
     # Scale LR by world size (reference pattern).
     opt = tf.optimizers.Adam(0.001 * hvd.size())
 

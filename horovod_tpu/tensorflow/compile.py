@@ -886,16 +886,23 @@ def tpu_compile(fn, example_inputs=None, input_signature=None,
         raise ValueError("pass example_inputs or input_signature")
 
     params, buffers, capture_values = {}, {}, {}
-    by_handle = {}
+    seen_names = set()
+    # Hold (handle, variable) pairs simultaneously: matching must be by
+    # object identity against the graph's captured external tensor, and
+    # an id()-keyed dict without live references can alias a GC'd
+    # temporary's id onto another variable — silently swapping
+    # same-shaped variables (e.g. BN moving mean/variance).
+    handles = []
     for v in cf.variables:
-        if v.name in by_handle.values():
+        if v.name in seen_names:
             raise ValueError(f"duplicate variable name {v.name}")
-        by_handle[id(v.handle)] = v.name
+        seen_names.add(v.name)
+        handles.append((v.handle, v.name))
         target = params if v.trainable else buffers
         target[v.name] = _jnp().asarray(_np_narrow(v.numpy()))
     for ext, internal in cf.graph.captures:
         if ext.dtype == tf.resource:
-            name = by_handle.get(id(ext))
+            name = next((nm for h, nm in handles if h is ext), None)
             if name is None:
                 raise NotImplementedError(
                     f"captured resource {internal.name} is not a model "

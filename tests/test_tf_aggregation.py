@@ -128,7 +128,11 @@ def test_aggregation_model_fit_parity():
     import sys
     pytest.importorskip("keras")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, KERAS_BACKEND="tensorflow")
+    # JAX_PLATFORMS must be in the env BEFORE the interpreter starts:
+    # the axon sitecustomize reads it at startup and force-selects the
+    # real chip otherwise (an in-script setdefault is too late).
+    env = dict(os.environ, KERAS_BACKEND="tensorflow",
+               JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, "-c", _FIT_PARITY_SCRIPT.format(repo=repo)],
         capture_output=True, text=True, timeout=300, env=env)

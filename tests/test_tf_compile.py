@@ -105,59 +105,83 @@ def test_gradient_parity_with_tf():
                                    rtol=1e-3, atol=1e-5)
 
 
-def test_keras_model_with_bn_and_dropout():
-    """tf.keras model through the bridge: PartitionedCall recursion,
-    FusedBatchNormV3 (training stats + moving-average buffer writes),
-    stateless dropout driven by the jax PRNG."""
-    optax = pytest.importorskip("optax")
-    tf.random.set_seed(0)
-    model = tf.keras.Sequential([
-        tf.keras.layers.Input((16,)),
-        tf.keras.layers.Dense(32, activation="relu"),
-        tf.keras.layers.BatchNormalization(),
-        tf.keras.layers.Dropout(0.1),
-        tf.keras.layers.Dense(10),
-    ])
-    lossf = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+_KERAS_MODEL_SCRIPT = r"""
+import os, sys
+os.environ["KERAS_BACKEND"] = "tensorflow"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import tensorflow as tf
+import jax, optax
+jax.config.update("jax_platforms", "cpu")  # axon self-selects otherwise
+import horovod_tpu as hvd_core
+from horovod_tpu.tensorflow.compile import tpu_compile
+hvd_core.init()
 
-    def loss_fn(x, y):
-        return lossf(y, model(x, training=True))
+# BN/dropout keras model: PartitionedCall recursion, FusedBatchNormV3
+# buffer writes, PRNG-driven stateless dropout.
+tf.random.set_seed(0)
+model = tf.keras.Sequential([
+    tf.keras.layers.Input((16,)),
+    tf.keras.layers.Dense(32, activation="relu"),
+    tf.keras.layers.BatchNormalization(),
+    tf.keras.layers.Dropout(0.1),
+    tf.keras.layers.Dense(10),
+])
+lossf = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+def loss_fn(x, y):
+    return lossf(y, model(x, training=True))
+rng = np.random.RandomState(0)
+x = rng.rand(32, 16).astype(np.float32)
+y = rng.randint(0, 10, size=(32,)).astype(np.int64)
+compiled = tpu_compile(loss_fn, example_inputs=(x, y))
+step = compiled.make_train_step(optax.sgd(0.05))
+mmk = next(k for k in compiled.buffers if "moving_mean" in k)
+mm0 = np.array(compiled.buffers[mmk])
+losses = [float(step((x, y), rng=jax.random.PRNGKey(i))) for i in range(8)]
+assert losses[-1] < losses[0], losses
+assert not np.allclose(mm0, np.array(compiled.buffers[mmk])), "BN stale"
 
-    rng = np.random.RandomState(0)
-    x = rng.rand(32, 16).astype(np.float32)
-    y = rng.randint(0, 10, size=(32,)).astype(np.int64)
+# training=False parity: BN moving stats, dropout off — exact vs eager.
+tf.random.set_seed(1)
+model2 = tf.keras.Sequential([
+    tf.keras.layers.Input((16,)),
+    tf.keras.layers.Dense(32, activation="tanh"),
+    tf.keras.layers.BatchNormalization(),
+    tf.keras.layers.Dropout(0.5),
+    tf.keras.layers.Dense(4),
+])
+def fwd(x):
+    return model2(x, training=False)
+x2 = np.random.RandomState(3).rand(8, 16).astype(np.float32)
+compiled2 = tpu_compile(fwd, example_inputs=(x2,))
+np.testing.assert_allclose(np.asarray(compiled2(x2)),
+                           model2(tf.constant(x2)).numpy(),
+                           rtol=1e-4, atol=1e-5)
+print("KERAS-BRIDGE OK")
+"""
 
-    compiled = tpu_compile(loss_fn, example_inputs=(x, y))
-    step = compiled.make_train_step(optax.sgd(0.05))
-    mmk = next(k for k in compiled.buffers if "moving_mean" in k)
-    mm0 = np.array(compiled.buffers[mmk])
-    losses = [float(step((x, y), rng=jax.random.PRNGKey(i)))
-              for i in range(8)]
-    assert losses[-1] < losses[0], losses
-    assert not np.allclose(mm0, np.array(compiled.buffers[mmk])), \
-        "BN moving stats never updated"
 
-
-def test_keras_model_inference_parity():
-    """training=False path: BN uses moving stats, dropout off — exact
-    parity with TF eager."""
-    tf.random.set_seed(1)
-    model = tf.keras.Sequential([
-        tf.keras.layers.Input((16,)),
-        tf.keras.layers.Dense(32, activation="tanh"),
-        tf.keras.layers.BatchNormalization(),
-        tf.keras.layers.Dropout(0.5),
-        tf.keras.layers.Dense(4),
-    ])
-
-    def fwd(x):
-        return model(x, training=False)
-
-    x = np.random.RandomState(3).rand(8, 16).astype(np.float32)
-    compiled = tpu_compile(fwd, example_inputs=(x,))
-    np.testing.assert_allclose(np.asarray(compiled(x)),
-                               model(tf.constant(x)).numpy(),
-                               rtol=1e-4, atol=1e-5)
+def test_keras_model_bridge_subprocess():
+    """tf.keras models through the bridge (PartitionedCall, BN buffer
+    writes, dropout, inference parity). Runs in a subprocess with
+    KERAS_BACKEND=tensorflow: the keras backend binds at import, and
+    another test module in this process may have claimed jax — tf.keras
+    models can only trace under tf.function on the tensorflow backend."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # JAX_PLATFORMS must be in the env BEFORE the interpreter starts:
+    # the axon sitecustomize reads it at startup and force-selects the
+    # real chip otherwise (an in-script setdefault is too late).
+    env = dict(os.environ, KERAS_BACKEND="tensorflow",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _KERAS_MODEL_SCRIPT.format(repo=repo)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "KERAS-BRIDGE OK" in out.stdout
 
 
 def test_embedding_and_einsum():
