@@ -19,6 +19,9 @@ EXAMPLES = os.path.join(REPO, "examples")
 def _run_example(argv, timeout=420, np=2, extra_launch=()):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    # Another test module in this process may have claimed a keras
+    # backend (import-time, process-wide); examples pick their own.
+    env.pop("KERAS_BACKEND", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
@@ -51,6 +54,50 @@ def test_keras_mnist():
     pytest.importorskip("keras")
     out = _run_example(["keras_mnist.py"])
     assert "loss" in out.lower() or "done" in out.lower()
+
+
+def _run_single(argv, env_extra=None, timeout=420):
+    """Single-process run on the 8-device virtual mesh (the
+    single-controller on-chip paths: keras set_data_parallel,
+    tpu_compile engines)."""
+    env = dict(os.environ)
+    env.pop("KERAS_BACKEND", None)  # examples pick their own backend
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    proc = subprocess.run([sys.executable, *argv], env=env,
+                          capture_output=True, timeout=timeout,
+                          cwd=EXAMPLES)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out[-4000:]
+    return out
+
+
+def test_keras_mnist_compiled_on_mesh():
+    """jax backend, single controller: the example activates
+    set_data_parallel and model.fit math compiles onto the 8-device
+    mesh."""
+    pytest.importorskip("keras")
+    out = _run_single(["keras_mnist.py"], {"KERAS_BACKEND": "jax"})
+    assert "done" in out.lower()
+
+
+def test_tensorflow2_mnist_tpu_engine():
+    """graph→JAX engine: model math leaves TF and runs as one XLA
+    program."""
+    pytest.importorskip("tensorflow")
+    out = _run_single(["tensorflow2_mnist.py", "--engine", "tpu"])
+    assert "done" in out
+
+
+def test_tensorflow2_synthetic_tpu_engine_tiny():
+    pytest.importorskip("tensorflow")
+    out = _run_single(
+        ["tensorflow2_synthetic_benchmark.py", "--tiny", "--engine",
+         "tpu", "--num-iters", "1", "--num-batches-per-iter", "1",
+         "--num-warmup-batches", "1"])
+    assert "img/sec" in out
 
 
 def test_tensorflow2_synthetic_benchmark_tiny():

@@ -177,6 +177,9 @@ def _run_fit_workers(tmp_path, worker, size=2):
             "STORE_PREFIX": str(tmp_path),
         })
         env.pop("XLA_FLAGS", None)
+        # The pytest process may have claimed a keras backend (e.g.
+        # test_keras_jax pins jax); the workers' setdefault must win.
+        env.pop("KERAS_BACKEND", None)
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(HERE, worker)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))

@@ -47,6 +47,10 @@ def launch(size, script=WORKER, extra_env=None, timeout=180):
             "JAX_PLATFORMS": "cpu",
         })
         env.pop("XLA_FLAGS", None)
+        # The pytest process may have claimed a keras backend (e.g.
+        # test_keras_jax pins jax); workers must choose their own unless
+        # the test passes one explicitly.
+        env.pop("KERAS_BACKEND", None)
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, script], env=env,
