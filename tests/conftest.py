@@ -52,6 +52,7 @@ _SLOW_NAMES = {
     "test_resnet_remat_variants_run",
     "test_space_to_depth_stem_equivalent",
     "test_transformer_remat_variants_run",
+    "test_keras_applications_model_on_mesh",
 }
 
 
@@ -66,6 +67,20 @@ def pytest_collection_modifyitems(config, items):
         mod = getattr(item.module, "__name__", "")
         if mod in _SLOW_MODULES or item.name.split("[")[0] in _SLOW_NAMES:
             item.add_marker(pytest.mark.slow)
+
+
+def clean_spawn_env(**overrides):
+    """Base env for worker subprocesses: drop pytest-process state that
+    must not leak (XLA device-count flags; the keras backend another
+    test module may have claimed at import), pin the CPU platform, then
+    apply overrides. One helper so the next leaking variable is fixed
+    in one place."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("KERAS_BACKEND", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(overrides)
+    return env
 
 
 @pytest.fixture(scope="session")

@@ -17,13 +17,9 @@ EXAMPLES = os.path.join(REPO, "examples")
 
 
 def _run_example(argv, timeout=420, np=2, extra_launch=()):
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    # Another test module in this process may have claimed a keras
-    # backend (import-time, process-wide); examples pick their own.
-    env.pop("KERAS_BACKEND", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import clean_spawn_env
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            "-np", str(np), *extra_launch, sys.executable, *argv]
     proc = subprocess.run(cmd, env=env, capture_output=True,
@@ -60,11 +56,10 @@ def _run_single(argv, env_extra=None, timeout=420):
     """Single-process run on the 8-device virtual mesh (the
     single-controller on-chip paths: keras set_data_parallel,
     tpu_compile engines)."""
-    env = dict(os.environ)
-    env.pop("KERAS_BACKEND", None)  # examples pick their own backend
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import clean_spawn_env
+    env = clean_spawn_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     env.update(env_extra or {})
     proc = subprocess.run([sys.executable, *argv], env=env,
                           capture_output=True, timeout=timeout,

@@ -173,6 +173,25 @@ def test_backward_passes_validation(hvd_init):
         hvd.DistributedOptimizer(conflicted, backward_passes_per_step=2)
 
 
+def test_keras_applications_model_on_mesh(hvd_init, n_devices):
+    """A real keras.applications model family (MobileNetV3: depthwise
+    convs, hard-swish, BN, squeeze-excite) compiles and trains through
+    model.fit on the mesh — the 'switch your keras model, keep your
+    code' contract."""
+    hvd.set_data_parallel()
+    model = keras.applications.MobileNetV3Small(
+        input_shape=(32, 32, 3), weights=None, classes=10,
+        include_top=True)
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(keras.optimizers.SGD(0.01)),
+        loss=keras.losses.SparseCategoricalCrossentropy())
+    x = np.random.RandomState(0).rand(64, 32, 32, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, size=(64,))
+    hist = model.fit(x, y, batch_size=32, epochs=2, verbose=0)
+    assert all(np.isfinite(v) for v in hist.history["loss"])
+    assert len(model.weights[0].value.sharding.device_set) == n_devices
+
+
 def test_set_data_parallel_requires_jax_backend(hvd_init, monkeypatch):
     monkeypatch.setattr(keras.backend, "backend", lambda: "torch")
     with pytest.raises(RuntimeError, match="jax keras backend"):

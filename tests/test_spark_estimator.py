@@ -167,19 +167,15 @@ def _run_fit_workers(tmp_path, worker, size=2):
     peers = ",".join(f"127.0.0.1:{p}" for p in ports)
     procs = []
     for rank in range(size):
-        env = dict(os.environ)
-        env.update({
+        from conftest import clean_spawn_env
+        env = clean_spawn_env(**{
             "HVDTPU_RANK": str(rank), "HVDTPU_SIZE": str(size),
             "HVDTPU_LOCAL_RANK": str(rank),
             "HVDTPU_LOCAL_SIZE": str(size),
             "HVDTPU_CROSS_RANK": "0", "HVDTPU_CROSS_SIZE": "1",
-            "HVDTPU_PEERS": peers, "JAX_PLATFORMS": "cpu",
+            "HVDTPU_PEERS": peers,
             "STORE_PREFIX": str(tmp_path),
         })
-        env.pop("XLA_FLAGS", None)
-        # The pytest process may have claimed a keras backend (e.g.
-        # test_keras_jax pins jax); the workers' setdefault must win.
-        env.pop("KERAS_BACKEND", None)
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(HERE, worker)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))

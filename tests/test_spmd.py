@@ -35,8 +35,8 @@ def launch(size, script=WORKER, extra_env=None, timeout=180):
     peers = ",".join(f"127.0.0.1:{p}" for p in ports)
     procs = []
     for rank in range(size):
-        env = dict(os.environ)
-        env.update({
+        from conftest import clean_spawn_env
+        env = clean_spawn_env(**{
             "HVDTPU_RANK": str(rank),
             "HVDTPU_SIZE": str(size),
             "HVDTPU_LOCAL_RANK": str(rank),
@@ -44,13 +44,7 @@ def launch(size, script=WORKER, extra_env=None, timeout=180):
             "HVDTPU_CROSS_RANK": "0",
             "HVDTPU_CROSS_SIZE": "1",
             "HVDTPU_PEERS": peers,
-            "JAX_PLATFORMS": "cpu",
         })
-        env.pop("XLA_FLAGS", None)
-        # The pytest process may have claimed a keras backend (e.g.
-        # test_keras_jax pins jax); workers must choose their own unless
-        # the test passes one explicitly.
-        env.pop("KERAS_BACKEND", None)
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, script], env=env,
