@@ -552,6 +552,24 @@ class _GraphInterpreter:
             return jnp.pad(args[0], pads, constant_values=args[2])
         if t == "Cumsum":
             return jnp.cumsum(args[0], axis=int(np.asarray(args[1])))
+        if t == "ReverseV2":
+            axes = tuple(_axis_list(args[1], "ReverseV2 axis"))
+            return jnp.flip(args[0], axis=axes)
+        if t in ("ResizeNearestNeighbor", "ResizeBilinear"):
+            size = _static_ints(args[1], f"{t} size")
+            method = "nearest" if t == "ResizeNearestNeighbor" \
+                else "bilinear"
+            if opr.get_attr("align_corners") or \
+                    not opr.get_attr("half_pixel_centers"):
+                # jax.image.resize samples half-pixel centers (TF2
+                # semantics); legacy TF1 grids would silently diverge.
+                raise NotImplementedError(
+                    f"{t} (node {opr.name}) only supports TF2 resize "
+                    "semantics (half_pixel_centers=True, "
+                    "align_corners=False)")
+            b, _, _, c = args[0].shape
+            return jax.image.resize(
+                args[0], (b, size[0], size[1], c), method=method)
         if t == "OneHot":
             depth = int(np.asarray(args[1]))
             ax = int(opr.get_attr("axis"))
@@ -685,7 +703,11 @@ def _make_simple_ops():
         "Rint": jnp.round,
         "Tanh": jnp.tanh, "Sigmoid": jax.nn.sigmoid,
         "Erf": jax.scipy.special.erf,
+        "Erfc": jax.scipy.special.erfc,
+        "Erfinv": jax.scipy.special.erfinv,
         "Sin": jnp.sin, "Cos": jnp.cos,
+        "Sinh": jnp.sinh, "Cosh": jnp.cosh,
+        "Atan2": jnp.arctan2,
         "Relu": jax.nn.relu,
         "Relu6": lambda x: jnp.clip(x, 0, 6),
         "LeakyRelu": jax.nn.leaky_relu,

@@ -25,9 +25,8 @@ def test_cluster_job_end_to_end():
         task_args = json.dumps([num, "127.0.0.1", port, token, timeout])
         procs = []
         for rank in range(2):
-            env = dict(os.environ)
-            env.pop("XLA_FLAGS", None)
-            env["JAX_PLATFORMS"] = "cpu"
+            from conftest import clean_spawn_env
+            env = clean_spawn_env()
             procs.append(subprocess.Popen(
                 [sys.executable,
                  os.path.join(HERE, "cluster_task_worker.py"),

@@ -137,10 +137,9 @@ def test_run_command_spmd_worker():
 def test_hvdrun_console_entry():
     """`python -m horovod_tpu.runner.launch -np 2 python -c ...` — the
     declared console script must import and run a trivial job."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import clean_spawn_env
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     script = ("import horovod_tpu as hvd, jax.numpy as jnp, numpy as np; "
               "hvd.init(); "
               "out = hvd.allreduce(jnp.ones(4) * (hvd.rank() + 1), "
@@ -160,10 +159,9 @@ def test_hvdrun_console_entry():
 def test_output_filename_captures_per_rank(tmp_path):
     """--output-filename mirrors each rank's streams into
     rank.N/stdout|stderr (reference: gloo_run.py:157 MultiFile capture)."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import clean_spawn_env
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     out_dir = str(tmp_path / "logs")
     script = ("import horovod_tpu as hvd, sys; hvd.init(); "
               "print('CAPTURED', hvd.rank()); "

@@ -158,6 +158,47 @@ compiled2 = tpu_compile(fwd, example_inputs=(x2,))
 np.testing.assert_allclose(np.asarray(compiled2(x2)),
                            model2(tf.constant(x2)).numpy(),
                            rtol=1e-4, atol=1e-5)
+
+# MHA transformer block (Einsum, Erfc-gelu, Softmax, BatchMatMul):
+# forward parity + training descent.
+tf.random.set_seed(0)
+inp = tf.keras.Input((16, 32))
+h = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=8)(inp, inp)
+h = tf.keras.layers.LayerNormalization()(h + inp)
+f = tf.keras.layers.Dense(64, activation="gelu")(h)
+f = tf.keras.layers.Dense(32)(f)
+mha_model = tf.keras.Model(inp, tf.keras.layers.LayerNormalization()(h + f))
+xm = np.random.RandomState(0).rand(2, 16, 32).astype(np.float32)
+cm = tpu_compile(lambda x: mha_model(x, training=False),
+                 example_inputs=(xm,))
+np.testing.assert_allclose(np.asarray(cm(xm)),
+                           mha_model(tf.constant(xm)).numpy(),
+                           rtol=1e-4, atol=1e-5)
+xt = np.random.RandomState(3).rand(8, 16, 32).astype(np.float32)
+yt = np.random.RandomState(1).rand(8, 16, 32).astype(np.float32)
+def mha_loss(x, y):
+    return tf.reduce_mean(tf.square(mha_model(x, training=True) - y))
+cmt = tpu_compile(mha_loss, example_inputs=(xt, yt))
+ms = cmt.make_train_step(optax.adam(1e-3))
+mlosses = [float(ms((xt, yt))) for _ in range(6)]
+assert mlosses[-1] < mlosses[0], mlosses
+
+# Recurrence (LSTM -> TensorList while loop) must fail LOUD, not
+# silently mis-train.
+tf.random.set_seed(1)
+lstm = tf.keras.Sequential([
+    tf.keras.layers.Input((12,), dtype="int32"),
+    tf.keras.layers.Embedding(100, 16),
+    tf.keras.layers.LSTM(8),
+    tf.keras.layers.Dense(2)])
+ids = np.random.RandomState(1).randint(0, 100, size=(2, 12)).astype(np.int32)
+cl = tpu_compile(lambda x: lstm(x, training=False), example_inputs=(ids,))
+try:
+    cl(ids)
+    raise SystemExit("LSTM did not fail loud")
+except NotImplementedError:
+    pass
+
 print("KERAS-BRIDGE OK")
 """
 
@@ -182,6 +223,18 @@ def test_keras_model_bridge_subprocess():
         capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, out.stderr[-4000:]
     assert "KERAS-BRIDGE OK" in out.stdout
+
+
+def test_image_resize_parity():
+    def fwd(x):
+        up = tf.image.resize(x, (8, 8), method="bilinear")
+        return tf.image.resize(up, (2, 2), method="nearest")
+
+    x = np.random.RandomState(2).rand(2, 4, 4, 3).astype(np.float32)
+    compiled = tpu_compile(fwd, example_inputs=(x,))
+    np.testing.assert_allclose(np.asarray(compiled(x)),
+                               fwd(tf.constant(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_embedding_and_einsum():

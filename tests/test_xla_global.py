@@ -90,10 +90,9 @@ def test_elastic_rejects_xla_plane():
     """Elastic + xla-global must fail at launch with guidance (not on the
     first scale-up reset): jax.distributed cannot re-form in-process."""
     import subprocess
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
+    from conftest import clean_spawn_env
+    env = clean_spawn_env()
     env.update({
-        "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": os.path.dirname(HERE),
         "HVDTPU_CPU_OPERATIONS": "xla",
         "HVDTPU_ELASTIC": "1",
