@@ -53,6 +53,7 @@ _SLOW_NAMES = {
     "test_space_to_depth_stem_equivalent",
     "test_transformer_remat_variants_run",
     "test_keras_applications_model_on_mesh",
+    "test_keras_applications_through_bridge",
 }
 
 

@@ -259,6 +259,28 @@ def main():
     # loss averaged across ranks like the shard_map path's pmean
     np.testing.assert_allclose(float(ts_l), 3.0 * mean_b, rtol=1e-5)
 
+    # has_aux variant (the TF-bridge train step's shape): aux state is
+    # rank-averaged like the shard_map path pmeans batch stats.
+    def ts_loss_aux(p, aux, b):
+        return jnp.sum(p["w"] * b), {"stat": aux["stat"] + rank + 1.0}
+
+    aux_opt = hvd_jax.DistributedOptimizer(optax.sgd(1.0))
+    aux_step = hvd_jax.make_train_step(ts_loss_aux, aux_opt,
+                                       has_aux=True)
+    _, new_aux, _, _ = aux_step(w0, {"stat": jnp.zeros(())},
+                                aux_opt.init(w0), bvec)
+    np.testing.assert_allclose(float(new_aux["stat"]), mean_b, rtol=1e-5)
+
+    # ZeRO has no host-plane variant: must refuse, not silently train
+    # each rank alone on the 1-device local mesh.
+    try:
+        hvd_jax.make_zero_train_step(ts_loss, aux_opt)
+    except RuntimeError as e:
+        assert "host-plane" in str(e)
+    else:
+        raise AssertionError("make_zero_train_step did not refuse "
+                             "host-plane SPMD mode")
+
     # -- join with unequal work ---------------------------------------------
     if rank % 2 == 1:
         last = hvd.join()

@@ -237,6 +237,49 @@ def test_image_resize_parity():
                                rtol=1e-5, atol=1e-6)
 
 
+_APPLICATIONS_SCRIPT = r"""
+import os, sys
+os.environ["KERAS_BACKEND"] = "tensorflow"
+sys.path.insert(0, {repo!r})
+import numpy as np
+import tensorflow as tf
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd_core
+from horovod_tpu.tensorflow.compile import tpu_compile
+hvd_core.init()
+tf.random.set_seed(0)
+model = getattr(tf.keras.applications, {name!r})(
+    weights=None, input_shape=(96, 96, 3), classes=10)
+x = np.random.RandomState(0).rand(2, 96, 96, 3).astype(np.float32)
+c = tpu_compile(lambda a: model(a, training=False), example_inputs=(x,))
+d = float(np.abs(np.asarray(c(x)) - model(tf.constant(x)).numpy()).max())
+assert d < 1e-4, d
+print("APPLICATIONS OK", d)
+"""
+
+
+@pytest.mark.parametrize("name", ["MobileNetV2", "EfficientNetB0",
+                                  "DenseNet121"])
+def test_keras_applications_through_bridge(name):
+    """The tf.keras.applications families the tf_on_tpu doc advertises:
+    exact forward parity through the graph→JAX bridge (depthwise convs,
+    swish/relu6, BN inference, skip connections, global pooling).
+    Subprocess: keras backend binds per process."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, KERAS_BACKEND="tensorflow",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         _APPLICATIONS_SCRIPT.format(repo=repo, name=name)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "APPLICATIONS OK" in out.stdout
+
+
 def test_embedding_and_einsum():
     """ResourceGather (embedding) + Einsum + LayerNorm-style math."""
     tf.random.set_seed(2)
