@@ -203,26 +203,30 @@ print("KERAS-BRIDGE OK")
 """
 
 
-def test_keras_model_bridge_subprocess():
-    """tf.keras models through the bridge (PartitionedCall, BN buffer
-    writes, dropout, inference parity). Runs in a subprocess with
-    KERAS_BACKEND=tensorflow: the keras backend binds at import, and
-    another test module in this process may have claimed jax — tf.keras
-    models can only trace under tf.function on the tensorflow backend."""
+def _run_bridge_subprocess(script_body, marker):
+    """Run a bridge scenario in its own interpreter. The keras backend
+    binds at import (another module may have claimed jax), and
+    JAX_PLATFORMS must be in the env BEFORE the interpreter starts —
+    the axon sitecustomize reads it at startup and force-selects the
+    real chip otherwise (an in-script setdefault is too late)."""
     import os
     import subprocess
     import sys
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # JAX_PLATFORMS must be in the env BEFORE the interpreter starts:
-    # the axon sitecustomize reads it at startup and force-selects the
-    # real chip otherwise (an in-script setdefault is too late).
     env = dict(os.environ, KERAS_BACKEND="tensorflow",
                JAX_PLATFORMS="cpu")
     out = subprocess.run(
-        [sys.executable, "-c", _KERAS_MODEL_SCRIPT.format(repo=repo)],
+        [sys.executable, "-c", script_body.format(repo=repo)],
         capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, out.stderr[-4000:]
-    assert "KERAS-BRIDGE OK" in out.stdout
+    assert marker in out.stdout
+
+
+def test_keras_model_bridge_subprocess():
+    """tf.keras models through the bridge: PartitionedCall recursion, BN
+    buffer writes, PRNG dropout, inference parity, the MHA transformer
+    block, and LSTM failing loud."""
+    _run_bridge_subprocess(_KERAS_MODEL_SCRIPT, "KERAS-BRIDGE OK")
 
 
 def test_image_resize_parity():
@@ -266,18 +270,9 @@ def test_keras_applications_through_bridge(name):
     exact forward parity through the graph→JAX bridge (depthwise convs,
     swish/relu6, BN inference, skip connections, global pooling).
     Subprocess: keras backend binds per process."""
-    import os
-    import subprocess
-    import sys
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, KERAS_BACKEND="tensorflow",
-               JAX_PLATFORMS="cpu")
-    out = subprocess.run(
-        [sys.executable, "-c",
-         _APPLICATIONS_SCRIPT.format(repo=repo, name=name)],
-        capture_output=True, text=True, timeout=600, env=env)
-    assert out.returncode == 0, out.stderr[-4000:]
-    assert "APPLICATIONS OK" in out.stdout
+    _run_bridge_subprocess(
+        _APPLICATIONS_SCRIPT.replace("{name!r}", repr(name)),
+        "APPLICATIONS OK")
 
 
 def test_embedding_and_einsum():

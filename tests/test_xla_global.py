@@ -86,6 +86,26 @@ def test_xla_global_through_hvdrun():
     assert rc == 0
 
 
+def test_keras_compiled_over_global_mesh():
+    """Keras model.fit with set_data_parallel spanning 2 processes x 2
+    devices over jax.distributed — the multi-host on-chip keras shape.
+    Each rank feeds its pre-sharded data; the jitted step is one
+    global-SPMD program; weights stay replicated across ranks."""
+    pytest.importorskip("keras")
+    extra = {
+        "HVDTPU_CPU_OPERATIONS": "xla",
+        "HVDTPU_XLA_COORD": f"127.0.0.1:{_free_port()}",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "XGW_LOCAL_DEVICES": "2",
+    }
+    codes, outs = launch(2, script=os.path.join(HERE,
+                                                "keras_global_worker.py"),
+                         extra_env=extra, timeout=420)
+    for rank, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {rank} failed (exit {code}):\n{out[-4000:]}"
+        assert f"rank {rank}/2: KERAS-GLOBAL OK" in out
+
+
 def test_elastic_rejects_xla_plane():
     """Elastic + xla-global must fail at launch with guidance (not on the
     first scale-up reset): jax.distributed cannot re-form in-process."""
