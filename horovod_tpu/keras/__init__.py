@@ -22,6 +22,7 @@ horovod/tensorflow/xla_mpi_ops.cc:174-232).
 
 from .. import basics
 from ..ops import reduce_ops
+from ..ops.compression import Compression
 from .._keras import (create_distributed_optimizer, rank, size,
                       spmd_active)
 
@@ -92,19 +93,36 @@ def DistributedOptimizer(optimizer, name=None, device_dense="",
                          sparse_as_dense=False, gradient_predivide_factor=1.0,
                          op=Average, backward_passes_per_step=1,
                          average_aggregated_gradients=True):
-    """Reference: horovod/keras/__init__.py:36 DistributedOptimizer."""
+    """Reference: horovod/keras/__init__.py:36 DistributedOptimizer.
+    ``compression`` (Compression.fp16/bf16) applies on the host/eager
+    sync planes; ``device_dense``/``device_sparse``/``sparse_as_dense``
+    are GPU placement/densification knobs the TPU design absorbs (grads
+    on the sync plane are always dense)."""
     import keras
     return create_distributed_optimizer(
         keras, optimizer, name=name, op=op,
         gradient_predivide_factor=gradient_predivide_factor,
         backward_passes_per_step=backward_passes_per_step,
-        average_aggregated_gradients=average_aggregated_gradients)
+        average_aggregated_gradients=average_aggregated_gradients,
+        compression=compression)
 
 
 def broadcast_global_variables(root_rank=0, model=None):
     """Broadcast a model's weights from root_rank (reference:
-    horovod/keras/__init__.py broadcast_global_variables)."""
-    if model is None or not spmd_active():
+    horovod/keras/__init__.py broadcast_global_variables).
+
+    Keras 3 has no global-variables registry, so the model must be
+    passed explicitly; a silent no-op here would let ranks keep
+    divergent initial weights (the reference likewise fails loud in
+    eager mode rather than guess)."""
+    if model is None:
+        raise ValueError(
+            "broadcast_global_variables needs the model: pass "
+            "model=<keras model>, use callbacks."
+            "BroadcastGlobalVariablesCallback(root_rank) in model.fit, or "
+            "broadcast the arrays directly with "
+            "horovod_tpu.functions.broadcast_variables.")
+    if not spmd_active():
         return
     import numpy as np
     from ..functions import broadcast_variables as _bv
@@ -113,7 +131,8 @@ def broadcast_global_variables(root_rank=0, model=None):
 
 
 def allreduce(value, name=None, average=True,
-              prescale_factor=1.0, postscale_factor=1.0, op=None):
+              prescale_factor=1.0, postscale_factor=1.0, op=None,
+              compression=None):
     import numpy as np
     import keras
     from ..ops import collectives as _c
@@ -122,7 +141,9 @@ def allreduce(value, name=None, average=True,
     if not spmd_active():
         return value
     out = _c.allreduce(np.asarray(keras.ops.convert_to_numpy(value)),
-                       op=op, name=name, prescale_factor=prescale_factor,
+                       op=op, name=name,
+                       compression=compression or Compression.none,
+                       prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
     return keras.ops.convert_to_tensor(np.asarray(out))
 
@@ -149,22 +170,32 @@ def broadcast(value, root_rank, name=None):
     return keras.ops.convert_to_tensor(np.asarray(out))
 
 
-def load_model(filepath, custom_objects=None, compile=True,  # noqa: A002
-               **kwargs):
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None, compile=True, **kwargs):  # noqa: A002
     """Load a model and wrap its optimizer (reference:
-    horovod/keras/__init__.py:167 load_model)."""
+    horovod/keras/__init__.py:167 load_model — same kwarg surface:
+    ``custom_optimizers`` extends the deserializable classes,
+    ``compression`` is applied to the re-wrapped optimizer so a model
+    trained with wire compression keeps it after reload)."""
     import keras
+    if custom_optimizers:
+        custom_objects = dict(custom_objects or {})
+        custom_objects.update({cls.__name__: cls
+                               for cls in custom_optimizers})
     model = keras.models.load_model(filepath,
                                     custom_objects=custom_objects,
                                     compile=compile, **kwargs)
     if compile and getattr(model, "optimizer", None) is not None:
-        model.optimizer = DistributedOptimizer(model.optimizer)
+        model.optimizer = DistributedOptimizer(model.optimizer,
+                                               compression=compression)
     return model
 
 
 class _Callbacks:
     """Lazy namespace: hvd.callbacks.BroadcastGlobalVariablesCallback etc.
-    (reference: horovod/_keras/callbacks.py)."""
+    (reference: horovod/_keras/callbacks.py). Created classes are cached
+    on the instance so repeated access returns the SAME class
+    (isinstance/identity checks must hold)."""
 
     def __getattr__(self, item):
         from .._keras.callbacks import make_callbacks
@@ -180,10 +211,10 @@ class _Callbacks:
             "UpdateBatchStateCallback": upd_batch,
             "UpdateEpochStateCallback": upd_epoch,
         }
-        try:
-            return mapping[item]
-        except KeyError:
+        if item not in mapping:
             raise AttributeError(item)
+        self.__dict__.update(mapping)
+        return mapping[item]
 
 
 callbacks = _Callbacks()

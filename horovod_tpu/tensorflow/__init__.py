@@ -16,7 +16,9 @@ import numpy as np
 from .. import basics
 from ..ops import reduce_ops
 from ..ops import collectives as _c
-from ..process_sets import global_process_set
+from ..ops.compression import Compression
+from ..process_sets import (ProcessSet, global_process_set,
+                            add_process_set, remove_process_set)
 from ..utils.logging_util import get_logger
 
 Average = reduce_ops.Average
@@ -37,6 +39,18 @@ is_homogeneous = basics.is_homogeneous
 mpi_enabled = basics.mpi_enabled
 gloo_enabled = basics.gloo_enabled
 nccl_built = basics.nccl_built
+
+
+def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
+    """Reference: horovod/common/basics.py:156 start_timeline."""
+    from .. import start_timeline as _st
+    return _st(file_path, mark_cycles=mark_cycles,
+               jax_profiler_dir=jax_profiler_dir)
+
+
+def stop_timeline():
+    from .. import stop_timeline as _st
+    return _st()
 
 
 def _tf():
@@ -101,10 +115,14 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
               process_set=global_process_set):
     """Reference: horovod/tensorflow/__init__.py:55-161 ``allreduce``.
     IndexedSlices are densified (the reference's ``sparse_as_dense``
-    behavior) before reduction."""
+    behavior) before reduction. ``compression`` shrinks the bytes the
+    host data plane carries (fp16/bf16 cast before the collective, cast
+    back after), like the reference's wire compression."""
     tf = _tf()
     if op is None:
         op = Sum if average is False else Average
+    if compression is None:
+        compression = Compression.none
     if isinstance(tensor, tf.IndexedSlices):
         tensor = tf.convert_to_tensor(tensor)
     if not _spmd():
@@ -114,6 +132,7 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
 
     def fn(arrs):
         out = _c.allreduce(arrs[0], op=op, name=name,
+                           compression=compression,
                            prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor,
                            process_set=process_set)
@@ -123,10 +142,11 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
 
 
 def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
-                      postscale_factor=1.0, name=None,
+                      postscale_factor=1.0, name=None, compression=None,
                       process_set=global_process_set):
     if op is None:
         op = Sum if average is False else Average
+    comp = Compression.none if compression is None else compression
     if not _spmd():
         tf = _tf()
         scale = prescale_factor * postscale_factor
@@ -135,6 +155,7 @@ def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
 
     def fn(arrs):
         outs = _c.grouped_allreduce(arrs, op=op, name=name,
+                                    compression=comp,
                                     prescale_factor=prescale_factor,
                                     postscale_factor=postscale_factor,
                                     process_set=process_set)
@@ -253,6 +274,7 @@ class DistributedGradientTape:
         self._predivide = gradient_predivide_factor
         self._num_groups = num_groups
         self._groups = groups
+        self._compression = compression
 
     def __getattr__(self, name):
         return getattr(self._tape, name)
@@ -271,7 +293,8 @@ class DistributedGradientTape:
         ngroups, group_ids = _resolve_groups(
             list(sources), self._num_groups, self._groups)
         return _reduce_grads(grads, self._op, self._process_set,
-                             self._predivide, ngroups, group_ids)
+                             self._predivide, ngroups, group_ids,
+                             compression=self._compression)
 
 
 def _grouping(n, num_groups, group_ids):
@@ -289,20 +312,12 @@ def _grouping(n, num_groups, group_ids):
                 by_gid.setdefault(gid, []).append(i)
         return list(by_gid.values()) + rest
     if num_groups and num_groups > 0:
-        k = min(int(num_groups), n)
-        # Contiguous near-even buckets, like the reference's split.
-        size, extra = divmod(n, k)
-        buckets, start = [], 0
-        for j in range(k):
-            end = start + size + (1 if j < extra else 0)
-            buckets.append(list(range(start, end)))
-            start = end
-        return buckets
+        return _c.fusion_buckets(n, num_groups)
     return [list(range(n))]
 
 
 def _reduce_grads(grads, op, process_set, predivide=1.0, num_groups=0,
-                  group_ids=None):
+                  group_ids=None, compression=None):
     tf = _tf()
     dense_idx, dense = [], []
     for i, g in enumerate(grads):
@@ -323,6 +338,7 @@ def _reduce_grads(grads, op, process_set, predivide=1.0, num_groups=0,
         outs = grouped_allreduce([dense[j] for j in bucket], op=op,
                                  prescale_factor=pre, postscale_factor=post,
                                  name=f"grad_reduce.g{b}",
+                                 compression=compression,
                                  process_set=process_set)
         for j, o in zip(bucket, outs):
             result[dense_idx[j]] = o
@@ -378,7 +394,12 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
     horovod/tensorflow/gradient_aggregation.py:16). The rank-sync and
     the inner apply happen only on every k-th call; skip calls just
     accumulate. ``num_groups``/``groups`` bound the gradient fusion
-    buckets like the reference."""
+    buckets like the reference. ``compression`` (Compression.fp16/bf16)
+    shrinks the bytes the host data plane carries per sync.
+    ``device_dense``/``device_sparse`` are GPU stream placement in the
+    reference — inert here (XLA owns device placement);
+    ``sparse_as_dense`` likewise: the sync path always densifies
+    IndexedSlices (the reference's sparse_as_dense=True behavior)."""
     k = int(backward_passes_per_step)
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -426,7 +447,8 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                     # the inner optimizer's sparse application.
                     grads = _reduce_grads(grads, op, process_set,
                                           gradient_predivide_factor,
-                                          ngroups, group_ids)
+                                          ngroups, group_ids,
+                                          compression=compression)
                 return cls.apply_gradients(self, list(zip(grads, tvars)),
                                            *args, **kwargs)
 

@@ -1,0 +1,48 @@
+"""Keras callback set under the ``horovod.tensorflow.keras`` namespace
+(reference: horovod/tensorflow/keras/callbacks.py:22-151). The classes
+are the shared backend-agnostic implementations (.._keras.callbacks),
+bound lazily so importing this module never imports keras.
+"""
+
+
+def _make():
+    from ..._keras.callbacks import make_callbacks
+    return make_callbacks()
+
+
+def _best_model_checkpoint():
+    import keras
+
+    class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
+        """save_best_only ModelCheckpoint (reference:
+        horovod/tensorflow/keras/callbacks.py:151 — used by the Spark
+        estimator to keep the best epoch's weights; ``filepath`` may be
+        assigned after construction, as the reference does)."""
+
+        def __init__(self, filepath=None, monitor="val_loss", verbose=0,
+                     save_weights_only=False, mode="auto",
+                     save_freq="epoch"):
+            super().__init__(filepath=filepath or "", monitor=monitor,
+                             verbose=verbose, save_best_only=True,
+                             save_weights_only=save_weights_only,
+                             mode=mode, save_freq=save_freq)
+
+    return BestModelCheckpoint
+
+
+def __getattr__(name):
+    """Lazy class creation, cached in module globals so repeated access
+    returns the SAME class (isinstance/identity checks must hold)."""
+    (bgv, ma, warmup, sched) = _make()
+    mapping = {
+        "BroadcastGlobalVariablesCallback": bgv,
+        "MetricAverageCallback": ma,
+        "LearningRateWarmupCallback": warmup,
+        "LearningRateScheduleCallback": sched,
+    }
+    if name == "BestModelCheckpoint":
+        mapping[name] = _best_model_checkpoint()
+    if name in mapping:
+        globals().update(mapping)
+        return globals()[name]
+    raise AttributeError(name)

@@ -1,0 +1,28 @@
+"""Elastic Keras state + callbacks under the ``horovod.tensorflow.keras``
+namespace (reference: horovod/tensorflow/keras/elastic.py:22 KerasState,
+:34-70 elastic callbacks).
+"""
+
+from ...elastic import run  # noqa: F401
+from ..elastic import TensorFlowKerasState
+
+
+class KerasState(TensorFlowKerasState):
+    """State of a Keras model and optimizer for elastic training
+    (reference: horovod/tensorflow/keras/elastic.py:22)."""
+
+
+def __getattr__(name):
+    """Lazy class creation, cached in module globals so repeated access
+    returns the SAME class (isinstance/identity checks must hold)."""
+    from ..._keras.elastic import make_elastic_callbacks
+    (commit, upd_batch, upd_epoch) = make_elastic_callbacks()
+    mapping = {
+        "CommitStateCallback": commit,
+        "UpdateBatchStateCallback": upd_batch,
+        "UpdateEpochStateCallback": upd_epoch,
+    }
+    if name in mapping:
+        globals().update(mapping)
+        return globals()[name]
+    raise AttributeError(name)

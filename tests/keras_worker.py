@@ -88,6 +88,28 @@ def main():
     np.testing.assert_allclose(float(np.asarray(sc)),
                                sum(range(1, n + 1)))
 
+    # DistributedOptimizer with wire compression: the sync plane casts
+    # grads to bf16 and back — training still converges and stays
+    # replicated (forwarding is pinned in test_tf_keras_namespace.py).
+    keras.utils.set_random_seed(r + 50)
+    model_c = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    opt_c = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05),
+                                     compression=hvd.Compression.bf16)
+    model_c.compile(optimizer=opt_c, loss="mse", run_eagerly=jax_eager)
+    hist_c = model_c.fit(
+        X, y, epochs=3, batch_size=32, verbose=0,
+        callbacks=[hvd.callbacks.BroadcastGlobalVariablesCallback(0)])
+    assert hist_c.history["loss"][-1] < hist_c.history["loss"][0]
+    all_wc = allgather_object([np.asarray(w)
+                               for w in model_c.get_weights()])
+    for rank_w in all_wc[1:]:
+        for a, b in zip(rank_w, all_wc[0]):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
     # load_model round-trip restores the distributed optimizer wrapper.
     import tempfile
     with tempfile.TemporaryDirectory() as d:

@@ -203,7 +203,7 @@ print("KERAS-BRIDGE OK")
 """
 
 
-def _run_bridge_subprocess(script_body, marker):
+def _run_bridge_subprocess(script_body, marker, **fmt):
     """Run a bridge scenario in its own interpreter. The keras backend
     binds at import (another module may have claimed jax), and
     JAX_PLATFORMS must be in the env BEFORE the interpreter starts —
@@ -216,7 +216,7 @@ def _run_bridge_subprocess(script_body, marker):
     env = dict(os.environ, KERAS_BACKEND="tensorflow",
                JAX_PLATFORMS="cpu")
     out = subprocess.run(
-        [sys.executable, "-c", script_body.format(repo=repo)],
+        [sys.executable, "-c", script_body.format(repo=repo, **fmt)],
         capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, out.stderr[-4000:]
     assert marker in out.stdout
@@ -270,9 +270,8 @@ def test_keras_applications_through_bridge(name):
     exact forward parity through the graph→JAX bridge (depthwise convs,
     swish/relu6, BN inference, skip connections, global pooling).
     Subprocess: keras backend binds per process."""
-    _run_bridge_subprocess(
-        _APPLICATIONS_SCRIPT.replace("{name!r}", repr(name)),
-        "APPLICATIONS OK")
+    _run_bridge_subprocess(_APPLICATIONS_SCRIPT, "APPLICATIONS OK",
+                           name=name)
 
 
 def test_embedding_and_einsum():

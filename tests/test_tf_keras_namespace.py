@@ -1,0 +1,198 @@
+"""The ``horovod_tpu.tensorflow.keras`` namespace (reference:
+horovod/tensorflow/keras/__init__.py — scripts written against
+``import horovod.tensorflow.keras as hvd`` must keep working) and the
+compression wiring through the TF/keras bindings (reference:
+horovod/tensorflow/keras/__init__.py:49 ``compression=`` — previously
+accepted-but-ignored here).
+
+Keras optimizers are only *wrapped* in-process (backend-neutral); the
+fit/apply behavior rides the subprocess workers (tf_worker.py,
+keras_worker.py) like the rest of the keras coverage.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+pytest.importorskip("keras")
+
+import horovod_tpu as hvd_core  # noqa: E402
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+import horovod_tpu.tensorflow.keras as hvd  # noqa: E402
+from horovod_tpu.ops.compression import Compression  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    hvd_core.init()
+    yield
+
+
+def test_namespace_surface():
+    """Every name a reference tf.keras script uses resolves."""
+    for name in ["init", "shutdown", "rank", "size", "local_rank",
+                 "local_size", "cross_rank", "cross_size",
+                 "DistributedOptimizer", "broadcast_global_variables",
+                 "allreduce", "allgather", "broadcast", "load_model",
+                 "Compression", "Average", "Sum", "Adasum",
+                 "ProcessSet", "add_process_set", "remove_process_set",
+                 "start_timeline", "stop_timeline"]:
+        assert hasattr(hvd, name), name
+    for cb in ["BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+               "LearningRateWarmupCallback", "LearningRateScheduleCallback",
+               "BestModelCheckpoint"]:
+        assert getattr(hvd.callbacks, cb) is not None, cb
+    for el in ["KerasState", "CommitStateCallback",
+               "UpdateBatchStateCallback", "UpdateEpochStateCallback",
+               "run"]:
+        assert getattr(hvd.elastic, el) is not None, el
+
+
+def test_callback_classes_are_cached():
+    """Repeated attribute access must return the SAME class so
+    isinstance/identity checks hold."""
+    import horovod_tpu.keras as hk
+    assert (hvd.callbacks.BroadcastGlobalVariablesCallback
+            is hvd.callbacks.BroadcastGlobalVariablesCallback)
+    assert (hvd.callbacks.BestModelCheckpoint
+            is hvd.callbacks.BestModelCheckpoint)
+    assert hvd.elastic.CommitStateCallback is hvd.elastic.CommitStateCallback
+    assert (hk.callbacks.MetricAverageCallback
+            is hk.callbacks.MetricAverageCallback)
+    cb = hvd.callbacks.BroadcastGlobalVariablesCallback(0)
+    assert isinstance(cb, hvd.callbacks.BroadcastGlobalVariablesCallback)
+
+
+def test_rewrap_guard_ignores_no_effect_average_flag():
+    """load_model wraps with one namespace's defaults; a second wrap with
+    the other namespace's defaults must be accepted at k=1 (the flag has
+    no effect there)."""
+    import keras
+    import horovod_tpu.keras as hk
+    opt = hk.DistributedOptimizer(keras.optimizers.SGD(0.1))  # avg=True
+    assert hvd.DistributedOptimizer(opt) is opt               # avg=False
+
+
+def test_distributed_optimizer_wraps_with_reference_kwargs():
+    import keras
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(0.1), compression=Compression.bf16,
+        sparse_as_dense=True, device_dense="/gpu:0")
+    assert getattr(opt, "_hvd_wrapped", False)
+    assert Compression.bf16 in opt._hvd_settings
+
+
+def test_num_groups_deprecation_matches_reference():
+    import warnings
+    import keras
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1),
+                                       num_groups=2)
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    assert 2 in opt._hvd_settings  # forwarded, not dropped
+    with pytest.raises(ValueError, match="groups"):
+        hvd.DistributedOptimizer(keras.optimizers.SGD(0.1), groups=-1)
+    v = tf.Variable(1.0)
+    with pytest.raises(NotImplementedError, match="variable-lists"):
+        hvd.DistributedOptimizer(keras.optimizers.SGD(0.1), groups=[[v]])
+
+
+def test_keras_sync_bucketing():
+    """num_groups splits the sync into that many grouped collectives."""
+    from horovod_tpu import _keras as keras_impl
+    assert keras_impl._buckets(5, 2) == [[0, 1, 2], [3, 4]]
+    assert keras_impl._buckets(3, 0) == [[0, 1, 2]]
+    assert keras_impl._buckets(2, 5) == [[0], [1]]
+    calls = []
+
+    def fake_grouped(tensors, **kw):
+        calls.append(len(tensors))
+        return list(tensors)
+
+    import unittest.mock as mock
+    with mock.patch.object(keras_impl._c, "grouped_allreduce",
+                           fake_grouped):
+        keras_impl._reduce_numpy_grads(
+            [np.ones(2)] * 5, keras_impl.reduce_ops.Average, 1.0, 1.0,
+            "t", num_groups=2)
+    assert calls == [3, 2]
+
+
+def test_broadcast_global_variables_fails_loud_without_model():
+    with pytest.raises(ValueError, match="model"):
+        hvd.broadcast_global_variables(0)
+
+
+def test_keras_allreduce_accepts_compression():
+    # Single process: identity path, but the kwarg must be accepted
+    # (reference scripts pass it verbatim).
+    out = hvd.allreduce(np.ones(3, np.float32),
+                        compression=Compression.fp16)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_tf_binding_exports_compression_and_process_sets():
+    for name in ["Compression", "ProcessSet", "add_process_set",
+                 "remove_process_set", "start_timeline", "stop_timeline"]:
+        assert hasattr(hvd_tf, name), name
+
+
+class _PlainSGD:
+    def __init__(self, lr):
+        self.lr = lr
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        for g, v in grads_and_vars:
+            if g is not None:
+                v.assign_sub(self.lr * g)
+
+
+def test_tf_optimizer_forwards_compression(monkeypatch):
+    captured = {}
+
+    def fake_grouped(tensors, **kw):
+        captured.update(kw)
+        return list(tensors)
+
+    monkeypatch.setattr(hvd_tf, "_spmd", lambda: True)
+    monkeypatch.setattr(hvd_tf, "grouped_allreduce", fake_grouped)
+    v = tf.Variable(1.0)
+    opt = hvd_tf.DistributedOptimizer(_PlainSGD(0.1),
+                                      compression=Compression.fp16)
+    opt.apply_gradients([(tf.constant(2.0), v)])
+    assert captured.get("compression") is Compression.fp16
+    np.testing.assert_allclose(v.numpy(), 0.8, rtol=1e-6)
+
+
+def test_tf_tape_forwards_compression(monkeypatch):
+    captured = {}
+
+    def fake_grouped(tensors, **kw):
+        captured.update(kw)
+        return list(tensors)
+
+    monkeypatch.setattr(hvd_tf, "_spmd", lambda: True)
+    monkeypatch.setattr(hvd_tf, "grouped_allreduce", fake_grouped)
+    v = tf.Variable(3.0)
+    with hvd_tf.DistributedGradientTape(
+            tf.GradientTape(), compression=Compression.bf16) as tape:
+        loss = v * v
+    tape.gradient(loss, [v])
+    assert captured.get("compression") is Compression.bf16
+
+
+def test_keras_numpy_plane_forwards_compression(monkeypatch):
+    from horovod_tpu import _keras as keras_impl
+    captured = {}
+
+    def fake_grouped(tensors, **kw):
+        captured.update(kw)
+        return list(tensors)
+
+    monkeypatch.setattr(keras_impl._c, "grouped_allreduce", fake_grouped)
+    out = keras_impl._reduce_numpy_grads(
+        [np.ones(3), None, np.ones(2)], keras_impl.reduce_ops.Average,
+        1.0, 1.0, "t", compression=Compression.fp16)
+    assert captured.get("compression") is Compression.fp16
+    assert out[1] is None and out[0].shape == (3,)

@@ -46,6 +46,20 @@ def main():
     np.testing.assert_allclose(outs[0].numpy(), s)
     np.testing.assert_allclose(outs[1].numpy(), 2.0 * s)
 
+    # -- wire compression: fp16/bf16 cast on the data plane, result dtype
+    # restored (reference: horovod/tensorflow/compression.py) ------------
+    xc = tf.ones([4], tf.float32) * (r + 1) / 3.0
+    cr = hvd.allreduce(xc, op=hvd.Sum, name="car",
+                       compression=hvd.Compression.fp16)
+    assert cr.dtype == tf.float32
+    np.testing.assert_allclose(cr.numpy(), sum(range(1, n + 1)) / 3.0,
+                               rtol=1e-2)
+    gouts = hvd.grouped_allreduce(
+        [tf.ones([2]) * r / 3.0, tf.ones([3]) * 2.0 * r / 3.0],
+        op=hvd.Sum, name="cgar", compression=hvd.Compression.bf16)
+    np.testing.assert_allclose(gouts[0].numpy(), s / 3.0, rtol=1e-2)
+    np.testing.assert_allclose(gouts[1].numpy(), 2.0 * s / 3.0, rtol=1e-2)
+
     # -- collectives inside tf.function (py_function bridge) -------------
     @tf.function
     def graph_reduce(t):
