@@ -20,7 +20,8 @@ from ..utils.logging_util import get_logger
 from ..ops import collectives as _c
 from ..ops import reduce_ops
 from ..ops.compression import Compression
-from ..process_sets import global_process_set
+from ..process_sets import (ProcessSet, global_process_set,
+                            add_process_set, remove_process_set)
 
 Average = reduce_ops.Average
 Sum = reduce_ops.Sum
@@ -32,6 +33,19 @@ Product = reduce_ops.Product
 init = basics.init
 shutdown = basics.shutdown
 is_initialized = basics.is_initialized
+
+
+def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
+    """Reference: horovod/torch/mpi_ops.py start_timeline (the shared
+    basics API surfaced per binding)."""
+    from .. import start_timeline as _st
+    return _st(file_path, mark_cycles=mark_cycles,
+               jax_profiler_dir=jax_profiler_dir)
+
+
+def stop_timeline():
+    from .. import stop_timeline as _st
+    return _st()
 
 
 def _torch():

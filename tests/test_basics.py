@@ -115,3 +115,21 @@ def test_checkpoint_save_restore(hvd, tmp_path):
     np.testing.assert_allclose(np.asarray(old["params"]["w"]),
                                np.arange(6.0).reshape(2, 3))
     assert ckpt.restore_latest(tmp_path / "empty") == (None, None)
+
+
+def test_binding_surface_parity():
+    """Every framework binding re-exports the shared runtime surface the
+    reference exposes per binding (reference: horovod/torch/__init__.py:
+    48-53 — timeline start/stop + process-set API + Compression)."""
+    import importlib
+    for mod_name, required in [
+        ("horovod_tpu.torch", "torch"),
+        ("horovod_tpu.tensorflow", "tensorflow"),
+    ]:
+        pytest.importorskip(required)
+        m = importlib.import_module(mod_name)
+        for name in ["start_timeline", "stop_timeline", "ProcessSet",
+                     "global_process_set", "add_process_set",
+                     "remove_process_set", "Compression", "init",
+                     "shutdown", "rank", "size"]:
+            assert hasattr(m, name), (mod_name, name)

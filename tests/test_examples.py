@@ -52,6 +52,15 @@ def test_keras_mnist():
     assert "loss" in out.lower() or "done" in out.lower()
 
 
+def test_tensorflow2_keras_mnist():
+    """The horovod.tensorflow.keras drop-in namespace end to end:
+    compressed + bucketed sync under the launcher."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("keras")
+    out = _run_example(["tensorflow2_keras_mnist.py"])
+    assert "done" in out
+
+
 def _run_single(argv, env_extra=None, timeout=420):
     """Single-process run on the 8-device virtual mesh (the
     single-controller on-chip paths: keras set_data_parallel,
