@@ -30,6 +30,8 @@ Average = reduce_ops.Average
 Sum = reduce_ops.Sum
 Adasum = reduce_ops.Adasum
 
+from . import elastic  # noqa: E402,F401  (hvd.elastic.KerasState)
+
 init = basics.init
 shutdown = basics.shutdown
 is_initialized = basics.is_initialized

@@ -1,15 +1,15 @@
-"""Elastic Keras state + callbacks under the ``horovod.tensorflow.keras``
-namespace (reference: horovod/tensorflow/keras/elastic.py:22 KerasState,
-:34-70 elastic callbacks).
+"""Elastic Keras state + callbacks under ``horovod_tpu.keras``
+(reference: horovod/keras/elastic.py:22 KerasState, :34-76 elastic
+callbacks).
 """
 
-from ...elastic import run  # noqa: F401
-from ..elastic import TensorFlowKerasState
+from ..elastic import run  # noqa: F401
+from ..tensorflow.elastic import TensorFlowKerasState
 
 
 class KerasState(TensorFlowKerasState):
     """State of a Keras model and optimizer for elastic training
-    (reference: horovod/tensorflow/keras/elastic.py:22)."""
+    (reference: horovod/keras/elastic.py:22)."""
 
 
 _LAZY = ("CommitStateCallback", "UpdateBatchStateCallback",
@@ -23,6 +23,6 @@ def __getattr__(name):
     AttributeError without importing keras."""
     if name not in _LAZY:
         raise AttributeError(name)
-    from ..._keras.elastic import make_elastic_callbacks
+    from .._keras.elastic import make_elastic_callbacks
     globals().update(zip(_LAZY, make_elastic_callbacks()))
     return globals()[name]

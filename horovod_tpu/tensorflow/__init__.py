@@ -37,8 +37,34 @@ cross_rank = basics.cross_rank
 cross_size = basics.cross_size
 is_homogeneous = basics.is_homogeneous
 mpi_enabled = basics.mpi_enabled
+mpi_built = basics.mpi_built
+mpi_threads_supported = basics.mpi_threads_supported
 gloo_enabled = basics.gloo_enabled
+gloo_built = basics.gloo_built
 nccl_built = basics.nccl_built
+ddl_built = basics.ddl_built
+ccl_built = basics.ccl_built
+cuda_built = basics.cuda_built
+rocm_built = basics.rocm_built
+
+from . import elastic  # noqa: E402,F401  (hvd.elastic.TensorFlowKerasState)
+
+
+def gpu_available():
+    """Reference: horovod/tensorflow/__init__.py gpu_available — here
+    'accelerator available': True when a TPU (or other non-CPU XLA
+    device) backs the runtime."""
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def check_num_rank_power_of_2(num_ranks):
+    """Reference: horovod/tensorflow/__init__.py:138-154 (Adasum's
+    power-of-2 rank requirement)."""
+    if num_ranks == 0 or num_ranks & (num_ranks - 1):
+        raise ValueError(
+            "Adasum allreduce requires a power-of-2 number of ranks; "
+            f"got {num_ranks}")
 
 
 def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
@@ -74,6 +100,33 @@ def _spmd():
     horovod_tpu.jax instead)."""
     rt = basics.runtime()
     return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
+
+
+# Graph-op variants (reference: horovod/tensorflow/mpi_ops.cc:1189-1218
+# rank/size query ops usable inside graphs). Rank/size are fixed for a
+# process's lifetime, so a captured constant has identical semantics to
+# the reference's kernel — and re-traces cannot change it mid-job.
+def rank_op(name=None):
+    return _tf().constant(rank(), name=name or "horovod_rank")
+
+
+def local_rank_op(name=None):
+    return _tf().constant(local_rank(), name=name or "horovod_local_rank")
+
+
+def size_op(name=None):
+    return _tf().constant(size(), name=name or "horovod_size")
+
+
+def local_size_op(name=None):
+    return _tf().constant(local_size(), name=name or "horovod_local_size")
+
+
+def process_set_included_op(process_set=global_process_set, name=None):
+    """1 when this rank belongs to process_set, else 0 (reference:
+    horovod/tensorflow/mpi_ops.py process_set_included_op)."""
+    return _tf().constant(1 if process_set.included() else 0,
+                          name=name or "horovod_process_set_included")
 
 
 def _np_of(tensor):
@@ -225,9 +278,30 @@ def reducescatter(tensor, op=None, name=None,
     return _eager(fn, [tensor], [tensor.dtype], name)[0]
 
 
+def broadcast_(variable, root_rank, name=None,
+               process_set=global_process_set):
+    """In-place broadcast into a tf.Variable (reference:
+    horovod/tensorflow/mpi_ops.cc:1026-1073 HorovodBroadcastInplace).
+    Returns the variable."""
+    out = broadcast(variable.read_value() if hasattr(variable,
+                                                     "read_value")
+                    else variable, root_rank, name=name,
+                    process_set=process_set)
+    variable.assign(out)
+    return variable
+
+
 def broadcast_object(obj, root_rank=0, name=None):
     from ..functions import broadcast_object as _bo
     return _bo(obj, root_rank=root_rank, name=name)
+
+
+def broadcast_object_fn(root_rank=0, name=None):
+    """Reference: horovod/tensorflow/functions.py broadcast_object_fn —
+    returns a callable capturing root_rank/name."""
+    def _fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name)
+    return _fn
 
 
 def allgather_object(obj, name=None):
@@ -500,3 +574,14 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
         log.info("tensorflow DistributedOptimizer wrapping %s over %d "
                  "ranks", cls.__name__, size())
     return opt
+
+
+def __getattr__(name):
+    # SyncBatchNormalization lives in its own module and subclasses a
+    # keras Layer; resolve it lazily so importing the binding never
+    # imports tensorflow/keras (cached in globals for identity).
+    if name == "SyncBatchNormalization":
+        from .sync_batch_norm import SyncBatchNormalization
+        globals()[name] = SyncBatchNormalization
+        return SyncBatchNormalization
+    raise AttributeError(name)

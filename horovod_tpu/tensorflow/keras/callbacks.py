@@ -30,19 +30,20 @@ def _best_model_checkpoint():
     return BestModelCheckpoint
 
 
+_LAZY = ("BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+         "LearningRateWarmupCallback", "LearningRateScheduleCallback")
+
+
 def __getattr__(name):
     """Lazy class creation, cached in module globals so repeated access
-    returns the SAME class (isinstance/identity checks must hold)."""
-    (bgv, ma, warmup, sched) = _make()
-    mapping = {
-        "BroadcastGlobalVariablesCallback": bgv,
-        "MetricAverageCallback": ma,
-        "LearningRateWarmupCallback": warmup,
-        "LearningRateScheduleCallback": sched,
-    }
+    returns the SAME class (isinstance/identity checks must hold). The
+    name check comes FIRST so attribute probes for other names raise
+    AttributeError without importing keras."""
     if name == "BestModelCheckpoint":
-        mapping[name] = _best_model_checkpoint()
-    if name in mapping:
-        globals().update(mapping)
-        return globals()[name]
-    raise AttributeError(name)
+        cls = _best_model_checkpoint()
+        globals()[name] = cls
+        return cls
+    if name not in _LAZY:
+        raise AttributeError(name)
+    globals().update(zip(_LAZY, _make()))
+    return globals()[name]

@@ -33,6 +33,17 @@ Product = reduce_ops.Product
 init = basics.init
 shutdown = basics.shutdown
 is_initialized = basics.is_initialized
+is_homogeneous = basics.is_homogeneous
+mpi_enabled = basics.mpi_enabled
+mpi_built = basics.mpi_built
+mpi_threads_supported = basics.mpi_threads_supported
+gloo_enabled = basics.gloo_enabled
+gloo_built = basics.gloo_built
+nccl_built = basics.nccl_built
+ddl_built = basics.ddl_built
+ccl_built = basics.ccl_built
+cuda_built = basics.cuda_built
+rocm_built = basics.rocm_built
 
 
 def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
@@ -363,6 +374,70 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
             arrs, op=op, name=name, process_set=process_set))
 
 
+def _grouped_allreduce_async_impl(tensors, average, name, op, process_set,
+                                  inplace, prescale=1.0, postscale=1.0):
+    if op is None:
+        op = Sum if average is False else Average
+    tensors = list(tensors)
+    if not tensors or not _spmd():
+        scale = (prescale or 1.0) * (postscale or 1.0)
+        if scale != 1.0:
+            if inplace:
+                for t in tensors:
+                    t.mul_(scale)
+            else:
+                tensors = [t * scale for t in tensors]
+        return _local_handle(tensors)
+    marsh = [_to_np(t) for t in tensors]
+    # Submitted now (async enqueue); the torch-side unmarshal runs at
+    # synchronize(), like the single-tensor handles.
+    inner = _c.grouped_allreduce_async([m[0] for m in marsh], op=op,
+                                       name=name,
+                                       prescale_factor=prescale or 1.0,
+                                       postscale_factor=postscale or 1.0,
+                                       process_set=process_set)
+
+    def resolve():
+        outs = _c.synchronize(inner)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        res = [_from_np(np.asarray(o), t, b)
+               for o, t, (_, b) in zip(outs, tensors, marsh)]
+        if inplace:
+            for t, r in zip(tensors, res):
+                t.copy_(r)
+            return tensors
+        return res
+
+    return _LazyHandle(resolve)
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=global_process_set):
+    """Handle-based grouped allreduce (reference:
+    horovod/torch/mpi_ops.py:375 grouped_allreduce_async)."""
+    return _grouped_allreduce_async_impl(tensors, average, name, op,
+                                         process_set, False,
+                                         prescale_factor, postscale_factor)
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set=global_process_set):
+    return _grouped_allreduce_async_impl(tensors, average, name, op,
+                                         process_set, True,
+                                         prescale_factor, postscale_factor)
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=global_process_set):
+    return synchronize(grouped_allreduce_async_(
+        tensors, average, name, op, prescale_factor, postscale_factor,
+        process_set=process_set))
+
+
 def _grouped_call(tensors, call):
     """Shared torch<->numpy marshalling for grouped collectives: one
     place for the dtype/device round-trip (and safe for iterator
@@ -665,3 +740,23 @@ def tpu_compile(module, input_names=None, example_inputs=None,
     return _impl(module, input_names=input_names,
                  example_inputs=example_inputs, loss_key=loss_key,
                  compute_dtype=compute_dtype)
+
+
+def __getattr__(name):
+    # Lazy submodule/class exports (reference surface: horovod/torch
+    # exposes SyncBatchNorm and the elastic submodule at top level);
+    # resolved on demand so importing the binding never imports torch,
+    # and cached in globals for identity.
+    if name == "SyncBatchNorm":
+        from .sync_batch_norm import SyncBatchNorm
+        globals()[name] = SyncBatchNorm
+        return SyncBatchNorm
+    if name == "elastic":
+        # importlib, not `from . import`: the from-import form checks
+        # hasattr(package, "elastic") mid-import and re-enters this
+        # __getattr__ forever.
+        import importlib
+        mod = importlib.import_module(".elastic", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

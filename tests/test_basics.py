@@ -117,19 +117,38 @@ def test_checkpoint_save_restore(hvd, tmp_path):
     assert ckpt.restore_latest(tmp_path / "empty") == (None, None)
 
 
-def test_binding_surface_parity():
+_SHARED_SURFACE = ["start_timeline", "stop_timeline", "ProcessSet",
+                   "global_process_set", "add_process_set",
+                   "remove_process_set", "Compression", "init",
+                   "shutdown", "rank", "size", "elastic", "mpi_built",
+                   "mpi_threads_supported", "gloo_built", "nccl_built",
+                   "ddl_built", "ccl_built", "cuda_built", "rocm_built"]
+
+
+@pytest.mark.parametrize("mod_name,required,extra", [
+    ("horovod_tpu.torch", "torch",
+     ["SyncBatchNorm", "grouped_allreduce_", "grouped_allreduce_async",
+      "grouped_allreduce_async_"]),
+    ("horovod_tpu.tensorflow", "tensorflow",
+     ["SyncBatchNormalization", "broadcast_", "broadcast_object_fn",
+      "rank_op", "size_op", "local_rank_op", "local_size_op",
+      "process_set_included_op", "gpu_available",
+      "check_num_rank_power_of_2"]),
+])
+def test_binding_surface_parity(mod_name, required, extra):
     """Every framework binding re-exports the shared runtime surface the
     reference exposes per binding (reference: horovod/torch/__init__.py:
-    48-53 — timeline start/stop + process-set API + Compression)."""
+    48-53 — timeline start/stop + process-set API + Compression).
+    Parametrized so a missing framework skips only its own row."""
     import importlib
-    for mod_name, required in [
-        ("horovod_tpu.torch", "torch"),
-        ("horovod_tpu.tensorflow", "tensorflow"),
-    ]:
-        pytest.importorskip(required)
-        m = importlib.import_module(mod_name)
-        for name in ["start_timeline", "stop_timeline", "ProcessSet",
-                     "global_process_set", "add_process_set",
-                     "remove_process_set", "Compression", "init",
-                     "shutdown", "rank", "size"]:
-            assert hasattr(m, name), (mod_name, name)
+    pytest.importorskip(required)
+    m = importlib.import_module(mod_name)
+    for name in _SHARED_SURFACE + extra:
+        assert hasattr(m, name), (mod_name, name)
+
+
+def test_keras_elastic_surface():
+    pytest.importorskip("keras")
+    import horovod_tpu.keras as hk
+    assert hasattr(hk.elastic, "KerasState")
+    assert not hasattr(hk.elastic, "definitely_not_a_name")

@@ -51,6 +51,20 @@ def main():
     np.testing.assert_allclose(outs[0].numpy(), s)
     np.testing.assert_allclose(outs[1].numpy(), 2.0 * s)
 
+    # handle-based grouped variants (reference: mpi_ops.py:375)
+    h = hvd.grouped_allreduce_async(
+        [torch.ones(2) * r, torch.ones(3) * 2 * r], op=hvd.Sum,
+        name="gar.async")
+    aouts = hvd.synchronize(h)
+    np.testing.assert_allclose(aouts[0].numpy(), s)
+    np.testing.assert_allclose(aouts[1].numpy(), 2.0 * s)
+    ta, tb = torch.ones(2) * r, torch.ones(3, dtype=torch.float64) * 2 * r
+    iouts = hvd.grouped_allreduce_([ta, tb], op=hvd.Sum, name="gar.inp")
+    assert iouts[0] is ta and iouts[1] is tb   # in-place write-back
+    assert tb.dtype == torch.float64           # dtype restored
+    np.testing.assert_allclose(ta.numpy(), s)
+    np.testing.assert_allclose(tb.numpy(), 2.0 * s)
+
     # -- grouped allgather / reducescatter -----------------------------------
     gg = hvd.grouped_allgather([torch.full((r + 1, 2), float(r)),
                                 torch.full((1,), float(r))], name="gag")
