@@ -68,7 +68,8 @@ def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
     rt = basics.runtime()
     if rt.timeline is not None:
         rt.timeline.stop()
-    rt.timeline = Timeline(file_path, jax_profiler_dir=jax_profiler_dir)
+    rt.timeline = Timeline(file_path, jax_profiler_dir=jax_profiler_dir,
+                           mark_cycles=mark_cycles)
     rt.timeline.start()
 
 

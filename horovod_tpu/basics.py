@@ -198,7 +198,10 @@ def init(comm=None, process_sets=None):
         timeline_path = envparse.get_str(envparse.TIMELINE, "")
         if timeline_path:
             from .timeline import Timeline
-            runtime.timeline = Timeline(timeline_path)
+            runtime.timeline = Timeline(
+                timeline_path,
+                mark_cycles=envparse.get_bool(
+                    envparse.TIMELINE_MARK_CYCLES))
             runtime.timeline.start()
 
         _runtime = runtime

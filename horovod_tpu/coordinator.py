@@ -197,8 +197,18 @@ class Coordinator:
             for e in batch:
                 backend.submit_entry(e)
             self.cycles += 1
-            self.tensors_processed += backend.run_cycle()
+            cycle_ts_us = time.perf_counter_ns() // 1000
+            processed = backend.run_cycle()
+            self.tensors_processed += processed
             self.bytes_processed = backend.core.bytes_processed()
+            timeline = self.runtime.timeline
+            if (processed and timeline is not None
+                    and timeline.mark_cycles):
+                # Mark only cycles that moved tensors (the native loop
+                # polls continuously; idle ticks would flood the trace) —
+                # stamped with the PRE-run_cycle time so the instant
+                # aligns with the cycle's start like the python plane.
+                timeline.marker("CYCLE_START", ts_us=cycle_ts_us)
             if self.runtime.autotuner is not None:
                 # Candidate switches are cycle-count driven so every rank
                 # applies the same knob at the same negotiation round.
@@ -214,6 +224,8 @@ class Coordinator:
         if self.runtime.autotuner is not None:
             self.runtime.autotuner.record_cycle()
         timeline = self.runtime.timeline
+        if timeline is not None and timeline.mark_cycles:
+            timeline.marker("CYCLE_START")
         backend = self.runtime.backend
         # Group allreduces for fusion; run everything else in order.
         fusible = [e for e in batch if e.kind == "allreduce"]

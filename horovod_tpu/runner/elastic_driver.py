@@ -187,7 +187,9 @@ class ElasticDriver:
         proc = spawn.SlotProcess(
             slot, self.command, env,
             prefix_output=self.elastic.base.prefix_output,
-            output_dir=self.elastic.base.output_filename)
+            output_dir=self.elastic.base.output_filename,
+            ssh_port=self.elastic.base.ssh_port,
+            ssh_identity_file=self.elastic.base.ssh_identity_file)
         self.workers[worker_id] = _Worker(worker_id, host, slot_index, proc)
 
     def _reconcile(self, targets):

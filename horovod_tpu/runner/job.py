@@ -21,7 +21,8 @@ class Settings:
 
     def __init__(self, num_proc=1, hosts=None, hostfile=None,
                  start_timeout=120, verbose=False, prefix_output=True,
-                 env=None, rendezvous_addr=None, output_filename=None):
+                 env=None, rendezvous_addr=None, output_filename=None,
+                 ssh_port=None, ssh_identity_file=None):
         self.num_proc = num_proc
         self.hosts = hosts
         self.hostfile = hostfile
@@ -33,6 +34,10 @@ class Settings:
         # Directory for per-rank rank.N/stdout|stderr capture (reference:
         # horovodrun --output-filename).
         self.output_filename = output_filename
+        # Remote-spawn ssh options (reference: horovodrun --ssh-port /
+        # --ssh-identity-file).
+        self.ssh_port = ssh_port
+        self.ssh_identity_file = ssh_identity_file
 
     def resolve_hosts(self):
         if self.hosts:
@@ -74,7 +79,9 @@ def launch_job(settings, command):
             })
             procs.append(spawn.SlotProcess(
                 slot, command, env, prefix_output=settings.prefix_output,
-                output_dir=settings.output_filename))
+                output_dir=settings.output_filename,
+                ssh_port=settings.ssh_port,
+                ssh_identity_file=settings.ssh_identity_file))
 
         return _monitor(procs, settings)
     finally:

@@ -30,6 +30,19 @@ def parse_args(argv=None):
                         help="comma-separated host:slots list")
     parser.add_argument("--hostfile", default=None,
                         help="file with one 'host slots=N' per line")
+    parser.add_argument("--version", action="store_true", dest="version",
+                        help="print the horovod_tpu version and exit")
+    parser.add_argument("--ssh-port", type=int, default=None,
+                        help="ssh port for remote worker spawn "
+                             "(reference: horovodrun --ssh-port)")
+    parser.add_argument("--ssh-identity-file", default=None,
+                        help="ssh identity (private key) file for remote "
+                             "worker spawn")
+    parser.add_argument("--network-interface", default=None,
+                        help="network interface the driver advertises for "
+                             "rendezvous (reference: horovodrun "
+                             "--network-interface; default: routed "
+                             "automatically)")
     parser.add_argument("--start-timeout", type=int, default=120,
                         help="seconds workers may take to rendezvous")
     parser.add_argument("--verbose", action="store_true")
@@ -58,6 +71,16 @@ def parse_args(argv=None):
     parser.add_argument("--cycle-time-ms", type=float, default=None)
     parser.add_argument("--cache-capacity", type=int, default=None)
     parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--timeline-mark-cycles", action="store_true",
+                        help="drop an instant event per negotiation cycle "
+                             "into the timeline")
+    parser.add_argument("--hierarchical-threshold-mb", type=float,
+                        default=None,
+                        help="min buffer MiB before multi-host collectives "
+                             "take the two-level intra/cross-host path; 0 "
+                             "disables (this design's single knob behind "
+                             "the reference's --hierarchical-allreduce/"
+                             "--hierarchical-allgather pair)")
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--autotune-log-file", default=None)
     parser.add_argument("--log-level", default=None)
@@ -72,7 +95,7 @@ def parse_args(argv=None):
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the training command to run on every slot")
     args = parser.parse_args(argv)
-    if args.check_build:
+    if args.check_build or args.version:
         return args
     if not args.command:
         parser.error("no command given")
@@ -162,6 +185,11 @@ def _knob_env(args):
         env["HVDTPU_CACHE_CAPACITY"] = str(args.cache_capacity)
     if args.timeline_filename:
         env["HVDTPU_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HVDTPU_TIMELINE_MARK_CYCLES"] = "1"
+    if args.hierarchical_threshold_mb is not None:
+        env["HVDTPU_HIERARCHICAL_THRESHOLD"] = str(
+            int(args.hierarchical_threshold_mb * 1024 * 1024))
     if args.autotune:
         env["HVDTPU_AUTOTUNE"] = "1"
     if args.autotune_log_file:
@@ -177,6 +205,32 @@ def _knob_env(args):
         env["HVDTPU_STALL_SHUTDOWN_TIME_SECONDS"] = str(
             args.stall_shutdown_time_seconds)
     return env
+
+
+def _iface_addr(iface):
+    """IPv4 address of a named interface (reference: horovodrun
+    --network-interface NIC pinning). None passes through — the driver
+    then routes automatically (rendezvous.py _local_ip_towards)."""
+    if not iface:
+        return None
+    import fcntl
+    import socket
+    import struct
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # SIOCGIFADDR; ifreq packs the interface name in the first 16
+        # bytes, the sockaddr_in's address at offset 20.
+        packed = fcntl.ioctl(
+            s.fileno(), 0x8915,
+            struct.pack("256s", iface.encode()[:15]))
+        return socket.inet_ntoa(packed[20:24])
+    except OSError as e:
+        raise SystemExit(
+            f"--network-interface {iface!r}: cannot resolve an IPv4 "
+            f"address ({e}); check `ip -4 addr` for available "
+            "interfaces")
+    finally:
+        s.close()
 
 
 def check_build():
@@ -212,13 +266,20 @@ def check_build():
 
 def run_commandline(argv=None):
     args = parse_args(argv)
+    if args.version:
+        from ..version import __version__
+        print(__version__, flush=True)
+        return 0
     if args.check_build:
         return check_build()
     settings = Settings(
         num_proc=args.num_proc, hosts=args.hosts, hostfile=args.hostfile,
         start_timeout=args.start_timeout, verbose=args.verbose,
         prefix_output=not args.disable_prefix_output, env=_knob_env(args),
-        output_filename=args.output_filename)
+        output_filename=args.output_filename,
+        rendezvous_addr=_iface_addr(args.network_interface),
+        ssh_port=args.ssh_port,
+        ssh_identity_file=args.ssh_identity_file)
     if args.host_discovery_script or args.min_np or args.max_np:
         from .elastic_driver import ElasticSettings, launch_elastic_job
         elastic = ElasticSettings(

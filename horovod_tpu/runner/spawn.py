@@ -87,7 +87,7 @@ class SlotProcess:
     """One spawned worker with its output pumps."""
 
     def __init__(self, slot, command, env, prefix_output=True,
-                 output_dir=None):
+                 output_dir=None, ssh_port=None, ssh_identity_file=None):
         self.slot = slot
         if is_local(slot.hostname):
             full_env = dict(os.environ)
@@ -103,8 +103,13 @@ class SlotProcess:
                                for k, v in env.items())
             remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
                 " ".join(shlex.quote(c) for c in command)
+            ssh_cmd = ["ssh", "-o", "BatchMode=yes"]
+            if ssh_port:
+                ssh_cmd += ["-p", str(ssh_port)]
+            if ssh_identity_file:
+                ssh_cmd += ["-i", ssh_identity_file]
             self.proc = subprocess.Popen(
-                ["ssh", "-o", "BatchMode=yes", slot.hostname, remote],
+                ssh_cmd + [slot.hostname, remote],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 start_new_session=True)
         rank = slot.rank

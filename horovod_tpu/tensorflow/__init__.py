@@ -278,17 +278,23 @@ def reducescatter(tensor, op=None, name=None,
     return _eager(fn, [tensor], [tensor.dtype], name)[0]
 
 
-def broadcast_(variable, root_rank, name=None,
+def broadcast_(variables, root_rank, name=None,
                process_set=global_process_set):
-    """In-place broadcast into a tf.Variable (reference:
-    horovod/tensorflow/mpi_ops.cc:1026-1073 HorovodBroadcastInplace).
-    Returns the variable."""
-    out = broadcast(variable.read_value() if hasattr(variable,
-                                                     "read_value")
-                    else variable, root_rank, name=name,
-                    process_set=process_set)
-    variable.assign(out)
-    return variable
+    """In-place broadcast into tf.Variables (reference:
+    horovod/tensorflow/mpi_ops.py:301 ``broadcast_(variables, ...)`` —
+    takes a LIST of variables; a single variable is accepted too).
+    Returns the updated values (list in, list out)."""
+    single = not isinstance(variables, (list, tuple))
+    var_list = [variables] if single else list(variables)
+    outs = []
+    for i, v in enumerate(var_list):
+        nm = f"{name}.{i}" if name and not single else name
+        out = broadcast(v.read_value() if hasattr(v, "read_value")
+                        else v, root_rank, name=nm,
+                        process_set=process_set)
+        v.assign(out)
+        outs.append(v)
+    return outs[0] if single else outs
 
 
 def broadcast_object(obj, root_rank=0, name=None):

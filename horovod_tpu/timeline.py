@@ -15,8 +15,11 @@ import time
 
 
 class Timeline:
-    def __init__(self, path, jax_profiler_dir=None):
+    def __init__(self, path, jax_profiler_dir=None, mark_cycles=False):
         self.path = path
+        # When set, the coordinator drops an instant event per negotiation
+        # cycle (reference: --timeline-mark-cycles / MarkCycle events).
+        self.mark_cycles = bool(mark_cycles)
         self._queue = queue.Queue()
         self._thread = None
         self._running = False
@@ -41,10 +44,14 @@ class Timeline:
             self._queue.put(("E", tuple(names), activity,
                              time.perf_counter_ns() // 1000))
 
-    def marker(self, name):
+    def marker(self, name, ts_us=None):
+        """Instant event; ``ts_us`` lets a caller stamp a time captured
+        earlier (the native cycle marker records the cycle's START but is
+        emitted after the cycle ran, once it knows work happened)."""
         if self._running:
             self._queue.put(("I", (name,), name,
-                             time.perf_counter_ns() // 1000))
+                             ts_us if ts_us is not None
+                             else time.perf_counter_ns() // 1000))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

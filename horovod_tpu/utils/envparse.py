@@ -67,6 +67,7 @@ FUSION_THRESHOLD = "FUSION_THRESHOLD"          # bytes, default 128 MiB
 CYCLE_TIME = "CYCLE_TIME"                      # ms, default 1.0
 CACHE_CAPACITY = "CACHE_CAPACITY"              # default 1024
 TIMELINE = "TIMELINE"                          # path to chrome-trace json
+TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"  # instant event per cycle
 LOG_LEVEL = "LOG_LEVEL"
 STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
 STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"

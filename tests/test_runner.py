@@ -293,3 +293,59 @@ def test_run_command_multi_host_topology():
     rc = run_command([sys.executable, "-c", script], num_proc=2,
                      hosts="localhost:1,127.0.0.1:1", env=_worker_env())
     assert rc == 0
+
+
+def test_new_launcher_flags():
+    """Round-4 flag additions mapped from the reference's horovodrun
+    surface: --version, --timeline-mark-cycles, ssh options,
+    --hierarchical-threshold-mb, --network-interface."""
+    from horovod_tpu.runner.launch import parse_args, _knob_env, \
+        _iface_addr
+
+    args = parse_args(["--timeline-mark-cycles",
+                       "--hierarchical-threshold-mb", "2",
+                       "--ssh-port", "2222",
+                       "--ssh-identity-file", "/tmp/key",
+                       "echo", "hi"])
+    env = _knob_env(args)
+    assert env["HVDTPU_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HVDTPU_HIERARCHICAL_THRESHOLD"] == str(2 * 1024 * 1024)
+    assert args.ssh_port == 2222
+    assert args.ssh_identity_file == "/tmp/key"
+
+    # --version parses without a command.
+    args = parse_args(["--version"])
+    assert args.version
+
+    # Loopback interface resolves; a bogus one fails loud.
+    assert _iface_addr(None) is None
+    assert _iface_addr("lo") == "127.0.0.1"
+    import pytest as _pytest
+    with _pytest.raises(SystemExit, match="no-such-iface"):
+        _iface_addr("no-such-iface")
+
+
+def test_version_prints_and_exits(capsys):
+    from horovod_tpu.runner.launch import run_commandline
+    import horovod_tpu
+    rc = run_commandline(["--version"])
+    assert rc == 0
+    assert horovod_tpu.__version__ in capsys.readouterr().out
+
+
+def test_timeline_mark_cycles_emits_markers(tmp_path):
+    """start_timeline(mark_cycles=True) drops CYCLE_START instants when
+    host-plane cycles move tensors (previously a dead parameter)."""
+    import json
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    trace = tmp_path / "tl.json"
+    hvd.start_timeline(str(trace), mark_cycles=True)
+    # Single-mode inputs are stacked: leading axis = virtual ranks.
+    hvd.allreduce(np.zeros((len(jax.devices()), 2), np.float32),
+                  op=hvd.Sum, name="tlmc")
+    hvd.stop_timeline()
+    events = json.loads(trace.read_text())
+    assert any(e.get("name") == "CYCLE_START" for e in events), events
