@@ -58,16 +58,22 @@ def __getattr__(name):
         f"module {__name__!r} has no attribute {name!r}")
 
 
-def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
+def start_timeline(file_path, mark_cycles=None, jax_profiler_dir=None):
     """Start recording a Chrome-trace timeline at runtime (reference:
     horovod/common/basics.py:156 start_timeline). ``jax_profiler_dir``
     additionally captures a jax.profiler device trace alongside the host
-    timeline (the TPU analog of the reference's NVTX ranges)."""
+    timeline (the TPU analog of the reference's NVTX ranges).
+    ``mark_cycles`` defaults to the HVDTPU_TIMELINE_MARK_CYCLES env knob
+    (hvdrun --timeline-mark-cycles) so the launcher flag applies to
+    runtime-started timelines too."""
     from . import basics
     from .timeline import Timeline
+    from .utils import envparse
     rt = basics.runtime()
     if rt.timeline is not None:
         rt.timeline.stop()
+    if mark_cycles is None:
+        mark_cycles = envparse.get_bool(envparse.TIMELINE_MARK_CYCLES)
     rt.timeline = Timeline(file_path, jax_profiler_dir=jax_profiler_dir,
                            mark_cycles=mark_cycles)
     rt.timeline.start()

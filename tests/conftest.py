@@ -54,6 +54,7 @@ _SLOW_NAMES = {
     "test_transformer_remat_variants_run",
     "test_keras_applications_model_on_mesh",
     "test_keras_applications_through_bridge",
+    "test_fsdp_training_matches_replicated",
 }
 
 

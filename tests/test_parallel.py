@@ -393,3 +393,73 @@ def test_gspmd_sharded_matmul_matches_replicated():
     out = jax.jit(f)(sharded, x)
     ref = f(params, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_fsdp_training_matches_replicated():
+    """ZeRO-3/FSDP end to end: parameters stored SHARDED along the fsdp
+    axis (transformer_param_rules fsdp_axis), the jitted train step
+    all-gathers them at use and reduce-scatters gradients — XLA inserts
+    the collectives from the shardings (the scaling-book recipe). Oracle:
+    the same steps on replicated params must give identical losses and
+    parameters."""
+    import optax
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                TransformerLM)
+    from horovod_tpu.parallel.sharding import (batch_spec,
+                                               make_param_specs)
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    cfg = TransformerConfig(vocab_size=128, hidden=32, layers=2, heads=2,
+                            max_len=16, dtype=jnp.float32, causal=True,
+                            use_rope=True)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))
+    specs = make_param_specs(params, mesh)
+    # The point of the test is SHARDED storage: at least one big kernel
+    # must actually carry the fsdp axis.
+    flat_specs = jax.tree.leaves(specs, is_leaf=lambda s: isinstance(s, P))
+    assert any("fsdp" in str(s) for s in flat_specs), flat_specs
+
+    opt = optax.adamw(1e-2)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    def step(p, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        updates, opt_state = opt.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randint(0, 128, size=(8, 16)))
+    y = jnp.asarray(rng.randint(0, 128, size=(8, 16)))
+
+    # Sharded run: params placed per spec, batch split over dp x fsdp.
+    p_shard = jax.tree.map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        params, specs)
+    opt_state = opt.init(p_shard)
+    bspec = NamedSharding(mesh, batch_spec(extra_dims=1))
+    xb = jax.device_put(x, bspec)
+    yb = jax.device_put(y, bspec)
+    jstep = jax.jit(step)
+    losses = []
+    for _ in range(3):
+        p_shard, opt_state, loss = jstep(p_shard, opt_state, (xb, yb))
+        losses.append(float(loss))
+
+    # Replicated oracle on one device.
+    p_ref, s_ref = params, opt.init(params)
+    ref_losses = []
+    for _ in range(3):
+        p_ref, s_ref, loss = step(p_ref, s_ref, (x, y))
+        ref_losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_shard), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

@@ -73,6 +73,18 @@ def main():
     hvd.broadcast_variables([v], root_rank=0)
     np.testing.assert_allclose(v.numpy(), 7.0)
 
+    # -- in-place broadcast_: list of variables (the reference signature,
+    # mpi_ops.py:301) and single-variable convenience ---------------------
+    vs = [tf.Variable(tf.fill([2], float(r + 1))),
+          tf.Variable(float(10 * r + 5))]
+    outs_b = hvd.broadcast_(vs, 1, name="bip")
+    assert outs_b[0] is vs[0] and outs_b[1] is vs[1]
+    np.testing.assert_allclose(vs[0].numpy(), 2.0)
+    np.testing.assert_allclose(float(vs[1]), 15.0)
+    single_v = tf.Variable(tf.fill([3], float(r)))
+    assert hvd.broadcast_(single_v, 0, name="bip1") is single_v
+    np.testing.assert_allclose(single_v.numpy(), 0.0)
+
     # -- DistributedGradientTape training (linear regression) -------------
     rng = np.random.RandomState(1234)      # shared truth
     w_true = rng.randn(4, 1).astype(np.float32)
