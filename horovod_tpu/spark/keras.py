@@ -51,7 +51,8 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
                    loss=None, metrics=None, custom_objects=None,
                    validation=None, callbacks=None,
                    train_steps_per_epoch=None, shuffle_seed=0, verbose=0,
-                   train_path=None):
+                   train_path=None, compression=None,
+                   backward_passes_per_step=1):
     """Train one rank's shard of a materialized parquet dataset; the
     executor-side body of ``KerasEstimator.fit`` (reference:
     horovod/spark/keras/remote.py:31 ``RemoteTrainer``).
@@ -76,8 +77,11 @@ def fit_on_parquet(store_prefix, run_id, model_bytes, feature_cols,
     model = deserialize_model(model_bytes, custom_objects)
     import keras
     opt = keras.optimizers.get(optimizer or "adam")
-    model.compile(optimizer=hvd.DistributedOptimizer(opt), loss=loss,
-                  metrics=list(metrics or []))
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            opt, compression=compression,
+            backward_passes_per_step=backward_passes_per_step),
+        loss=loss, metrics=list(metrics or []))
 
     val_rows = 0
     n_rows = shard.num_rows
@@ -208,7 +212,8 @@ class KerasEstimator:
                  metrics=None, feature_cols=None, label_cols=None,
                  batch_size=32, epochs=1, num_proc=None, validation=None,
                  callbacks=None, custom_objects=None, run_id=None,
-                 train_steps_per_epoch=None, verbose=1):
+                 train_steps_per_epoch=None, verbose=1, compression=None,
+                 backward_passes_per_step=1):
         if model is None or store is None:
             raise ValueError("KerasEstimator requires model= and store=")
         if not feature_cols or not label_cols:
@@ -230,6 +235,8 @@ class KerasEstimator:
         self.run_id = run_id or f"run_{uuid.uuid4().hex[:8]}"
         self.train_steps_per_epoch = train_steps_per_epoch
         self.verbose = verbose
+        self.compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
 
     def fit(self, df):
         require_pyspark("KerasEstimator.fit")
@@ -256,7 +263,9 @@ class KerasEstimator:
                 validation=self.validation,
                 callbacks=self.callbacks,
                 train_steps_per_epoch=self.train_steps_per_epoch,
-                verbose=self.verbose),
+                verbose=self.verbose,
+                compression=self.compression,
+                backward_passes_per_step=self.backward_passes_per_step),
             num_proc=num_proc)
         return self.load(self.store, self.run_id,
                          feature_cols=self.feature_cols,

@@ -69,7 +69,8 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
                          epochs=1, validation=None,
                          train_steps_per_epoch=None, shuffle_seed=0,
                          verbose=0, train_path=None,
-                         feature_dtype="float32", label_dtype=None):
+                         feature_dtype="float32", label_dtype=None,
+                         compression=None, backward_passes_per_step=1):
     """Train one rank's shard; the executor body of
     ``TorchEstimator.fit`` (reference: horovod/spark/torch/remote.py:100
     ``train``). Returns {'loss': [...], 'val_loss': [...]} with metrics
@@ -94,7 +95,9 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
                              else deserialize_torch(opt_spec))
     optimizer = opt_cls(model.parameters(), **opt_defaults)
     optimizer = hvd.DistributedOptimizer(
-        optimizer, named_parameters=model.named_parameters())
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression,
+        backward_passes_per_step=backward_passes_per_step)
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
     hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
@@ -126,6 +129,13 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
             "a rank has 0 training rows after the validation split; "
             "repartition the dataset or lower the validation fraction")
     steps = train_steps_per_epoch or max(1, n_rows // batch_size)
+    k = int(backward_passes_per_step)
+    if k > 1:
+        # The wrapper syncs every k-th backward and expects grads to
+        # accumulate across the window (zero_grad/step only at window
+        # boundaries). Trim the epoch to whole windows so no partially
+        # accumulated, un-synced gradient is ever applied.
+        steps = max(k, (steps // k) * k)
 
     def to_xy(batch):
         xs = [torch.as_tensor(_stack_column(batch[c])).to(
@@ -153,11 +163,18 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
     try:
         for epoch in range(epochs):
             total = 0.0
+            micro = 0
             for x, y in loader:
-                optimizer.zero_grad()
+                if micro % k == 0:
+                    optimizer.zero_grad()
                 loss_val = loss_fn(model(x), y)
                 loss_val.backward()
-                optimizer.step()
+                micro += 1
+                if micro % k == 0:
+                    # k==1: every batch. k>1: the k-th backward fired the
+                    # allreduce over the accumulated grads (postscaled
+                    # 1/k by the wrapper); step() applies the average.
+                    optimizer.step()
                 total += float(loss_val.detach())
             # Cross-rank metric averaging (the MetricAverageCallback analog).
             avg = float(hvd.allreduce(
@@ -250,7 +267,8 @@ class TorchEstimator:
                  feature_cols=None, label_cols=None, batch_size=32,
                  epochs=1, num_proc=None, validation=None, run_id=None,
                  train_steps_per_epoch=None, verbose=1,
-                 feature_dtype="float32", label_dtype=None):
+                 feature_dtype="float32", label_dtype=None,
+                 compression=None, backward_passes_per_step=1):
         if model is None or store is None or optimizer is None:
             raise ValueError(
                 "TorchEstimator requires model=, store= and optimizer=")
@@ -275,6 +293,8 @@ class TorchEstimator:
         self.verbose = verbose
         self.feature_dtype = feature_dtype
         self.label_dtype = label_dtype
+        self.compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
 
     def fit(self, df):
         require_pyspark("TorchEstimator.fit")
@@ -300,7 +320,9 @@ class TorchEstimator:
                 train_steps_per_epoch=self.train_steps_per_epoch,
                 verbose=self.verbose,
                 feature_dtype=self.feature_dtype,
-                label_dtype=self.label_dtype),
+                label_dtype=self.label_dtype,
+                compression=self.compression,
+                backward_passes_per_step=self.backward_passes_per_step),
             num_proc=num_proc)
         return self.load(self.store, self.run_id,
                          feature_cols=self.feature_cols,

@@ -67,7 +67,7 @@ def check_num_rank_power_of_2(num_ranks):
             f"got {num_ranks}")
 
 
-def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
+def start_timeline(file_path, mark_cycles=None, jax_profiler_dir=None):
     """Reference: horovod/common/basics.py:156 start_timeline."""
     from .. import start_timeline as _st
     return _st(file_path, mark_cycles=mark_cycles,

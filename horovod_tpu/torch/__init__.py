@@ -46,7 +46,7 @@ cuda_built = basics.cuda_built
 rocm_built = basics.rocm_built
 
 
-def start_timeline(file_path, mark_cycles=False, jax_profiler_dir=None):
+def start_timeline(file_path, mark_cycles=None, jax_profiler_dir=None):
     """Reference: horovod/torch/mpi_ops.py start_timeline (the shared
     basics API surfaced per binding)."""
     from .. import start_timeline as _st

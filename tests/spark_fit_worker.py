@@ -24,6 +24,8 @@ def main():
     model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
     from horovod_tpu.spark.keras import serialize_model
 
+    from horovod_tpu.ops.compression import Compression
+
     history = fit_on_parquet(
         store_prefix=os.environ["STORE_PREFIX"],
         run_id="testrun",
@@ -36,6 +38,8 @@ def main():
                    "config": {"learning_rate": 0.05}},
         loss="mse",
         validation=0.25,
+        # Estimator-level wire compression (reference estimator param).
+        compression=Compression.bf16,
     )
     assert history["loss"][-1] < history["loss"][0], history
     assert "val_loss" in history, list(history)

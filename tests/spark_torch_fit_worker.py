@@ -35,6 +35,29 @@ def main():
     )
     assert history["loss"][-1] < history["loss"][0], history
     assert "val_loss" in history, list(history)
+
+    # Local gradient aggregation + wire compression through the
+    # estimator body: windows of 2 backwards per applied step; ranks
+    # must stay in lockstep and still converge.
+    from horovod_tpu.ops.compression import Compression
+    torch.manual_seed(int(os.environ["HVDTPU_RANK"]) + 7)
+    model2 = torch.nn.Linear(4, 1)
+    hist2 = fit_on_parquet_torch(
+        store_prefix=os.environ["STORE_PREFIX"],
+        run_id="torchrun_agg",
+        model_bytes=serialize_torch(model2),
+        opt_spec=(torch.optim.Adam, {"lr": 0.05}),
+        loss=lambda out, y: torch.nn.functional.mse_loss(
+            out.squeeze(-1), y.to(out.dtype)),
+        feature_cols=["features"],
+        label_cols=["label"],
+        batch_size=8,
+        epochs=4,
+        backward_passes_per_step=2,
+        compression=Compression.bf16,
+    )
+    assert hist2["loss"][-1] < hist2["loss"][0], hist2
+
     print("HISTORY " + json.dumps(history), flush=True)
 
 
