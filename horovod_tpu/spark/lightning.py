@@ -78,7 +78,8 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
                              epochs=1, validation=None,
                              train_steps_per_epoch=None, shuffle_seed=0,
                              verbose=0, train_path=None,
-                             feature_dtype="float32", label_dtype=None):
+                             feature_dtype="float32", label_dtype=None,
+                             compression=None):
     """Train one rank's shard; the executor body of
     ``LightningEstimator.fit`` (reference:
     horovod/spark/lightning/remote.py:100 ``train``). Returns
@@ -101,7 +102,8 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
     module = deserialize_torch(module_bytes)
     optimizer, schedulers = _resolve_optimizers(module)
     optimizer = hvd.DistributedOptimizer(
-        optimizer, named_parameters=module.named_parameters())
+        optimizer, named_parameters=module.named_parameters(),
+        compression=compression)
     hvd.broadcast_parameters(module.state_dict(), root_rank=0)
     hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
@@ -273,7 +275,8 @@ class LightningEstimator:
                  label_cols=None, batch_size=32, epochs=1, num_proc=None,
                  validation=None, run_id=None,
                  train_steps_per_epoch=None, verbose=1,
-                 feature_dtype="float32", label_dtype=None):
+                 feature_dtype="float32", label_dtype=None,
+                 compression=None):
         if model is None or store is None:
             raise ValueError("LightningEstimator requires model= and "
                              "store=")
@@ -298,6 +301,7 @@ class LightningEstimator:
         self.verbose = verbose
         self.feature_dtype = feature_dtype
         self.label_dtype = label_dtype
+        self.compression = compression
 
     def fit(self, df):
         require_pyspark("LightningEstimator.fit")
@@ -321,7 +325,8 @@ class LightningEstimator:
                 train_steps_per_epoch=self.train_steps_per_epoch,
                 verbose=self.verbose,
                 feature_dtype=self.feature_dtype,
-                label_dtype=self.label_dtype),
+                label_dtype=self.label_dtype,
+                compression=self.compression),
             num_proc=num_proc)
         return self.load(self.store, self.run_id,
                          feature_cols=self.feature_cols,
