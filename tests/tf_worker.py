@@ -183,6 +183,28 @@ def main():
     np.testing.assert_allclose(
         sbn.moving_mean.numpy(), 0.1 * mean, rtol=1e-4, atol=1e-6)
 
+    # -- tpu_compile train step synced across ranks (graph→JAX bridge
+    # over the host plane; single-process parity lives in
+    # test_tf_compile.py) --------------------------------------------------
+    tf.random.set_seed(7)  # same init everywhere; grads sync per step
+    Wt = tf.Variable(tf.random.normal([4, 1], stddev=0.5), name="wt")
+
+    def tf_loss(x, y):
+        return tf.reduce_mean(tf.square(tf.matmul(x, Wt) - y))
+
+    from horovod_tpu.tensorflow import tpu_compile
+    comp = tpu_compile(tf_loss, example_inputs=(X[:8], y[:8]))
+    import optax
+    bridge_step = comp.make_train_step(optax.sgd(0.1))
+    first = last = None
+    for _ in range(20):
+        last = float(bridge_step((X[:32], y[:32])))
+        first = last if first is None else first
+    assert last < first * 0.5, (first, last)
+    all_wb = allgather_object(np.asarray(comp.params["wt:0"]))
+    for wb in all_wb[1:]:
+        np.testing.assert_allclose(wb, all_wb[0], rtol=1e-5)
+
     # -- dtype x op matrix (reference: test_tensorflow.py:128+ sweeps) -----
     float_dtypes = [tf.float16, tf.float32, tf.float64, tf.bfloat16]
     int_dtypes = [tf.uint8, tf.int8, tf.int32, tf.int64]
