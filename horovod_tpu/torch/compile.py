@@ -246,6 +246,7 @@ def _build_function_table():
         torch.exp: jnp.exp, torch.log: jnp.log, torch.sqrt: jnp.sqrt,
         torch.rsqrt: lambda x: 1.0 / jnp.sqrt(x),
         torch.abs: jnp.abs, torch.sigmoid: jax.nn.sigmoid,
+        torch.relu: jax.nn.relu,
         torch.cumsum: lambda x, dim: jnp.cumsum(x, axis=dim),
         torch.clamp: lambda x, min=None, max=None: jnp.clip(x, min, max),
         torch.mean: lambda x, dim=None, keepdim=False: jnp.mean(
@@ -545,6 +546,67 @@ class _JaxInterpreter:
             "_JaxInterpreter._run_module")
 
 
+def _check_trace_fidelity(module, gm, example_inputs):
+    """Eager module vs traced graph on the example inputs (both torch,
+    no jit): catches fx control-flow specialization at compile time."""
+    import torch
+
+    def call(m):
+        with torch.no_grad():
+            if isinstance(example_inputs, dict):
+                return m(**example_inputs)
+            args = (example_inputs if isinstance(example_inputs,
+                                                 (tuple, list))
+                    else (example_inputs,))
+            return m(*args)
+
+    was_training = module.training
+    module.eval()
+    gm.eval()
+    try:
+        ref, traced = call(module), call(gm)
+    finally:
+        module.train(was_training)
+        gm.train(was_training)
+
+    flat_ref, _ = _flatten_out(ref)
+    flat_tr, _ = _flatten_out(traced)
+    if len(flat_ref) != len(flat_tr):
+        raise ValueError(
+            f"fx trace output structure ({len(flat_tr)} tensors) does "
+            f"not match the eager module ({len(flat_ref)}); the trace "
+            "specialized on data-dependent control flow for these "
+            "example_inputs")
+    for i, (a, b) in enumerate(zip(flat_ref, flat_tr)):
+        if torch.is_tensor(a) and torch.is_tensor(b):
+            if not torch.allclose(a.float(), b.float(), rtol=1e-4,
+                                  atol=1e-5):
+                raise ValueError(
+                    f"fx trace diverges from the eager module on "
+                    f"example_inputs (output leaf {i}): tracing "
+                    "specialized data-dependent control flow; restructure "
+                    "the branch with tensor ops or trace a wrapper that "
+                    "pins the intended path")
+
+
+def _flatten_out(out):
+    """Flatten nested dict/list/tuple module outputs to tensor leaves."""
+    if isinstance(out, dict):
+        leaves, keys = [], []
+        for k in sorted(out):
+            sub, _ = _flatten_out(out[k])
+            leaves.extend(sub)
+            keys.append(k)
+        return leaves, keys
+    if isinstance(out, (list, tuple)):
+        leaves = []
+        for v in out:
+            sub, _ = _flatten_out(v)
+            leaves.extend(sub)
+        return leaves, None
+    return [out], None
+
+
 class CompiledModule:
     """A torch module compiled to a jitted JAX callable.
 
@@ -686,6 +748,13 @@ def tpu_compile(module, input_names=None, example_inputs=None,
     HF transformers models are traced with ``transformers.utils.fx``
     (pass ``input_names``); plain ``torch.nn.Module``s go through
     ``torch.fx.symbolic_trace``. Returns a :class:`CompiledModule`.
+
+    ``example_inputs`` (dict of kwargs or tuple of positional args) runs
+    a one-shot trace-fidelity check: fx tracing silently SPECIALIZES
+    data-dependent Python control flow to the traced branch, so the
+    traced graph is compared against the eager module on these inputs
+    and a mismatch fails loudly at compile time instead of training on
+    the wrong branch.
     """
     import torch
 
@@ -698,6 +767,9 @@ def tpu_compile(module, input_names=None, example_inputs=None,
             gm = None
     if gm is None:
         gm = torch.fx.symbolic_trace(module)
+
+    if example_inputs is not None:
+        _check_trace_fidelity(module, gm, example_inputs)
 
     params = {n: _t2j(p) for n, p in module.named_parameters()}
     buffers = {n: _t2j(b) for n, b in module.named_buffers()}

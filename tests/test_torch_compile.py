@@ -228,3 +228,41 @@ def test_custom_causal_lm_parity_and_training():
     losses = [float(step({"ids": ids8}, rng=jax.random.PRNGKey(i)))
               for i in range(5)]
     assert losses[-1] < losses[0], losses
+
+
+def test_example_inputs_trace_fidelity_check():
+    """example_inputs runs an eager-vs-traced parity check at compile
+    time: fx silently specializes data-dependent Python branches, and
+    the check turns that silent wrong-branch training into a loud
+    compile-time error."""
+    import torch
+
+    class Branchy(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(4, 4, bias=False)
+
+        def forward(self, x):
+            # Data-dependent Python branch: fx bakes the traced path
+            # (symbolic tracing takes the bool of a traced value's
+            # .sum(), which torch.fx evaluates on proxies as True).
+            if x.sum() > 0:
+                return {"out": self.lin(x)}
+            return {"out": -self.lin(x)}
+
+    x_neg = torch.full((2, 4), -1.0)
+    with pytest.raises((ValueError, torch.fx.proxy.TraceError)):
+        tpu_compile(Branchy(), example_inputs=(x_neg,))
+
+    # A branch-free module passes the check and stays usable.
+    class Clean(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(4, 2, bias=False)
+
+        def forward(self, x):
+            return {"out": torch.relu(self.lin(x))}
+
+    comp = tpu_compile(Clean(), example_inputs=(torch.ones(3, 4),))
+    out = comp(x=torch.ones(3, 4))
+    assert np.asarray(out["out"]).shape == (3, 2)

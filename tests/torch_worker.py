@@ -267,6 +267,38 @@ def main():
     for p, w0 in zip(model.parameters(), weights):
         np.testing.assert_allclose(p.detach().numpy(), w0, rtol=1e-6)
 
+    # -- tpu_compile train step synced across ranks (fx→JAX bridge over
+    # the host plane; single-process parity lives in
+    # test_torch_compile.py) ----------------------------------------------
+    torch.manual_seed(11)  # same init on every rank; grads sync per step
+
+    class _LinReg(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(4, 1, bias=False)
+
+        def forward(self, x, y):
+            err = self.lin(x).squeeze(-1) - y
+            return {"loss": (err * err).mean()}
+
+    from horovod_tpu.torch import tpu_compile
+    import optax
+    shard2 = np.random.RandomState(300 + r)
+    Xb = shard2.randn(32, 4).astype(np.float32)
+    yb = (Xb @ np.ones(4)).astype(np.float32)
+    comp = tpu_compile(_LinReg(),
+                       example_inputs={"x": torch.from_numpy(Xb),
+                                       "y": torch.from_numpy(yb)})
+    bstep = comp.make_train_step(optax.sgd(0.05))
+    first = last = None
+    for _ in range(25):
+        last = float(bstep({"x": Xb, "y": yb}))
+        first = last if first is None else first
+    assert last < first * 0.5, (first, last)
+    all_wb = allgather_object(np.asarray(comp.params["lin.weight"]))
+    for wb in all_wb[1:]:
+        np.testing.assert_allclose(wb, all_wb[0], rtol=1e-5)
+
     print(f"rank {r}/{n}: TORCH-BINDING OK", flush=True)
     hvd.shutdown()
 
