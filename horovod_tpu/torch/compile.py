@@ -569,42 +569,45 @@ def _check_trace_fidelity(module, gm, example_inputs):
         module.train(was_training)
         gm.train(was_training)
 
-    flat_ref, _ = _flatten_out(ref)
-    flat_tr, _ = _flatten_out(traced)
+    flat_ref = _flatten_out(ref)
+    flat_tr = _flatten_out(traced)
     if len(flat_ref) != len(flat_tr):
         raise ValueError(
-            f"fx trace output structure ({len(flat_tr)} tensors) does "
+            f"fx trace output structure ({len(flat_tr)} leaves) does "
             f"not match the eager module ({len(flat_ref)}); the trace "
             "specialized on data-dependent control flow for these "
             "example_inputs")
+
+    def diverged(i, why):
+        raise ValueError(
+            f"fx trace diverges from the eager module on example_inputs "
+            f"(output leaf {i}: {why}): tracing specialized "
+            "data-dependent control flow or baked mutable state into a "
+            "constant; restructure with tensor ops or trace a wrapper "
+            "that pins the intended path")
+
     for i, (a, b) in enumerate(zip(flat_ref, flat_tr)):
-        if torch.is_tensor(a) and torch.is_tensor(b):
-            if not torch.allclose(a.float(), b.float(), rtol=1e-4,
-                                  atol=1e-5):
-                raise ValueError(
-                    f"fx trace diverges from the eager module on "
-                    f"example_inputs (output leaf {i}): tracing "
-                    "specialized data-dependent control flow; restructure "
-                    "the branch with tensor ops or trace a wrapper that "
-                    "pins the intended path")
+        if torch.is_tensor(a) != torch.is_tensor(b):
+            # A constant-folded leaf (tensor on one side, python value on
+            # the other) is exactly the divergence this check exists for.
+            diverged(i, "tensor vs non-tensor")
+        elif torch.is_tensor(a):
+            if a.shape != b.shape or not torch.allclose(
+                    a.float(), b.float(), rtol=1e-4, atol=1e-5):
+                diverged(i, "values differ")
+        elif a != b:
+            diverged(i, f"{a!r} != {b!r}")
 
 
 def _flatten_out(out):
-    """Flatten nested dict/list/tuple module outputs to tensor leaves."""
+    """Flatten nested dict/list/tuple module outputs to leaves (dicts in
+    sorted-key order so both sides flatten identically)."""
     if isinstance(out, dict):
-        leaves, keys = [], []
-        for k in sorted(out):
-            sub, _ = _flatten_out(out[k])
-            leaves.extend(sub)
-            keys.append(k)
-        return leaves, keys
+        return [leaf for k in sorted(out)
+                for leaf in _flatten_out(out[k])]
     if isinstance(out, (list, tuple)):
-        leaves = []
-        for v in out:
-            sub, _ = _flatten_out(v)
-            leaves.extend(sub)
-        return leaves, None
-    return [out], None
+        return [leaf for v in out for leaf in _flatten_out(v)]
+    return [out]
 
 
 class CompiledModule:

@@ -243,9 +243,8 @@ def test_example_inputs_trace_fidelity_check():
             self.lin = torch.nn.Linear(4, 4, bias=False)
 
         def forward(self, x):
-            # Data-dependent Python branch: fx bakes the traced path
-            # (symbolic tracing takes the bool of a traced value's
-            # .sum(), which torch.fx evaluates on proxies as True).
+            # Data-dependent Python branch: fx refuses to trace bool(
+            # proxy) — loud already, the check is for subtler cases.
             if x.sum() > 0:
                 return {"out": self.lin(x)}
             return {"out": -self.lin(x)}
@@ -253,6 +252,21 @@ def test_example_inputs_trace_fidelity_check():
     x_neg = torch.full((2, 4), -1.0)
     with pytest.raises((ValueError, torch.fx.proxy.TraceError)):
         tpu_compile(Branchy(), example_inputs=(x_neg,))
+
+    # The case fx traces WITHOUT complaint but wrong: mutable python
+    # state read in forward gets baked as a trace-time constant. Only
+    # the fidelity check catches this one.
+    class Foldy(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0  # python int: invisible to fx, baked
+
+        def forward(self, x):
+            self.calls += 1
+            return {"out": x * float(self.calls)}
+
+    with pytest.raises(ValueError, match="diverges"):
+        tpu_compile(Foldy(), example_inputs=(torch.ones(2, 4),))
 
     # A branch-free module passes the check and stays usable.
     class Clean(torch.nn.Module):
