@@ -266,8 +266,8 @@ def main():
                 print(json.dumps(fn(*args, **kwargs)), flush=True)
                 return
             except Exception as e:  # noqa: BLE001 — classified below
-                print(f"bench attempt {attempt + 1} failed: {e!r}",
-                      file=sys.stderr, flush=True)
+                print(f"{fn.__name__} attempt {attempt + 1} failed: "
+                      f"{e!r}", file=sys.stderr, flush=True)
                 if attempt + 1 < attempts and _transient(e):
                     time.sleep(10)
                     continue

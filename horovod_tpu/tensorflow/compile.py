@@ -184,10 +184,19 @@ def _conv2d(x, w, strides, padding, dilations, data_format,
     if padding == "EXPLICIT":
         pads = list(explicit_paddings)
         padding = [(pads[2], pads[3]), (pads[4], pads[5])]
+    # Grouped convolution: TF keeps the op type Conv2D and encodes the
+    # group count implicitly as in_channels / rhs_in_channels (e.g.
+    # ConvNeXt's 7x7 depthwise is Conv2D with groups == channels).
+    groups, rem = divmod(x.shape[-1], w.shape[2])
+    if rem:
+        raise NotImplementedError(
+            f"Conv2D input channels {x.shape[-1]} not divisible by "
+            f"kernel input channels {w.shape[2]}")
     return lax.conv_general_dilated(
         x, w, window_strides=tuple(strides[1:3]), padding=padding,
         rhs_dilation=tuple(dilations[1:3]),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups)
 
 
 def _depthwise_conv2d(x, w, strides, padding, dilations, data_format):
