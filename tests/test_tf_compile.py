@@ -265,7 +265,8 @@ print("APPLICATIONS OK", d)
 
 @pytest.mark.parametrize("name", ["MobileNetV2", "EfficientNetB0",
                                   "DenseNet121", "InceptionV3",
-                                  "ConvNeXtTiny"])
+                                  "ConvNeXtTiny", "Xception",
+                                  "MobileNetV3Small"])
 def test_keras_applications_through_bridge(name):
     """The tf.keras.applications families the tf_on_tpu doc advertises:
     exact forward parity through the graph→JAX bridge (depthwise convs,
