@@ -92,10 +92,16 @@ def _dropout(x, p, train, key):
     return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
 
 
-def _sdpa(rng_key, train, q, k, v, attn_mask=None, dropout_p=0.0,
-          is_causal=False, scale=None):
+def _sdpa(rng_key, train, q=None, k=None, v=None, attn_mask=None,
+          dropout_p=0.0, is_causal=False, scale=None, query=None,
+          key=None, value=None):
     """torch.nn.functional.scaled_dot_product_attention semantics on jax:
-    bool masks keep-where-True; float masks are additive."""
+    bool masks keep-where-True; float masks are additive. Accepts both
+    positional q/k/v and the keyword spelling (query=/key=/value=) some
+    HF models use (e.g. Albert)."""
+    q = query if q is None else q
+    k = key if k is None else k
+    v = value if v is None else v
     jnp = _jnp()
     d = q.shape[-1]
     if scale is None:
@@ -207,7 +213,9 @@ def _size(x, dim=None):
     return x.shape if dim is None else x.shape[dim]
 
 
-def _softmax(x, dim=-1, dtype=None):
+def _softmax(x, dim=-1, _stacklevel=3, dtype=None):
+    # Positional order mirrors F.softmax(input, dim, _stacklevel, dtype);
+    # _stacklevel is the legacy warn-location kwarg, inert here.
     import jax
     jnp = _jnp()
     xf = x.astype(jnp.float32)

@@ -280,3 +280,50 @@ def test_example_inputs_trace_fidelity_check():
     comp = tpu_compile(Clean(), example_inputs=(torch.ones(3, 4),))
     out = comp(x=torch.ones(3, 4))
     assert np.asarray(out["out"]).shape == (3, 2)
+
+
+@pytest.mark.parametrize("family", ["bert", "distilbert", "roberta",
+                                    "albert", "electra"])
+def test_hf_families_loss_parity(family):
+    """HF encoder families beyond BERT through the fx bridge: loss
+    parity vs torch eager on tiny configs (covers Albert's keyword
+    sdpa spelling and Electra's legacy softmax kwarg)."""
+    transformers = pytest.importorskip("transformers")
+    import numpy as np
+
+    builders = {
+        "bert": lambda: transformers.BertForMaskedLM(
+            transformers.BertConfig(
+                vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=128,
+                max_position_embeddings=32)),
+        "distilbert": lambda: transformers.DistilBertForMaskedLM(
+            transformers.DistilBertConfig(
+                vocab_size=128, dim=64, n_layers=2, n_heads=2,
+                hidden_dim=128, max_position_embeddings=32)),
+        "roberta": lambda: transformers.RobertaForMaskedLM(
+            transformers.RobertaConfig(
+                vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=128,
+                max_position_embeddings=34)),
+        "albert": lambda: transformers.AlbertForMaskedLM(
+            transformers.AlbertConfig(
+                vocab_size=128, hidden_size=64, embedding_size=32,
+                num_hidden_layers=2, num_attention_heads=2,
+                intermediate_size=128, max_position_embeddings=32)),
+        "electra": lambda: transformers.ElectraForMaskedLM(
+            transformers.ElectraConfig(
+                vocab_size=128, hidden_size=64, embedding_size=32,
+                num_hidden_layers=2, num_attention_heads=2,
+                intermediate_size=128, max_position_embeddings=32)),
+    }
+    torch.manual_seed(0)
+    model = builders[family]().eval()
+    ids = torch.randint(0, 128, (2, 16))
+    labels = torch.randint(0, 128, (2, 16))
+    comp = tpu_compile(model, input_names=["input_ids", "labels"])
+    out = comp(input_ids=ids, labels=labels)
+    with torch.no_grad():
+        ref = model(input_ids=ids, labels=labels)
+    np.testing.assert_allclose(float(np.asarray(out["loss"])),
+                               float(ref.loss), rtol=1e-4, atol=1e-4)
