@@ -35,6 +35,8 @@ import operator
 
 import numpy as np
 
+_op_setitem = operator.setitem
+
 
 def _jnp():
     import jax.numpy as jnp
@@ -293,15 +295,74 @@ def _build_function_table():
             return jnp.full(tuple(size), fill, dtype=dt)
         return make
 
+    def min_max(reduce_fn, arg_fn, pair_fn):
+        # torch.min/max have three spellings: full reduce (one arg),
+        # per-dim torch.min(x, dim[, keepdim]) -> namedtuple-like
+        # (values, indices), elementwise torch.min(x, other). Unknown
+        # arguments fail loud (the module's coverage contract) rather
+        # than silently misbind.
+        import collections
+        pair_t = collections.namedtuple("minmax", ["values", "indices"])
+
+        def h(a, *args, **kwargs):
+            if kwargs.pop("out", None) is not None:
+                raise NotImplementedError("min/max out= unsupported")
+            other = kwargs.pop("other", None)
+            dim = kwargs.pop("dim", None)
+            keepdim = kwargs.pop("keepdim", False)
+            if kwargs:
+                raise NotImplementedError(
+                    f"min/max kwargs {sorted(kwargs)} unsupported")
+            rest = list(args)
+            if rest and not isinstance(rest[0], (int, bool)):
+                if other is None:
+                    other = rest.pop(0)
+            elif rest and dim is None:
+                dim = rest.pop(0)
+                if rest and isinstance(rest[0], bool):
+                    keepdim = rest.pop(0)
+            if rest:
+                raise NotImplementedError(
+                    f"min/max argument pattern {args!r} unsupported")
+            if other is not None:
+                return pair_fn(a, other)
+            if dim is None:
+                return reduce_fn(a)
+            if isinstance(dim, bool):
+                raise NotImplementedError("min/max bool dim is ambiguous")
+            return pair_t(reduce_fn(a, axis=dim, keepdims=keepdim),
+                          arg_fn(a, axis=dim, keepdims=keepdim))
+        return h
+
+    table[torch.min] = min_max(jnp.min, jnp.argmin, jnp.minimum)
+    table[torch.max] = min_max(jnp.max, jnp.argmax, jnp.maximum)
+    table[torch.minimum] = jnp.minimum
+    table[torch.maximum] = jnp.maximum
+    table[torch.triu] = lambda x, diagonal=0, **kw: jnp.triu(x, diagonal)
+    table[torch.tril] = lambda x, diagonal=0, **kw: jnp.tril(x, diagonal)
     table[torch.ones] = factory(1)
     table[torch.zeros] = factory(0)
-    table[torch.full] = lambda size, value, dtype=None, device=None, **kw: \
-        jnp.full(tuple(size), value,
-                 dtype=_to_jax_dtype(dtype) if dtype else None)
+    def opt_dtype(dtype):
+        # One place for the optional torch->jax dtype mapping (factory
+        # fns accept dtype=None meaning "default").
+        return _to_jax_dtype(dtype) if dtype is not None else None
+
+    table[torch.full] = \
+        lambda size, fill_value, dtype=None, device=None, **kw: \
+        jnp.full(tuple(size), fill_value, dtype=opt_dtype(dtype))
+    table[torch.full_like] = \
+        lambda x, fill_value, dtype=None, device=None, **kw: \
+        jnp.full_like(x, fill_value, dtype=opt_dtype(dtype))
+    table[torch.zeros_like] = \
+        lambda x, dtype=None, device=None, **kw: jnp.zeros_like(
+            x, dtype=opt_dtype(dtype))
+    table[torch.ones_like] = \
+        lambda x, dtype=None, device=None, **kw: jnp.ones_like(
+            x, dtype=opt_dtype(dtype))
     table[torch.arange] = lambda *a, dtype=None, device=None, **kw: \
-        jnp.arange(*a, dtype=_to_jax_dtype(dtype) if dtype else None)
+        jnp.arange(*a, dtype=opt_dtype(dtype))
     table[torch.tensor] = lambda v, dtype=None, device=None, **kw: \
-        jnp.asarray(v, dtype=_to_jax_dtype(dtype) if dtype else None)
+        jnp.asarray(v, dtype=opt_dtype(dtype))
     return table
 
 
@@ -471,6 +532,22 @@ class _JaxInterpreter:
                 elif isinstance(out, list):
                     out = list(out)
                 return out
+
+            if node.op == "call_function" and node.target is _op_setitem:
+                # In-place indexed assignment (x[idx] = v, e.g. T5's
+                # shift_right): JAX arrays are immutable, so rebind the
+                # TARGET node's env entry to the functional update —
+                # later uses of that node see the mutation, like torch.
+                # (Mutation through a separate VIEW node would not
+                # propagate; fx traces of the supported models assign
+                # through the array node itself.)
+                target = node.args[0]
+                idx = load_arg(node.args[1])
+                val = load_arg(node.args[2])
+                updated = env[target.name].at[idx].set(val)
+                env[target.name] = updated
+                env[node.name] = updated
+                continue
 
             args = load_arg(node.args)
             kwargs = load_arg(node.kwargs)

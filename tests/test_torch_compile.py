@@ -283,7 +283,7 @@ def test_example_inputs_trace_fidelity_check():
 
 
 @pytest.mark.parametrize("family", ["bert", "distilbert", "roberta",
-                                    "albert", "electra"])
+                                    "albert", "electra", "t5"])
 def test_hf_families_loss_parity(family):
     """HF encoder families beyond BERT through the fx bridge: loss
     parity vs torch eager on tiny configs (covers Albert's keyword
@@ -316,6 +316,12 @@ def test_hf_families_loss_parity(family):
                 vocab_size=128, hidden_size=64, embedding_size=32,
                 num_hidden_layers=2, num_attention_heads=2,
                 intermediate_size=128, max_position_embeddings=32)),
+        # Encoder-decoder: relative position bias (torch.min spellings),
+        # shift_right's in-place setitem, cross attention.
+        "t5": lambda: transformers.T5ForConditionalGeneration(
+            transformers.T5Config(
+                vocab_size=128, d_model=64, d_kv=16, d_ff=128,
+                num_layers=2, num_heads=4, decoder_start_token_id=0)),
     }
     torch.manual_seed(0)
     model = builders[family]().eval()
@@ -327,3 +333,26 @@ def test_hf_families_loss_parity(family):
         ref = model(input_ids=ids, labels=labels)
     np.testing.assert_allclose(float(np.asarray(out["loss"])),
                                float(ref.loss), rtol=1e-4, atol=1e-4)
+
+
+def test_min_max_spellings():
+    """torch.min/max through the bridge in all three spellings:
+    elementwise (tensor other), per-dim (positional keepdim, namedtuple
+    .values/.indices), and full reduce."""
+    class M(torch.nn.Module):
+        def forward(self, x, y):
+            a = torch.min(x, y)                  # elementwise
+            b = torch.max(x, 0, True).values     # positional keepdim
+            c = torch.min(x, dim=1).indices      # kwarg dim, indices
+            d = torch.max(x)                     # full reduce
+            return {"a": a, "b": b, "c": c.to(x.dtype), "d": d}
+
+    torch.manual_seed(5)
+    m = M().eval()
+    x, y = torch.randn(3, 4), torch.randn(3, 4)
+    comp = tpu_compile(m)
+    out = comp(x=x, y=y)
+    ref = m(x, y)
+    for k in "abcd":
+        np.testing.assert_allclose(np.asarray(out[k]), ref[k].numpy(),
+                                   rtol=1e-5, atol=1e-6)
