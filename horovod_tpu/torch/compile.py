@@ -82,7 +82,11 @@ def _t2j(tensor):
 
 class _Device:
     """Sentinel for getattr(x, 'device') results; consumed (and ignored)
-    by factory-function device= kwargs."""
+    by factory-function device= kwargs. Models that branch on
+    ``x.device.type`` (e.g. BART's mask helper) see the accelerator
+    answer."""
+
+    type = "xla"  # noqa: A003 — mirrors torch.device.type
 
 
 def _dropout(x, p, train, key):
@@ -388,6 +392,18 @@ def _getattr_node(obj, name):
 _METHODS = None
 
 
+def _new_factory(fill):
+    """tensor.new_zeros/new_ones/new_full(size...) — fresh array of the
+    source's dtype unless overridden; size passed flat or as one tuple
+    (the same normalization view/reshape use)."""
+    def h(x, *s, dtype=None, device=None, **kw):
+        size = (s[0] if len(s) == 1 and isinstance(s[0], (tuple, list))
+                else s)
+        dt = _to_jax_dtype(dtype) if dtype is not None else x.dtype
+        return _jnp().full(tuple(size), fill, dtype=dt)
+    return h
+
+
 def _method_table():
     global _METHODS
     if _METHODS is None:
@@ -412,6 +428,13 @@ def _method_table():
             "type_as": lambda x, o: x.astype(o.dtype),
             "masked_fill": _masked_fill,
             "masked_fill_": _masked_fill,
+            # tensor.new_*: fresh arrays inheriting the source's dtype
+            # unless overridden (shared helper below the table).
+            "new_zeros": _new_factory(0),
+            "new_ones": _new_factory(1),
+            "new_full": lambda x, size, fill_value, dtype=None,
+                device=None, **kw: _new_factory(fill_value)(
+                    x, size, dtype=dtype),
             "dim": lambda x: x.ndim,
             "size": _size,
             "numel": lambda x: int(np.prod(x.shape)),
@@ -534,8 +557,10 @@ class _JaxInterpreter:
                 return out
 
             if node.op == "call_function" and node.target is _op_setitem:
-                # In-place indexed assignment (x[idx] = v, e.g. T5's
-                # shift_right): JAX arrays are immutable, so rebind the
+                # In-place indexed assignment (x[idx] = v, e.g. BART's
+                # shift_tokens_right; this transformers release's T5
+                # takes an fx-proxy branch built from full+cat instead):
+                # JAX arrays are immutable, so rebind the
                 # TARGET node's env entry to the functional update —
                 # later uses of that node see the mutation, like torch.
                 # (Mutation through a separate VIEW node would not
@@ -567,6 +592,17 @@ class _JaxInterpreter:
                         "has no jax mapping; add it to "
                         "horovod_tpu/torch/compile.py _method_table")
                 env[node.name] = fn(*args, **kwargs)
+                if (node.target.endswith("_")
+                        and not node.target.endswith("__")
+                        and node.args
+                        and isinstance(node.args[0], torch.fx.Node)):
+                    # Torch's trailing-underscore in-place convention
+                    # (masked_fill_ etc., e.g. BART/T5 shift helpers
+                    # replacing -100 label sentinels): later uses of the
+                    # TARGET node must see the mutation, so rebind it to
+                    # the functional result — same contract as the
+                    # setitem handler above.
+                    env[node.args[0].name] = env[node.name]
             elif node.op == "call_function":
                 fn = self.fn_table.get(node.target)
                 if fn == "sdpa":

@@ -283,7 +283,7 @@ def test_example_inputs_trace_fidelity_check():
 
 
 @pytest.mark.parametrize("family", ["bert", "distilbert", "roberta",
-                                    "albert", "electra", "t5"])
+                                    "albert", "electra", "t5", "bart"])
 def test_hf_families_loss_parity(family):
     """HF encoder families beyond BERT through the fx bridge: loss
     parity vs torch eager on tiny configs (covers Albert's keyword
@@ -322,17 +322,46 @@ def test_hf_families_loss_parity(family):
             transformers.T5Config(
                 vocab_size=128, d_model=64, d_kv=16, d_ff=128,
                 num_layers=2, num_heads=4, decoder_start_token_id=0)),
+        # Second seq2seq shape: learned positions, new_zeros shift,
+        # device.type branch in the mask helper.
+        "bart": lambda: transformers.BartForConditionalGeneration(
+            transformers.BartConfig(
+                vocab_size=128, d_model=64, encoder_layers=2,
+                decoder_layers=2, encoder_attention_heads=2,
+                decoder_attention_heads=2, encoder_ffn_dim=128,
+                decoder_ffn_dim=128, max_position_embeddings=64)),
     }
     torch.manual_seed(0)
     model = builders[family]().eval()
     ids = torch.randint(0, 128, (2, 16))
     labels = torch.randint(0, 128, (2, 16))
+    # HF-standard -100 ignore sentinels: the seq2seq shift helpers
+    # masked_fill_ them to pad in-place (the interpreter must make the
+    # mutation visible downstream or -100 leaks into the embedding).
+    labels[:, -3:] = -100
     comp = tpu_compile(model, input_names=["input_ids", "labels"])
     out = comp(input_ids=ids, labels=labels)
     with torch.no_grad():
         ref = model(input_ids=ids, labels=labels)
     np.testing.assert_allclose(float(np.asarray(out["loss"])),
                                float(ref.loss), rtol=1e-4, atol=1e-4)
+
+
+def test_inplace_method_mutation_visible_downstream():
+    """Torch's trailing-underscore in-place methods mutate the TARGET:
+    later uses of the pre-mutation fx node must see the update (the
+    shift-helper pattern: mutate, then return the original variable)."""
+    class M(torch.nn.Module):
+        def forward(self, x):
+            y = x + 0.0
+            y.masked_fill_(y > 0, -1.0)  # return value unused
+            return y * 2.0
+
+    m = M().eval()
+    x = torch.tensor([[-1.0, 2.0, 3.0, -4.0]])
+    comp = tpu_compile(m)
+    out = comp(x=x)
+    np.testing.assert_allclose(np.asarray(out), m(x).numpy())
 
 
 def test_min_max_spellings():
