@@ -392,15 +392,30 @@ def _getattr_node(obj, name):
 _METHODS = None
 
 
+def _div_inplace(x, o, rounding_mode=None):
+    if rounding_mode is not None:
+        # floor/trunc division would need the rounding semantics, not a
+        # silently-wrong truediv.
+        raise NotImplementedError(
+            f"div_ rounding_mode={rounding_mode!r} has no jax mapping; "
+            "add it to horovod_tpu/torch/compile.py _method_table")
+    return x / o
+
+
+def _normalize_size(s):
+    """Torch size spellings: flat ints (x.view(2, 3)) or one iterable
+    (x.view((2, 3))) — one helper for every size-taking method."""
+    return (tuple(s[0]) if len(s) == 1 and isinstance(s[0], (tuple, list))
+            else tuple(s))
+
+
 def _new_factory(fill):
     """tensor.new_zeros/new_ones/new_full(size...) — fresh array of the
-    source's dtype unless overridden; size passed flat or as one tuple
-    (the same normalization view/reshape use)."""
-    def h(x, *s, dtype=None, device=None, **kw):
-        size = (s[0] if len(s) == 1 and isinstance(s[0], (tuple, list))
-                else s)
+    source's dtype unless overridden; size positional or keyword."""
+    def h(x, *s, size=None, dtype=None, device=None, **kw):
+        shape = (tuple(size) if size is not None else _normalize_size(s))
         dt = _to_jax_dtype(dtype) if dtype is not None else x.dtype
-        return _jnp().full(tuple(size), fill, dtype=dt)
+        return _jnp().full(shape, fill, dtype=dt)
     return h
 
 
@@ -409,16 +424,11 @@ def _method_table():
     if _METHODS is None:
         jnp = _jnp()
         _METHODS = {
-            "view": lambda x, *s: x.reshape(
-                s[0] if len(s) == 1 and isinstance(s[0], (tuple, list))
-                else s),
-            "reshape": lambda x, *s: x.reshape(
-                s[0] if len(s) == 1 and isinstance(s[0], (tuple, list))
-                else s),
+            "view": lambda x, *s: x.reshape(_normalize_size(s)),
+            "reshape": lambda x, *s: x.reshape(_normalize_size(s)),
             "transpose": lambda x, a, b: jnp.swapaxes(x, a, b),
             "permute": lambda x, *dims: jnp.transpose(
-                x, dims[0] if len(dims) == 1 and
-                isinstance(dims[0], (tuple, list)) else dims),
+                x, _normalize_size(dims)),
             "contiguous": lambda x: x,
             "clone": lambda x: x,
             "detach": lambda x: x,
@@ -456,9 +466,7 @@ def _method_table():
                 jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
             "chunk": lambda x, n, dim=-1: tuple(jnp.split(x, n, axis=dim)),
             "flatten": lambda x, start=0, end=-1: _flatten(x, start, end),
-            "repeat": lambda x, *reps: jnp.tile(
-                x, reps[0] if len(reps) == 1 and
-                isinstance(reps[0], (tuple, list)) else reps),
+            "repeat": lambda x, *reps: jnp.tile(x, _normalize_size(reps)),
             "t": lambda x: x.T,
             "gather": lambda x, dim, index: jnp.take_along_axis(
                 x, index, axis=dim),
@@ -470,6 +478,20 @@ def _method_table():
             "mul": operator.mul, "add": operator.add,
             "sub": operator.sub, "div": operator.truediv,
             "neg": operator.neg,
+            # In-place spellings: functional results; the interpreter's
+            # trailing-underscore rebinding makes the mutation visible
+            # to later uses of the target node.
+            "add_": lambda x, o, alpha=1: x + (alpha * o
+                                               if alpha != 1 else o),
+            "sub_": lambda x, o, alpha=1: x - (alpha * o
+                                               if alpha != 1 else o),
+            "mul_": operator.mul,
+            "div_": _div_inplace,
+            "clamp_": lambda x, min=None, max=None: jnp.clip(x, min, max),
+            "fill_": lambda x, v: jnp.full_like(x, v),
+            "zero_": lambda x: jnp.zeros_like(x),
+            "copy_": lambda x, o, non_blocking=False: jnp.broadcast_to(
+                o.astype(x.dtype), x.shape),
             "item": lambda x: x,   # stays traced; fine under jit
         }
     return _METHODS

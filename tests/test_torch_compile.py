@@ -385,3 +385,25 @@ def test_min_max_spellings():
     for k in "abcd":
         np.testing.assert_allclose(np.asarray(out[k]), ref[k].numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_inplace_arithmetic_methods():
+    """add_/mul_/clamp_/copy_ spellings: functional mapping + target
+    rebinding reproduce torch's in-place semantics."""
+    class M(torch.nn.Module):
+        def forward(self, x):
+            y = x * 1.0
+            y.add_(2.0)
+            y.mul_(3.0)
+            z = x.clone()
+            z.clamp_(min=0.0)
+            w = x * 0.0
+            w.copy_(y)
+            return {"y": y, "z": z, "w": w}
+
+    m = M().eval()
+    x = torch.tensor([[-1.0, 2.0]])
+    out = tpu_compile(m)(x=x)
+    ref = m(x.clone())
+    for k in "yzw":
+        np.testing.assert_allclose(np.asarray(out[k]), ref[k].numpy())
