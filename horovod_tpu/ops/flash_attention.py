@@ -97,9 +97,21 @@ def _tile_interior(causal, q_start, k_start, kv_len, qb, kb, block_q,
     return inside
 
 
-def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
-                block_k, n_k):
+def _keep_scale(dm_ref, dropout_rate):
+    """fp32 dropout multiplier for the current tile: keep-mask rescaled
+    by 1/(1-rate). One definition keeps the four fwd/bwd use sites in
+    exact sync (a fwd/bwd mismatch would be a silent gradient bug)."""
+    return dm_ref[0].astype(jnp.float32) * (1.0 / (1.0 - dropout_rate))
+
+
+def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, *rest, sm_scale, causal,
+                block_q, block_k, n_k, dropout_rate=0.0):
+    # rest = [dm_ref?], o_ref, lse_ref, m_scr, l_scr, acc_scr
+    if dropout_rate > 0.0:
+        dm_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        dm_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     kb = pl.program_id(2)
     qb = pl.program_id(1)
     q_start = lens_ref[0]
@@ -145,8 +157,14 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
         l_prev = l_scr[:, :1]
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        # Attention dropout (torch semantics: probs are dropped AFTER
+        # softmax, so the normalizer l uses the undropped p while the
+        # value accumulation uses the dropped/rescaled weights).
+        pv = p
+        if dm_ref is not None:
+            pv = p * _keep_scale(dm_ref, dropout_rate)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            pv.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -173,22 +191,30 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = lse.astype(lse_ref.dtype)
 
 
-def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k):
+def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
+              dm=None, dropout_rate=0.0):
     bh, sq, d = q.shape
     sk = k.shape[1]
     n_q = sq // block_q
     n_k = sk // block_k
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, n_k=n_k)
+        block_q=block_q, block_k=block_k, n_k=n_k,
+        dropout_rate=dropout_rate)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(
+            (1, block_q, block_k), lambda b, i, j, lens: (b, i, j)))
+        operands.append(dm)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
@@ -214,7 +240,7 @@ def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k):
         out_shape=out_shapes,
         compiler_params=compiler_params,
         interpret=_interpret(),
-    )(lens, q, k, v)
+    )(lens, *operands)
     return o, lse[:, 0, :]
 
 
@@ -223,8 +249,14 @@ def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    sm_scale, causal, block_q, block_k, n_q):
+                    delta_ref, *rest, sm_scale, causal, block_q,
+                    block_k, n_q, dropout_rate=0.0):
+    # rest = [dm_ref?], dk_ref, dv_ref, dk_scr, dv_scr
+    if dropout_rate > 0.0:
+        dm_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dm_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     qb = pl.program_id(2)
     kb = pl.program_id(1)
     q_start = lens_ref[0]
@@ -266,16 +298,25 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             # Interior tile: no element masked (see _tile_interior).
             p = jnp.exp(s - lse[:, None])        # (bq, bk) fp32
 
+        # Dropout backward: o = (P∘M̃)V with M̃ = mask/(1-rate), so
+        # dV = (P∘M̃)ᵀdO and dP = (dO Vᵀ)∘M̃; the delta trick survives
+        # because Σₖ Pᵢₖ dPᵢₖ = rowsum(dO∘O) = delta exactly as without
+        # dropout (O already carries M̃).
+        pv = p
+        if dm_ref is not None:
+            pv = p * _keep_scale(dm_ref, dropout_rate)
         # MXU operands in the input dtype (bf16 in training; identity for
         # fp32 inputs), fp32 accumulation. fp32 operands would run the
         # matmuls at a fraction of MXU rate — the softmax weights and ds
         # are the canonical safe-to-round tensors of the flash backward.
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bq, bk)
+        if dm_ref is not None:
+            dp = dp * _keep_scale(dm_ref, dropout_rate)
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -297,8 +338,14 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
-                   block_q, block_k, n_k):
+                   delta_ref, *rest, sm_scale, causal, block_q,
+                   block_k, n_k, dropout_rate=0.0):
+    # rest = [dm_ref?], dq_ref, dq_scr
+    if dropout_rate > 0.0:
+        dm_ref, dq_ref, dq_scr = rest
+    else:
+        dm_ref = None
+        dq_ref, dq_scr = rest
     kb = pl.program_id(2)
     qb = pl.program_id(1)
     q_start = lens_ref[0]
@@ -340,6 +387,8 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dm_ref is not None:
+            dp = dp * _keep_scale(dm_ref, dropout_rate)
         ds = p * (dp - delta[:, None]) * sm_scale
         # input-dtype operand, fp32 accumulation (see _bwd_dkv_kernel).
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
@@ -361,7 +410,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
-              g_lse=None):
+              g_lse=None, dm=None, dropout_rate=0.0):
     bh, sq, d = q.shape
     sk = k.shape[1]
     n_q = sq // block_q
@@ -382,17 +431,23 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
     except TypeError:
         compiler_params = None
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
+    ]
+    dkv_operands = [q, k, v, do, lse3, delta3]
+    if dropout_rate > 0.0:
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, block_q, block_k), lambda b, j, i, lens: (b, i, j)))
+        dkv_operands.append(dm)
     dkv_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i, lens: (b, j, 0)),
@@ -404,7 +459,8 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
     )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_q=n_q),
+                          block_q=block_q, block_k=block_k, n_q=n_q,
+                          dropout_rate=dropout_rate),
         grid_spec=dkv_spec,
         out_shape=[
             _struct((bh, sk, d), k.dtype, q, k, v, do, lens),
@@ -412,19 +468,25 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
         ],
         compiler_params=compiler_params,
         interpret=_interpret(),
-    )(lens, q, k, v, do, lse3, delta3)
+    )(lens, *dkv_operands)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
+    ]
+    dq_operands = [q, k, v, do, lse3, delta3]
+    if dropout_rate > 0.0:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, block_q, block_k), lambda b, i, j, lens: (b, i, j)))
+        dq_operands.append(dm)
     dq_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
         ],
@@ -434,12 +496,13 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
     )
     (dq,) = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_k=n_k),
+                          block_q=block_q, block_k=block_k, n_k=n_k,
+                          dropout_rate=dropout_rate),
         grid_spec=dq_spec,
         out_shape=[_struct((bh, sq, d), q.dtype, q, k, v, do, lens)],
         compiler_params=compiler_params,
         interpret=_interpret(),
-    )(lens, q, k, v, do, lse3, delta3)
+    )(lens, *dq_operands)
     return dq, dk, dv
 
 
@@ -491,6 +554,33 @@ def _flash_with_lse_bwd(sm_scale, causal, block_q, block_k, res, g):
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_dropout(q, k, v, lens, dm, sm_scale, causal, block_q, block_k,
+                   rate):
+    o, _ = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
+                     dm=dm, dropout_rate=rate)
+    return o
+
+
+def _flash_dropout_fwd(q, k, v, lens, dm, sm_scale, causal, block_q,
+                       block_k, rate):
+    o, lse = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
+                       dm=dm, dropout_rate=rate)
+    return o, (q, k, v, o, lse, lens, dm)
+
+
+def _flash_dropout_bwd(sm_scale, causal, block_q, block_k, rate, res, g):
+    q, k, v, o, lse, lens, dm = res
+    dq, dk, dv = _bwd_call(q, k, v, o, g, lse, lens, sm_scale, causal,
+                           block_q, block_k, dm=dm, dropout_rate=rate)
+    dlens = np.zeros((3,), jax.dtypes.float0)
+    ddm = np.zeros(dm.shape, jax.dtypes.float0)
+    return dq, dk, dv, dlens, ddm
+
+
+_flash_dropout.defvjp(_flash_dropout_fwd, _flash_dropout_bwd)
+
+
 def _prepare(q, k, v, block_q, block_k):
     """Reshape (B,H,S,D)→(BH,S,D), pad D to a lane tile (64 when D<=64,
     else 128) and S to block multiples. Returns padded tensors +
@@ -531,7 +621,7 @@ def _varying(*xs):
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     q_offset=0, k_offset=0, kv_len=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    with_lse=False):
+                    with_lse=False, dropout_mask=None, dropout_rate=0.0):
     """Flash attention over (batch, heads, seq, head_dim) tensors.
 
     Args:
@@ -542,6 +632,14 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
       kv_len: number of valid keys in ``k`` (defaults to its length);
         keys at or beyond this index are masked (padding).
       with_lse: also return the per-query log-sum-exp (fp32, (B,H,Sq)).
+      dropout_mask: optional (B, H, Sq, Sk) keep-mask applied to the
+        softmax probabilities (torch attention-dropout semantics: probs
+        are dropped after normalization and the kept ones rescaled by
+        1/(1-dropout_rate)). Passing the mask explicitly — rather than a
+        PRNG seed — keeps the kernel exactly reproducible against the
+        einsum oracle; the torch/TF bridges generate it with
+        jax.random.bernoulli per attention site.
+      dropout_rate: the rate the mask was drawn with (for rescaling).
     """
     orig_dtype = q.dtype
     b, h, sq, d = q.shape
@@ -549,6 +647,11 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
         sm_scale = 1.0 / np.sqrt(d)
     if kv_len is None:
         kv_len = k.shape[2]
+    has_dropout = dropout_mask is not None and dropout_rate > 0.0
+    if has_dropout and with_lse:
+        raise NotImplementedError(
+            "flash_attention: dropout_mask with with_lse is unsupported "
+            "(ring/merged attention never uses attention dropout)")
     if _interpret() and _varying(q, k, v, q_offset, k_offset):
         # Pallas's HLO interpreter cannot run with device-varying operands
         # inside shard_map (check_vma dynamic_slice limitation); on non-TPU
@@ -556,9 +659,17 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
         # handles shard_map natively.
         return reference_attention(
             q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset,
-            k_offset=k_offset, kv_len=kv_len, with_lse=with_lse)
+            k_offset=k_offset, kv_len=kv_len, with_lse=with_lse,
+            dropout_mask=dropout_mask, dropout_rate=dropout_rate)
     qp, kp, vp, dims, bq, bk = _prepare(q, k, v, block_q, block_k)
     lens = jnp.asarray([q_offset, k_offset, kv_len], jnp.int32)
+    if has_dropout:
+        # bf16 carries 0/1 exactly at half the HBM traffic of fp32.
+        dm = dropout_mask.astype(jnp.bfloat16).reshape(b * h, sq, -1)
+        dm = _pad_to(_pad_to(dm, bk, 2), bq, 1)
+        o = _flash_dropout(qp, kp, vp, lens, dm, float(sm_scale),
+                           bool(causal), bq, bk, float(dropout_rate))
+        return o[:, :sq, :d].reshape(b, h, sq, d).astype(orig_dtype)
     if with_lse:
         o, lse = _flash_with_lse(qp, kp, vp, lens, float(sm_scale),
                                  bool(causal), bq, bk)
@@ -571,7 +682,8 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
 
 def reference_attention(q, k, v, *, causal=False, sm_scale=None,
                         q_offset=0, k_offset=0, kv_len=None,
-                        with_lse=False):
+                        with_lse=False, dropout_mask=None,
+                        dropout_rate=0.0):
     """Plain einsum attention with the same masking semantics — the
     correctness oracle for the kernel tests and the shard_map-on-CPU
     fallback. Offsets may be traced scalars."""
@@ -596,7 +708,12 @@ def reference_attention(q, k, v, *, causal=False, sm_scale=None,
     p = jnp.where(mask, jnp.exp(s - m), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    o = (jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    pv = p
+    if dropout_mask is not None and dropout_rate > 0.0:
+        # Post-softmax dropout: the normalizer l keeps the undropped sum.
+        pv = p * (dropout_mask.astype(jnp.float32)
+                  / (1.0 - dropout_rate))
+    o = (jnp.einsum("bhqk,bhkd->bhqd", pv, v.astype(jnp.float32))
          / safe_l).astype(q.dtype)
     if not with_lse:
         return o

@@ -32,6 +32,7 @@ itself has).
 
 import math
 import operator
+import os
 
 import numpy as np
 
@@ -98,17 +99,109 @@ def _dropout(x, p, train, key):
     return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
 
 
+def _flash_enabled():
+    """Route bridge attention through the Pallas flash kernel?  auto =
+    only when the math actually runs on a TPU (in interpret mode the
+    kernel is a python-level grid loop — correct but slow, so the CPU
+    test suite keeps the einsum lowering unless it opts in)."""
+    mode = os.environ.get("HVDTPU_BRIDGE_FLASH", "auto").lower()
+    if mode == "always":
+        return True
+    if mode == "never":
+        return False
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+_flash_fallback_noted = set()
+
+
+def _note_flash_fallback(reason):
+    if reason not in _flash_fallback_noted:
+        _flash_fallback_noted.add(reason)
+        import warnings
+        warnings.warn(
+            f"hvd.tpu_compile: attention falls back to the einsum "
+            f"lowering ({reason}); the Pallas flash path supports "
+            f"4-D q/k/v with equal head counts and a mask that is "
+            f"None/all-keep at compile time", stacklevel=2)
+
+
+def _resolve_static_mask(attn_mask, jnp):
+    """If attn_mask is a compile-time constant that keeps every position
+    (HF encoders build their additive mask from shapes/dtypes only, so
+    with no padding it constant-folds to zeros during tracing), return
+    None; otherwise return the mask unchanged."""
+    if attn_mask is None:
+        return None
+    import jax
+    if isinstance(attn_mask, jax.core.Tracer):
+        return attn_mask
+    # The mask is concrete (const-folded), but any op on it inside the
+    # jit trace would be staged — inspect it at compile time instead.
+    with jax.ensure_compile_time_eval():
+        m = jnp.asarray(attn_mask)
+        if m.dtype == jnp.bool_:
+            if bool(m.all()):
+                return None
+        elif bool((m == 0).all()):
+            return None
+    return attn_mask
+
+
 def _sdpa(rng_key, train, q=None, k=None, v=None, attn_mask=None,
           dropout_p=0.0, is_causal=False, scale=None, query=None,
           key=None, value=None):
     """torch.nn.functional.scaled_dot_product_attention semantics on jax:
     bool masks keep-where-True; float masks are additive. Accepts both
     positional q/k/v and the keyword spelling (query=/key=/value=) some
-    HF models use (e.g. Albert)."""
+    HF models use (e.g. Albert).
+
+    When the mask resolves away at compile time (None, all-True bool, or
+    all-zero additive — the no-padding HF encoder case), the call lowers
+    to the repo's Pallas flash kernel (ops/flash_attention.py), including
+    exact attention dropout via an explicit bernoulli keep-mask; anything
+    the kernel does not cover falls back to this einsum lowering with a
+    one-time warning."""
     q = query if q is None else q
     k = key if k is None else k
     v = value if v is None else v
     jnp = _jnp()
+    if _flash_enabled():
+        resolved = _resolve_static_mask(attn_mask, jnp)
+        if (resolved is None
+                and getattr(q, "ndim", 0) == 4
+                and getattr(k, "ndim", 0) == 4
+                and getattr(v, "ndim", 0) == 4
+                and q.shape[:2] == k.shape[:2] == v.shape[:2]
+                and q.shape[-1] == k.shape[-1] == v.shape[-1]
+                and q.shape[-1] <= 128):
+            from ..ops.flash_attention import flash_attention
+            dm = None
+            rate = 0.0
+            if dropout_p and train and rng_key is not None:
+                # The explicit (B,H,Sq,Sk) keep-mask costs O(S²) HBM —
+                # the same footprint the einsum fallback pays for its
+                # logits, so flash routing never loses memory headroom
+                # to it; long-context models that need O(S) attention
+                # memory run dropout-free (the native flagship path).
+                import jax
+                rate = float(dropout_p)
+                dm = jax.random.bernoulli(
+                    rng_key, 1.0 - rate,
+                    q.shape[:3] + (k.shape[2],))
+            return flash_attention(
+                q, k, v, causal=bool(is_causal), sm_scale=scale,
+                dropout_mask=dm, dropout_rate=rate)
+        if resolved is None:
+            # Mask folded away but the shapes are outside kernel
+            # coverage — still drop the dead mask from the einsum path.
+            attn_mask = None
+            _note_flash_fallback(
+                f"q/k/v shapes {getattr(q, 'shape', None)}/"
+                f"{getattr(k, 'shape', None)}/{getattr(v, 'shape', None)}")
+        else:
+            _note_flash_fallback("mask is not statically all-keep")
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
@@ -530,6 +623,53 @@ class _JaxInterpreter:
         for node in self.graph.nodes:
             if self._is_dropout_site(node):
                 self.site_of[node.name] = len(self.site_of)
+        self._value_free = self._compute_value_free()
+
+    def _compute_value_free(self):
+        """Names of nodes whose value depends on no placeholder's runtime
+        VALUES (only shapes/dtypes), no parameter/buffer, and no RNG.
+
+        JAX omnistaging stages every op inside a jit trace, so HF's
+        shape-derived attention-mask chains (ones(size) → expand → sub →
+        masked_fill) would reach the attention lowering as tracers even
+        though they are compile-time constants. Nodes in this set run
+        under ``jax.ensure_compile_time_eval()`` instead, so the all-keep
+        mask stays concrete and ``_resolve_static_mask`` can drop it —
+        which is what routes no-padding encoders onto the flash kernel.
+        """
+        import torch.fx
+        shape_methods = {"size", "dim", "ndimension"}
+        shape_attrs = {"dtype", "shape", "device", "ndim"}
+        # Nodes mutated in place anywhere in the graph change value
+        # between definition and later uses — never fold those.
+        mutated = set()
+        for node in self.graph.nodes:
+            if node.op == "call_function" and node.target is _op_setitem:
+                if isinstance(node.args[0], torch.fx.Node):
+                    mutated.add(node.args[0].name)
+            elif (node.op == "call_method" and node.target.endswith("_")
+                  and not node.target.endswith("__") and node.args
+                  and isinstance(node.args[0], torch.fx.Node)):
+                mutated.add(node.args[0].name)
+        value_free = set()
+        for node in self.graph.nodes:
+            if node.op in ("placeholder", "get_attr", "call_module",
+                           "output"):
+                continue
+            if node.name in mutated or node.name in self.site_of:
+                continue
+            if node.op == "call_method" and node.target in shape_methods:
+                value_free.add(node.name)
+                continue
+            if (node.op == "call_function" and node.target is getattr
+                    and len(node.args) >= 2
+                    and node.args[1] in shape_attrs):
+                value_free.add(node.name)
+                continue
+            if all(d.name in value_free and d.name not in mutated
+                   for d in node.all_input_nodes):
+                value_free.add(node.name)
+        return value_free
 
     def _is_dropout_site(self, node):
         import torch.nn.functional as F
@@ -601,6 +741,23 @@ class _JaxInterpreter:
             key = None
             if node.name in self.site_of and rng is not None:
                 key = jax.random.fold_in(rng, self.site_of[node.name])
+
+            if node.name in self._value_free:
+                # Shape/dtype-derived subgraph: evaluate eagerly so the
+                # result stays a compile-time constant under the jit
+                # trace (see _compute_value_free).
+                with jax.ensure_compile_time_eval():
+                    if node.op == "call_method":
+                        fn = _method_table().get(node.target)
+                    else:
+                        fn = self.fn_table.get(node.target)
+                    if fn is None or isinstance(fn, str):
+                        raise NotImplementedError(
+                            f"torch {node.op} {node.target!r} (node "
+                            f"{node.name}) has no jax mapping; add it to "
+                            "horovod_tpu/torch/compile.py")
+                    env[node.name] = fn(*args, **kwargs)
+                continue
 
             if node.op == "call_module":
                 sub = self.gm.get_submodule(node.target)

@@ -134,3 +134,49 @@ def test_transformer_attention_impl_parity():
     out_f = m_f.apply(params, tokens)
     np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_f),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dropout_mask_matches_reference(causal):
+    """Explicit-dropout-mask kernel path vs the einsum oracle using the
+    SAME bernoulli mask (exact semantics: probs dropped after softmax,
+    normalizer keeps the undropped sum, kept probs rescaled)."""
+    b, h, s, d = 2, 2, 192, 32
+    q, k, v = (_rand((b, h, s, d), i) for i in range(3))
+    rate = 0.2
+    dm = jax.random.bernoulli(jax.random.PRNGKey(9), 1.0 - rate,
+                              (b, h, s, s))
+    out = flash_attention(q, k, v, causal=causal, dropout_mask=dm,
+                          dropout_rate=rate)
+    ref = reference_attention(q, k, v, causal=causal, dropout_mask=dm,
+                              dropout_rate=rate)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dropout_mask_gradients_match_reference():
+    b, h, s, d = 1, 2, 128, 32
+    q, k, v = (_rand((b, h, s, d), i) for i in range(3))
+    g = _rand((b, h, s, d), 7)
+    rate = 0.1
+    dm = jax.random.bernoulli(jax.random.PRNGKey(11), 1.0 - rate,
+                              (b, h, s, s))
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a, causal=True, dropout_mask=dm,
+                                     dropout_rate=rate) * g)
+
+    g1 = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_dropout_zero_mask_is_identity_path():
+    """rate=0.0 ignores the mask entirely (no kernel-path change)."""
+    q, k, v = (_rand((1, 1, 64, 32), i) for i in range(3))
+    dm = jnp.zeros((1, 1, 64, 64), bool)
+    out = flash_attention(q, k, v, dropout_mask=dm, dropout_rate=0.0)
+    ref = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))

@@ -407,3 +407,102 @@ def test_inplace_arithmetic_methods():
     ref = m(x.clone())
     for k in "yzw":
         np.testing.assert_allclose(np.asarray(out[k]), ref[k].numpy())
+
+
+def test_flash_routing_parity_and_engagement(monkeypatch):
+    """With HVDTPU_BRIDGE_FLASH=always, BERT's shape-derived all-zero
+    additive mask const-folds away and every attention site lowers to
+    the Pallas flash kernel; the loss matches the einsum lowering."""
+    pytest.importorskip("transformers")
+    model, cfg = _tiny_bert()
+    model.eval()
+    ids, labels = _mlm_batch(cfg)
+
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "never")
+    ref = tpu_compile(model, input_names=["input_ids", "labels"])
+    loss_ref = float(ref(input_ids=ids, labels=labels)["loss"])
+
+    from horovod_tpu.ops import flash_attention as fa_mod
+    calls = []
+    orig = fa_mod.flash_attention
+
+    def spy(*args, **kwargs):
+        calls.append(kwargs.get("dropout_rate", 0.0))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
+    compiled = tpu_compile(model, input_names=["input_ids", "labels"])
+    loss_flash = float(compiled(input_ids=ids, labels=labels)["loss"])
+    assert len(calls) == cfg.num_hidden_layers, \
+        f"expected every attention site on flash, saw {len(calls)}"
+    np.testing.assert_allclose(loss_flash, loss_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_routing_train_dropout_and_loss_decrease(monkeypatch):
+    """Train-mode trace bakes dropout_p>0; the flash path applies it via
+    an explicit bernoulli keep-mask and training still converges."""
+    pytest.importorskip("transformers")
+    optax = pytest.importorskip("optax")
+    import jax
+    model, cfg = _tiny_bert()
+    model.train()
+    ids, labels = _mlm_batch(cfg, batch=8)  # divisible by the CPU mesh
+
+    from horovod_tpu.ops import flash_attention as fa_mod
+    rates = []
+    orig = fa_mod.flash_attention
+
+    def spy(*args, **kwargs):
+        rates.append(kwargs.get("dropout_rate", 0.0))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
+    compiled = tpu_compile(model, input_names=["input_ids", "labels"])
+    step = compiled.make_train_step(optax.adamw(1e-3))
+    key = jax.random.PRNGKey(0)
+    losses = [float(step({"input_ids": ids, "labels": labels},
+                         rng=jax.random.fold_in(key, i)))
+              for i in range(4)]
+    assert cfg.attention_probs_dropout_prob in set(rates)
+    assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_flash_fallback_on_real_padding_mask(monkeypatch):
+    """A data-dependent attention_mask input cannot const-fold; the
+    lowering must fall back to einsum (warn once) and stay correct."""
+    transformers = pytest.importorskip("transformers")
+    model, cfg = _tiny_bert()
+    model.eval()
+    ids, labels = _mlm_batch(cfg)
+    attn = torch.ones_like(ids)
+    attn[:, -4:] = 0  # real padding
+
+    from transformers.utils import fx as hf_fx  # noqa: F401
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "never")
+    ref = tpu_compile(
+        model, input_names=["input_ids", "attention_mask", "labels"])
+    loss_ref = float(ref(input_ids=ids, attention_mask=attn,
+                         labels=labels)["loss"])
+
+    from horovod_tpu.ops import flash_attention as fa_mod
+    calls = []
+    orig = fa_mod.flash_attention
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
+    compiled = tpu_compile(
+        model, input_names=["input_ids", "attention_mask", "labels"])
+    loss2 = float(compiled(input_ids=ids, attention_mask=attn,
+                           labels=labels)["loss"])
+    assert not calls, "padded mask must not route to the flash kernel"
+    np.testing.assert_allclose(loss2, loss_ref, rtol=1e-5, atol=1e-5)
+    with torch.no_grad():
+        torch_loss = float(model(input_ids=ids, attention_mask=attn,
+                                 labels=labels).loss)
+    np.testing.assert_allclose(loss2, torch_loss, rtol=1e-3, atol=1e-3)
