@@ -250,11 +250,25 @@ def main():
         """Only the TPU tunnel's flaky infra errors are worth retrying
         (dropped remote_compile connections surface as INTERNAL /
         UNAVAILABLE JaxRuntimeErrors); a real bug or missing dep must
-        fail fast, not re-run a multi-minute benchmark three times."""
+        fail fast, not re-run a multi-minute benchmark three times.
+        Gate on the exception TYPE first: an application ConnectionError
+        or an assertion mentioning 'INTERNAL' is not tunnel flake."""
+        try:
+            runtime_errors = (jax.errors.JaxRuntimeError,)
+        except AttributeError:
+            runtime_errors = ()
+        if runtime_errors and not isinstance(e, runtime_errors):
+            print(f"# bench: non-runtime error, failing fast: "
+                  f"{type(e).__name__}", file=sys.stderr, flush=True)
+            return False
         text = repr(e)
-        return any(s in text for s in ("INTERNAL", "UNAVAILABLE",
-                                       "remote_compile", "read body",
-                                       "Connection", "DEADLINE"))
+        verdict = any(s in text for s in ("INTERNAL", "UNAVAILABLE",
+                                          "remote_compile", "read body",
+                                          "Connection", "DEADLINE"))
+        print(f"# bench: {type(e).__name__} classified "
+              f"{'transient' if verdict else 'fatal'}",
+              file=sys.stderr, flush=True)
+        return verdict
 
     def emit(fn, *args, required=True, **kwargs):
         """Run one benchmark, retrying transient tunnel errors so one

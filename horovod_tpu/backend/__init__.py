@@ -111,18 +111,13 @@ def make_spmd_backend(topology):
     (reference: horovod/common/operations.cc:144-253 CreateOperationManager).
     """
     from ..utils import envparse
-    # The elastic + xla-global rejection must precede the size==1 early
-    # return: an elastic job can START at size 1 (Loopback) and only hit
-    # the xla path on its first scale-up reset — failing then would be
-    # the deferred mid-training crash this check exists to prevent.
+    # elastic + xla-global is supported via exit-restart resets: on a
+    # membership change the worker persists its commit and exits with
+    # elastic.RESTART_EXIT_CODE, the driver respawns the slot fresh, and
+    # the new process re-forms jax.distributed at the new world size
+    # (jax.distributed cannot re-initialize in-process — see
+    # elastic.py "Exit-restart reset").
     cpu_ops = envparse.get_str(envparse.CPU_OPERATIONS, "").lower()
-    if cpu_ops in ("xla", "xla-global", "nccl") and \
-            envparse.get_bool(envparse.ELASTIC):
-        raise NotImplementedError(
-            "elastic jobs cannot use the xla-global data plane: "
-            "jax.distributed cannot re-initialize in-process after a "
-            "membership change. Use HVDTPU_CPU_OPERATIONS=tcp for "
-            "elastic jobs.")
     if topology.size == 1:
         return LoopbackBackend()
     if not envparse.get_str(envparse.PEERS, ""):

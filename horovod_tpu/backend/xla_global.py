@@ -79,10 +79,11 @@ def init_jax_distributed(topology):
     import jax
     try:
         if jax.distributed.is_initialized():
-            # Fresh world pre-initialized by user code: reuse it. (The
-            # elastic + xla combination is rejected once at backend
-            # selection, make_spmd_backend — a stale post-reset world
-            # cannot reach here.)
+            # Fresh world pre-initialized by user code: reuse it. (A
+            # stale post-reset world cannot reach here: elastic resets
+            # on this plane happen across a process boundary —
+            # elastic.py exit-restart — so a live process never holds a
+            # previous cohort's jax.distributed world.)
             return
     except AttributeError:  # older jax
         pass
@@ -105,6 +106,13 @@ def init_jax_distributed(topology):
             "the hvdrun launcher's rendezvous to broker the JAX "
             "coordinator address")
     addr, port, token = cfg
+    # Elastic exit-restart: every membership version forms a fresh
+    # jax.distributed world, so the coordinator key must be scoped to
+    # the version this cohort joined — a respawned worker reading the
+    # previous cohort's coordinator would dial a dead listener.
+    import os
+    ver = os.environ.get("HVDTPU_ELASTIC_VERSION")
+    coord_key = f"coord.{ver}" if ver is not None else "coord"
     if topology.rank == 0:
         # initialize() blocks until every process connects, so the address
         # must be published while it runs. Bind happens immediately inside
@@ -140,7 +148,7 @@ def init_jax_distributed(topology):
                 f"could not start the JAX coordinator: {last_err}")
         log.info("xla-global: serving jax.distributed coordinator at %s",
                  coord)
-        http_client.put_kv(addr, port, JAXDIST_SCOPE, "coord", coord,
+        http_client.put_kv(addr, port, JAXDIST_SCOPE, coord_key, coord,
                            token=token)
         thread.join()  # all ranks connected (or init failed)
         if errs:
@@ -148,7 +156,7 @@ def init_jax_distributed(topology):
                 f"could not start the JAX coordinator: {errs[0]}")
     else:
         coord = http_client.wait_for_kv(
-            addr, port, JAXDIST_SCOPE, "coord", token=token,
+            addr, port, JAXDIST_SCOPE, coord_key, token=token,
             deadline_s=float(
                 envparse.get_str("START_TIMEOUT", "120"))).decode()
         log.info("xla-global: jax.distributed coordinator=%s process "

@@ -23,7 +23,8 @@ import os
 import time
 
 from . import basics
-from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .exceptions import (RESTART_EXIT_CODE, HorovodInternalError,
+                         HostsUpdatedInterrupt)
 from .utils.logging_util import get_logger
 
 
@@ -130,6 +131,134 @@ def _reset():
     basics.init()
 
 
+# ---------------------------------------------------------------------------
+# Exit-restart reset: elastic over the compiled (xla-global) data plane
+# ---------------------------------------------------------------------------
+#
+# The reference aborts NCCL comms and re-initializes in-process
+# (reference: horovod/common/elastic.py:150-176 + nccl elastic abort).
+# jax.distributed cannot re-form inside a live process, so the compiled
+# plane resets across a PROCESS boundary instead: on a membership event
+# the worker persists its last commit to the driver's KV store and exits
+# with RESTART_EXIT_CODE; the elastic driver respawns the same slot
+# fresh, the new process re-forms jax.distributed at the new world size,
+# and run_fn restores the persisted commit before the first sync().
+
+_STATE_SCOPE = "elastic.state"
+
+
+def _restart_mode():
+    """Exit-restart semantics are required whenever the requested data
+    plane is the compiled one (xla-global over jax.distributed)."""
+    from .utils import envparse
+    if not envparse.get_bool(envparse.ELASTIC):
+        return False
+    return envparse.get_str(envparse.CPU_OPERATIONS, "").lower() in (
+        "xla", "xla-global", "nccl")
+
+
+def _state_payload(state):
+    """The picklable restore-point of a State. save() runs first so a
+    graceful membership change persists CURRENT progress (the interrupt
+    is raised at step-aligned commit points; after a failure the caller
+    already restored, and re-saving the restored attrs is the same
+    snapshot). States carrying non-picklable payloads cannot use the
+    exit-restart plane — fail loud at persist time, not with a corrupt
+    restore."""
+    try:
+        state.save()
+    except NotImplementedError:
+        pass
+    payload = getattr(state, "_saved_state", None)
+    if payload is None:
+        raise NotImplementedError(
+            f"{type(state).__name__} exposes no _saved_state snapshot; "
+            "exit-restart elastic (xla-global plane) needs a picklable "
+            "commit payload")
+    return payload
+
+
+def _persist_and_exit(state, log, rereq):
+    """Persist the last commit to the driver's KV store and leave the
+    process; the driver respawns this slot fresh (see module note)."""
+    import base64
+    import pickle
+    import sys
+
+    from .runner import http_client
+    from .runner import rendezvous as rdv
+    cfg = rdv.rendezvous_config()
+    wid = os.environ.get("HVDTPU_WORKER_ID", "")
+    if cfg is None or not wid:
+        raise HorovodInternalError(
+            "exit-restart elastic requires the hvdrun launcher's "
+            "rendezvous (HVDTPU_RENDEZVOUS_ADDR/PORT)")
+    addr, port, token = cfg
+    import json
+    payload = base64.b64encode(
+        pickle.dumps(_state_payload(state))).decode()
+    json_blob = json.dumps({"version": _joined_version(),
+                            "payload": payload})
+    http_client.put_kv(addr, port, _STATE_SCOPE, wid, json_blob,
+                       token=token)
+    # "any": last-writer fallback for replacement workers whose slot has
+    # no history (all survivors persist the same restore point — commits
+    # are step-aligned by the training collectives).
+    http_client.put_kv(addr, port, _STATE_SCOPE, "any", json_blob,
+                       token=token)
+    if rereq:
+        # A transport failure with no process death changes no
+        # membership; ask the driver to bump the version so the fresh
+        # cohort re-forms (mirrors rendezvous.elastic_bootstrap).
+        http_client.put_kv(addr, port, rdv.ELASTIC_SCOPE,
+                           f"rereq.{wid}", str(_joined_version() + 1),
+                           token=token)
+    log.info("elastic: persisting commit and exiting for process "
+             "restart (compiled plane reset)")
+    try:
+        basics.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(RESTART_EXIT_CODE)
+
+
+def _maybe_restore_persisted(state, log):
+    """In a fresh exit-restart process: load this slot's persisted
+    commit (or the cohort's last-writer fallback) into ``state``."""
+    import base64
+    import json
+    import pickle
+
+    from .runner import http_client
+    from .runner import rendezvous as rdv
+    cfg = rdv.rendezvous_config()
+    wid = os.environ.get("HVDTPU_WORKER_ID", "")
+    if cfg is None or not wid:
+        return
+    addr, port, token = cfg
+    raw = http_client.get_kv(addr, port, _STATE_SCOPE, wid, token=token)
+    if raw is None:
+        raw = http_client.get_kv(addr, port, _STATE_SCOPE, "any",
+                                 token=token)
+    if raw is None:
+        return
+    try:
+        record = json.loads(raw.decode()
+                            if isinstance(raw, bytes) else raw)
+        payload = pickle.loads(base64.b64decode(record["payload"]))
+    except Exception as e:  # noqa: BLE001
+        log.warning("elastic: persisted state unreadable (%s); starting "
+                    "fresh", e)
+        return
+    state._saved_state = payload
+    state.restore()
+    state.save()
+    log.info("elastic: restored persisted commit from version %s",
+             record.get("version"))
+
+
 def run_fn(func, reset=_reset):
     """Wrap a training function for elastic execution (reference:
     horovod/common/elastic.py:151 ``run_fn``). The wrapped function takes
@@ -140,6 +269,8 @@ def run_fn(func, reset=_reset):
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        if _restart_mode():
+            _maybe_restore_persisted(state, log)
         skip_sync = False
         while True:
             if not skip_sync:
@@ -151,9 +282,13 @@ def run_fn(func, reset=_reset):
                          "last commit", e)
                 state.restore()
                 skip_sync = False
+                if _restart_mode():
+                    _persist_and_exit(state, log, rereq=True)
             except HostsUpdatedInterrupt as e:
                 log.info("elastic: hosts updated; re-rendezvousing")
                 skip_sync = e.skip_sync
+                if _restart_mode():
+                    _persist_and_exit(state, log, rereq=False)
             _retry_reset(reset, log)
             state.on_reset()
 

@@ -8,6 +8,13 @@ written the same way: a recoverable collective failure raises
 """
 
 
+# Process exit code a worker uses to request a fresh respawn of its slot
+# (elastic exit-restart on the compiled data plane — see elastic.py).
+# Defined here so the launcher/driver can import it without dragging the
+# jax-importing elastic module into the supervisor process.
+RESTART_EXIT_CODE = 79
+
+
 class HorovodInternalError(RuntimeError):
     """Internal error raised when a collective routine fails.
 

@@ -172,13 +172,17 @@ def broadcast(value, root_rank, name=None):
     return keras.ops.convert_to_tensor(np.asarray(out))
 
 
-def load_model(filepath, custom_optimizers=None, custom_objects=None,
+def load_model(filepath, *, custom_optimizers=None, custom_objects=None,
                compression=None, compile=True, **kwargs):  # noqa: A002
     """Load a model and wrap its optimizer (reference:
     horovod/keras/__init__.py:167 load_model — same kwarg surface:
     ``custom_optimizers`` extends the deserializable classes,
     ``compression`` is applied to the re-wrapped optimizer so a model
-    trained with wire compression keeps it after reload)."""
+    trained with wire compression keeps it after reload).
+
+    The extra parameters are keyword-only: positionally they would
+    shadow ``keras.models.load_model(filepath, custom_objects)`` and
+    silently bind a custom_objects dict to custom_optimizers."""
     import keras
     if custom_optimizers:
         custom_objects = dict(custom_objects or {})

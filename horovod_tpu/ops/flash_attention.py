@@ -25,6 +25,7 @@ test suite exercises the exact kernel logic on the CPU mesh.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,38 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+_bridge_fallback_noted = set()
+
+
+def note_flash_fallback(reason):
+    """One-shot warning that a bridge attention site stayed on its
+    einsum lowering. Shared by the torch and TF bridges so the wording
+    and dedup behavior cannot diverge."""
+    if reason in _bridge_fallback_noted:
+        return
+    _bridge_fallback_noted.add(reason)
+    import warnings
+    warnings.warn(
+        f"tpu_compile: attention falls back to the einsum lowering "
+        f"({reason}); the Pallas flash path needs 4-D rank-consistent "
+        f"q/k/v with equal head dims and a mask that is all-keep or "
+        f"causal at compile time", stacklevel=3)
+
+
+def bridge_flash_enabled():
+    """Should the torch/TF bridges route attention through this kernel?
+    auto = only when the math actually runs on a TPU (in interpret mode
+    the kernel is a python-level grid loop — correct but slow, so the
+    CPU test suite keeps the einsum lowerings unless it opts in via
+    HVDTPU_BRIDGE_FLASH=always)."""
+    mode = os.environ.get("HVDTPU_BRIDGE_FLASH", "auto").lower()
+    if mode == "always":
+        return True
+    if mode == "never":
+        return False
+    return jax.default_backend() == "tpu"
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128

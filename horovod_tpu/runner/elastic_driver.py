@@ -21,6 +21,7 @@ from . import spawn
 from .hosts import HostInfo
 from .http_server import RendezvousServer, new_job_token
 from .job import _rendezvous_ip
+from ..exceptions import RESTART_EXIT_CODE
 from .rendezvous import ASSIGN_SCOPE, ELASTIC_SCOPE, PEER_SCOPE, VERSION_KEY
 from ..utils.logging_util import get_logger
 
@@ -274,6 +275,18 @@ class ElasticDriver:
                 self.succeeded.append(wid)
                 self.completing = True
                 self.log.info("elastic driver: worker %s finished", wid)
+            elif rc == RESTART_EXIT_CODE and not self.completing:
+                # Compiled-plane reset (elastic.py exit-restart): the
+                # worker persisted its commit and asked to be respawned
+                # fresh so jax.distributed can re-form at the new world
+                # size. Not a failure: no blacklist count, and no
+                # membership change beyond what triggered the reset —
+                # bumping the version here would make the respawned
+                # cohort immediately stale and loop.
+                self._spawn(wid, w.host, w.slot_index)
+                self.log.info(
+                    "elastic driver: worker %s exited for data-plane "
+                    "reset; respawned fresh", wid)
             else:
                 w.state = FAILED
                 self.fail_counts[w.host] = self.fail_counts.get(w.host,

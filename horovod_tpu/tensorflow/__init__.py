@@ -102,31 +102,70 @@ def _spmd():
     return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
 
 
-# Graph-op variants (reference: horovod/tensorflow/mpi_ops.cc:1189-1218
-# rank/size query ops usable inside graphs). Rank/size are fixed for a
-# process's lifetime, so a captured constant has identical semantics to
-# the reference's kernel — and re-traces cannot change it mid-job.
+# Graph-op variants (reference: horovod/tensorflow/mpi_ops.py:410-472
+# rank/size query ops usable inside graphs). Like the reference kernels,
+# these resolve at graph EXECUTION time — elastic mode re-forms the
+# runtime in-process (shutdown(); init()), so a tf.function that
+# captured one of these must observe the NEW rank/size after a reset, a
+# trace-time tf.constant would silently keep the stale value.
+def _runtime_scalar_op(fn, name):
+    tf = _tf()
+
+    def _value():
+        return np.int32(fn())
+
+    out = tf.py_function(_value, [], tf.int32, name=name)
+    out.set_shape(())
+    return out
+
+
+def _process_set_size(process_set_id):
+    if process_set_id in (0, None):
+        return size()
+    from ..process_sets import process_set_by_id
+    ps = process_set_by_id(process_set_id)
+    if ps is None:
+        raise ValueError(f"no process set with id {process_set_id}")
+    return len(ps.ranks)
+
+
 def rank_op(name=None):
-    return _tf().constant(rank(), name=name or "horovod_rank")
+    return _runtime_scalar_op(rank, name or "horovod_rank")
 
 
 def local_rank_op(name=None):
-    return _tf().constant(local_rank(), name=name or "horovod_local_rank")
+    return _runtime_scalar_op(local_rank, name or "horovod_local_rank")
 
 
-def size_op(name=None):
-    return _tf().constant(size(), name=name or "horovod_size")
+def size_op(process_set_id=0, name=None):
+    # the default name carries the ps id so the graph→JAX bridge can
+    # resolve the op without access to the captured python closure
+    return _runtime_scalar_op(
+        lambda: _process_set_size(process_set_id),
+        name or f"horovod_size_ps{process_set_id}")
 
 
 def local_size_op(name=None):
-    return _tf().constant(local_size(), name=name or "horovod_local_size")
+    return _runtime_scalar_op(local_size, name or "horovod_local_size")
 
 
 def process_set_included_op(process_set=global_process_set, name=None):
     """1 when this rank belongs to process_set, else 0 (reference:
-    horovod/tensorflow/mpi_ops.py process_set_included_op)."""
-    return _tf().constant(1 if process_set.included() else 0,
-                          name=name or "horovod_process_set_included")
+    horovod/tensorflow/mpi_ops.py process_set_included_op). Accepts a
+    ProcessSet object or a numeric process_set_id."""
+    def _included():
+        ps = process_set
+        if isinstance(ps, int):
+            from ..process_sets import process_set_by_id
+            ps = process_set_by_id(process_set)
+            if ps is None:
+                raise ValueError(f"no process set with id {process_set}")
+        return 1 if ps.included() else 0
+
+    ps_id = (process_set if isinstance(process_set, int)
+             else process_set.process_set_id)
+    return _runtime_scalar_op(
+        _included, name or f"horovod_process_set_included_ps{ps_id}")
 
 
 def _np_of(tensor):

@@ -248,6 +248,320 @@ def _einsum_handler(op, args):
     return _jnp().einsum(eq, *args)
 
 
+# ---------------------------------------------------------------------------
+# Flash-attention routing (Einsum → [scale] → [mask add] → Softmax → Einsum)
+# ---------------------------------------------------------------------------
+
+def _note_flash_fallback(reason):
+    from ..ops.flash_attention import note_flash_fallback
+    note_flash_fallback(reason)
+
+
+def _einsum_labels(op):
+    """Parse a 2-operand, rank-4, no-ellipsis einsum equation into
+    (lhs0, lhs1, out) label strings; None when it does not qualify."""
+    eq = op.get_attr("equation")
+    eq = eq.decode() if isinstance(eq, bytes) else eq
+    if "..." in eq or "->" not in eq:
+        return None
+    lhs, out = eq.split("->")
+    parts = lhs.split(",")
+    if len(parts) != 2:
+        return None
+    a, b = parts
+    if not (len(a) == len(b) == len(out) == 4):
+        return None
+    if len(set(a)) != 4 or len(set(b)) != 4 or len(set(out)) != 4:
+        return None
+    return a, b, out
+
+
+def _match_attention(sm):
+    """Recognize the keras/HF attention triple around a Softmax op:
+
+        scores = einsum(E1, X0, X1)       # QKᵀ in any label layout
+        scores = scores * c | scores / c  # optional scalar scale
+        scores = scores + mask            # optional additive mask
+        probs  = softmax(scores)          # last axis
+        out    = einsum(E2, probs, V)     # in either operand order
+
+    Identification is semantic (einsum-label bookkeeping), not equation
+    string matching, so any batch/head/seq layout qualifies. Returns a
+    list of (combine_op_name, plan). The plan stores tensor NAMES; the
+    interpreter resolves them against the live env at dispatch time so
+    scale/mask constancy is judged on actual traced values. Chain
+    intermediates may have extra consumers (e.g. a Shape feeding
+    ones_like, or returned attention scores): they still execute
+    normally — only the combine einsum's output is substituted, so
+    every other consumer keeps its exact value.
+
+    reference: no counterpart — the reference framework has no attention
+    compute at all; this serves BASELINE's "model math on the
+    accelerator at native efficiency" bar for bridged keras models."""
+    chain = sm.inputs[0].op
+    scale_name = None
+    scale_kind = None
+    mask_name = None
+    mask_kind = None
+    neg_name = None
+    for _ in range(3):
+        if chain.type in ("Mul", "RealDiv"):
+            if scale_name:
+                return None
+            i0, i1 = chain.inputs
+            if chain.type == "RealDiv":
+                # chain must be the numerator
+                if i1.shape.rank == 0:
+                    scale_name, scale_kind, chain = i1.name, "div", i0.op
+                    continue
+                return None
+            if i1.shape.rank == 0:
+                scale_name, scale_kind, chain = i1.name, "mul", i0.op
+                continue
+            if i0.shape.rank == 0:
+                scale_name, scale_kind, chain = i0.name, "mul", i1.op
+                continue
+            return None
+        if chain.type in ("Add", "AddV2"):
+            if mask_name:
+                return None
+            i0, i1 = chain.inputs
+            # the scores operand is the one produced by the rest of the
+            # chain (einsum / scale); the other is the additive mask
+            if i0.op.type in ("Einsum", "Mul", "RealDiv"):
+                mask_name, mask_kind, chain = i1.name, "add", i0.op
+                continue
+            if i1.op.type in ("Einsum", "Mul", "RealDiv"):
+                mask_name, mask_kind, chain = i0.name, "add", i1.op
+                continue
+            return None
+        if chain.type == "SelectV2":
+            # keras masked softmax: where(keep_mask, scores, big_negative)
+            if mask_name:
+                return None
+            cond, on_true, on_false = chain.inputs
+            mask_name, mask_kind = cond.name, "select"
+            neg_name = on_false.name
+            chain = on_true.op
+            continue
+        break
+    if chain.type != "Einsum":
+        return None
+    e1 = chain
+    labels = _einsum_labels(e1)
+    if labels is None:
+        return None
+    a_l, b_l, s_l = labels
+    contracted = (set(a_l) & set(b_l)) - set(s_l)
+    if len(contracted) != 1:
+        return None
+    h = contracted.pop()
+    sk = s_l[-1]                      # softmax axis label (last)
+    in_a, in_b = sk in a_l, sk in b_l
+    if in_a == in_b:
+        return None
+    k_l, k_t = (a_l, e1.inputs[0]) if in_a else (b_l, e1.inputs[1])
+    q_l, q_t = (b_l, e1.inputs[1]) if in_a else (a_l, e1.inputs[0])
+    shared_bh = [l for l in s_l if l in q_l and l in k_l]
+    if len(shared_bh) != 2:
+        return None
+    bb, hh = shared_bh
+    sq_set = set(q_l) - {bb, hh, h}
+    if len(sq_set) != 1:
+        return None
+    sq = sq_set.pop()
+    if set(s_l) != {bb, hh, sq, sk} or set(k_l) != {bb, hh, sk, h}:
+        return None
+
+    matches = []
+    for e2 in sm.outputs[0].consumers():
+        if e2.type != "Einsum":
+            continue
+        labels2 = _einsum_labels(e2)
+        if labels2 is None:
+            continue
+        l20, l21, o_l = labels2
+        if e2.inputs[0].op is sm:
+            p_l, v_l, v_t = l20, l21, e2.inputs[1]
+        elif e2.inputs[1].op is sm:
+            p_l, v_l, v_t = l21, l20, e2.inputs[0]
+        else:
+            continue
+        # Translate E2's labels into E1's label space positionally via
+        # the probs operand (its axes ARE E1's output axes).
+        trans = {p_l[i]: s_l[i] for i in range(4)}
+        c2 = (set(p_l) & set(v_l)) - set(o_l)
+        if len(c2) != 1 or trans[next(iter(c2))] != sk:
+            continue
+        hv = [l for l in v_l if l not in trans]
+        if len(hv) != 1:
+            continue
+        tv = [trans.get(l, "HV") for l in v_l]
+        if set(tv) != {bb, hh, sk, "HV"}:
+            continue
+        to = [trans.get(l, "HV") for l in o_l]
+        if set(to) != {bb, hh, sq, "HV"}:
+            continue
+        matches.append((e2.name, {
+            "q": q_t.name, "k": k_t.name, "v": v_t.name,
+            "perm_q": tuple(q_l.index(x) for x in (bb, hh, sq, h)),
+            "perm_k": tuple(k_l.index(x) for x in (bb, hh, sk, h)),
+            "perm_v": tuple(tv.index(x) for x in (bb, hh, sk, "HV")),
+            "out_perm": tuple((bb, hh, sq, "HV").index(x) for x in to),
+            "scale": scale_name, "scale_kind": scale_kind,
+            "mask": mask_name, "mask_kind": mask_kind, "neg": neg_name,
+        }))
+    return matches
+
+
+def _attention_plans(graph):
+    plans = {}
+    for op in graph.get_operations():
+        if op.type != "Softmax":
+            continue
+        hit = _match_attention(op)
+        if hit is None:
+            continue
+        for name, plan in hit:
+            plans[name] = plan
+    return plans
+
+
+_VALUE_FREE_ROOTS = frozenset({"Shape", "ShapeN", "Size", "Rank", "Const"})
+_TAINT_OPS = frozenset({
+    "Placeholder", "Arg", "_Arg", "ReadVariableOp", "ResourceGather",
+    "VarHandleOp", "AssignVariableOp", "AssignAddVariableOp",
+    "AssignSubVariableOp", "PartitionedCall", "StatefulPartitionedCall",
+    "StatelessRandomGetKeyCounter", "StatelessRandomGetAlg",
+})
+
+
+def _value_free_ops(graph):
+    """Op names whose outputs depend on no graph input's runtime VALUES
+    (only static shapes), no variable, and no RNG. JAX omnistaging
+    stages every op inside a jit trace, so keras's shape-derived mask
+    chains (ones_like → GreaterEqual → LogicalAnd) would reach the
+    attention pattern as tracers; ops in this set run under
+    ``jax.ensure_compile_time_eval()`` instead, keeping those masks
+    concrete so _try_flash_attention can classify them statically."""
+    free = set()
+    for op in graph.get_operations():
+        t = op.type
+        if t in _VALUE_FREE_ROOTS:
+            free.add(op.name)
+            continue
+        if t in _TAINT_OPS or t in _RANDOM_OPS or t == "NoOp":
+            continue
+        if all(i.op.name in free or i.op.type in _VALUE_FREE_ROOTS
+               for i in op.inputs):
+            free.add(op.name)
+    return free
+
+
+def _classify_static_mask(mval, kind, n_q, n_k):
+    """For a concrete mask ('add': additive float, zeros keep / ≤-1e8
+    block; 'select': boolean keep-mask): ('none', 0) if it keeps
+    everything, ('causal', q_offset) if it is exactly a (broadcast)
+    bottom-right-aligned causal pattern — keep[i, j] iff
+    j <= i + (n_k - n_q), which the kernel reproduces with
+    q_offset = n_k - n_q — else None (fall back to einsum)."""
+    import jax
+    jnp = _jnp()
+    with jax.ensure_compile_time_eval():
+        m = np.asarray(jnp.asarray(mval))
+    if kind == "select":
+        if m.dtype != np.bool_:
+            return None
+        keep = m
+        blocked = ~m
+    else:
+        m = m.astype(np.float32)
+        keep = m == 0
+        blocked = m <= -1e8
+    if not (keep | blocked).all():
+        return None
+    if keep.all():
+        return "none", 0
+    if keep.ndim < 2 or keep.shape[-2:] != (n_q, n_k):
+        return None
+    flat = keep.reshape(-1, n_q, n_k)
+    if not (flat == flat[0]).all():
+        return None
+    causal = np.tril(np.ones((n_q, n_k), bool), k=n_k - n_q)
+    if (flat[0] == causal).all():
+        return "causal", n_k - n_q
+    return None
+
+
+def _try_flash_attention(env, plan, opr):
+    """Attempt to compute the recognized attention pattern with the
+    Pallas flash kernel. Returns the combine-einsum's output or None
+    (caller falls back to the plain einsum lowering)."""
+    import jax
+    jnp = _jnp()
+    q, k, v = env.get(plan["q"]), env.get(plan["k"]), env.get(plan["v"])
+    if q is None or k is None or v is None:
+        return None
+    if not all(getattr(x, "ndim", 0) == 4 for x in (q, k, v)):
+        return None
+    qt = jnp.transpose(q, plan["perm_q"])
+    kt = jnp.transpose(k, plan["perm_k"])
+    vt = jnp.transpose(v, plan["perm_v"])
+    if not (qt.shape[-1] == kt.shape[-1] == vt.shape[-1]
+            and qt.shape[-1] <= 128
+            and qt.shape[:2] == kt.shape[:2] == vt.shape[:2]
+            and kt.shape[2] == vt.shape[2]):
+        _note_flash_fallback(
+            f"unsupported attention shapes q{qt.shape} k{kt.shape} "
+            f"v{vt.shape}")
+        return None
+    sm_scale = 1.0
+    if plan["scale"] is not None:
+        sval = env.get(plan["scale"])
+        if isinstance(sval, jax.core.Tracer):
+            _note_flash_fallback("non-constant attention scale")
+            return None
+        with jax.ensure_compile_time_eval():
+            sm_scale = float(jnp.asarray(sval))
+        if plan["scale_kind"] == "div":
+            if sm_scale == 0.0:
+                return None
+            sm_scale = 1.0 / sm_scale
+    causal = False
+    if plan["mask"] is not None:
+        mval = env.get(plan["mask"])
+        if isinstance(mval, jax.core.Tracer):
+            _note_flash_fallback(
+                "attention mask is not a compile-time constant")
+            return None
+        if plan["mask_kind"] == "select":
+            # the on-false fill must actually block (≤ -1e8)
+            neg = env.get(plan["neg"])
+            if isinstance(neg, jax.core.Tracer):
+                _note_flash_fallback("non-constant masked-softmax fill")
+                return None
+            with jax.ensure_compile_time_eval():
+                neg_ok = bool((jnp.asarray(neg) <= -1e8).all())
+            if not neg_ok:
+                _note_flash_fallback(
+                    "masked-softmax fill value is not a large negative")
+                return None
+        verdict = _classify_static_mask(mval, plan["mask_kind"],
+                                        qt.shape[2], kt.shape[2])
+        if verdict is None:
+            _note_flash_fallback(
+                "attention mask is neither all-keep nor causal")
+            return None
+        kind, q_offset = verdict
+        causal = kind == "causal"
+    else:
+        q_offset = 0
+    from ..ops.flash_attention import flash_attention
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale,
+                          q_offset=q_offset)
+    return jnp.transpose(out, plan["out_perm"])
+
+
 def _matmul(a, b, transpose_a=False, transpose_b=False, adjoint=False):
     """MatMul transpose_a/b is a plain transpose; BatchMatMul adj_x/y is
     the adjoint — conjugate-transpose for complex inputs."""
@@ -302,6 +616,37 @@ def _pack(args, axis):
     return _jnp().stack(args, axis=axis)
 
 
+def _hvd_query_op_value(opr):
+    """Resolve one of this binding's rank/size py_function graph ops to
+    its current value (see the EagerPyFunc dispatch case). Foreign
+    py_functions are genuinely uncompilable host calls — fail loud."""
+    import re
+    from . import (rank, local_rank, size, local_size)
+    leaf = opr.name.rsplit("/", 1)[-1]
+    if "horovod_local_rank" in leaf:
+        return np.int32(local_rank())
+    if "horovod_local_size" in leaf:
+        return np.int32(local_size())
+    if "horovod_rank" in leaf:
+        return np.int32(rank())
+    m = re.search(r"horovod_process_set_included_ps(\d+)", leaf)
+    if m:
+        from ..process_sets import process_set_by_id
+        ps = process_set_by_id(int(m.group(1)))
+        if ps is None:
+            raise ValueError(f"no process set with id {m.group(1)}")
+        return np.int32(1 if ps.included() else 0)
+    m = re.search(r"horovod_size_ps(\d+)", leaf)
+    if m:
+        from . import _process_set_size
+        return np.int32(_process_set_size(int(m.group(1))))
+    if "horovod_size" in leaf:
+        return np.int32(size())
+    raise NotImplementedError(
+        f"EagerPyFunc {opr.name!r}: arbitrary py_function host calls "
+        "cannot run inside a compiled TPU program")
+
+
 class _GraphInterpreter:
     """Execute a ConcreteFunction graph with jax values.
 
@@ -317,6 +662,8 @@ class _GraphInterpreter:
         self.fdefs = fdef_library
         self.rng_sites = {}
         self._number_rng_sites(graph, prefix="")
+        self._plan_cache = {}   # graph -> {einsum op name: flash plan}
+        self._gctx = None       # (env, plans) of the graph being run
 
     def _number_rng_sites(self, graph, prefix):
         for opr in graph.get_operations():
@@ -345,19 +692,36 @@ class _GraphInterpreter:
         return flat, self.updates
 
     def _run_graph(self, graph, env, prefix):
-        for opr in graph.get_operations():
-            if opr.type in ("Placeholder", "Arg", "_Arg"):
-                continue  # bound by caller
-            if opr.type == "NoOp":
-                continue
-            args = [env[t.name] for t in opr.inputs]
-            outs = self._dispatch(opr, args, prefix)
-            if outs is _SKIP:
-                continue
-            if not isinstance(outs, tuple):
-                outs = (outs,)
-            for t, v in zip(opr.outputs, outs):
-                env[t.name] = v
+        import jax
+        if graph not in self._plan_cache:
+            self._plan_cache[graph] = (_attention_plans(graph),
+                                       _value_free_ops(graph))
+        plans, value_free = self._plan_cache[graph]
+        prev_ctx = self._gctx
+        self._gctx = (env, plans)
+        try:
+            for opr in graph.get_operations():
+                if opr.type in ("Placeholder", "Arg", "_Arg"):
+                    continue  # bound by caller
+                if opr.type == "NoOp":
+                    continue
+                args = [env[t.name] for t in opr.inputs]
+                if opr.name in value_free:
+                    # Shape-derived subgraph: evaluate eagerly so the
+                    # result stays a compile-time constant under the jit
+                    # trace (see _value_free_ops).
+                    with jax.ensure_compile_time_eval():
+                        outs = self._dispatch(opr, args, prefix)
+                else:
+                    outs = self._dispatch(opr, args, prefix)
+                if outs is _SKIP:
+                    continue
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                for t, v in zip(opr.outputs, outs):
+                    env[t.name] = v
+        finally:
+            self._gctx = prev_ctx
         return env
 
     def _rng_key(self, opr, prefix):
@@ -446,6 +810,16 @@ class _GraphInterpreter:
             return _SKIP
         if t in ("PartitionedCall", "StatefulPartitionedCall"):
             return self._call_function(opr, args, prefix)
+
+        if t == "EagerPyFunc":
+            # The binding's rank/size graph ops are py_functions (they
+            # resolve at execution time on the eager plane, surviving an
+            # elastic shutdown();init()). Inside a compiled program a
+            # host call is impossible, so resolve them to the CURRENT
+            # runtime value at trace time — a fresh trace after a reset
+            # observes the new topology. Identified by the op-name
+            # markers the binding embeds (including the process-set id).
+            return _hvd_query_op_value(opr)
 
         if t == "StatelessRandomGetKeyCounter":
             # TF's seed->key/counter derivation; our randomness comes from
@@ -604,6 +978,15 @@ class _GraphInterpreter:
                            opr.get_attr("adj_x"), opr.get_attr("adj_y"),
                            adjoint=True)
         if t == "Einsum":
+            if self._gctx is not None:
+                env, gplans = self._gctx
+                plan = gplans.get(opr.name)
+                if plan is not None:
+                    from ..ops.flash_attention import bridge_flash_enabled
+                    if bridge_flash_enabled():
+                        out = _try_flash_attention(env, plan, opr)
+                        if out is not None:
+                            return out
             return _einsum_handler(opr, args)
         if t == "BiasAdd":
             return _bias_add(args[0], args[1],

@@ -100,31 +100,13 @@ def _dropout(x, p, train, key):
 
 
 def _flash_enabled():
-    """Route bridge attention through the Pallas flash kernel?  auto =
-    only when the math actually runs on a TPU (in interpret mode the
-    kernel is a python-level grid loop — correct but slow, so the CPU
-    test suite keeps the einsum lowering unless it opts in)."""
-    mode = os.environ.get("HVDTPU_BRIDGE_FLASH", "auto").lower()
-    if mode == "always":
-        return True
-    if mode == "never":
-        return False
-    import jax
-    return jax.default_backend() == "tpu"
-
-
-_flash_fallback_noted = set()
+    from ..ops.flash_attention import bridge_flash_enabled
+    return bridge_flash_enabled()
 
 
 def _note_flash_fallback(reason):
-    if reason not in _flash_fallback_noted:
-        _flash_fallback_noted.add(reason)
-        import warnings
-        warnings.warn(
-            f"hvd.tpu_compile: attention falls back to the einsum "
-            f"lowering ({reason}); the Pallas flash path supports "
-            f"4-D q/k/v with equal head counts and a mask that is "
-            f"None/all-keep at compile time", stacklevel=2)
+    from ..ops.flash_attention import note_flash_fallback
+    note_flash_fallback(reason)
 
 
 def _resolve_static_mask(attn_mask, jnp):
@@ -410,14 +392,30 @@ def _build_function_table():
             if kwargs:
                 raise NotImplementedError(
                     f"min/max kwargs {sorted(kwargs)} unsupported")
+            import numbers
             rest = list(args)
-            if rest and not isinstance(rest[0], (int, bool)):
-                if other is None:
+            if rest:
+                first = rest[0]
+                if isinstance(first, (bool, np.bool_)):
+                    raise NotImplementedError(
+                        "min/max bool positional argument is ambiguous")
+                if isinstance(first, numbers.Integral):
+                    # covers python int AND np.integer: the positional
+                    # integer spelling is torch.min(x, dim); a scalar
+                    # 'other' must use the keyword to disambiguate
+                    if dim is not None:
+                        raise NotImplementedError(
+                            "min/max got both positional and keyword dim")
+                    dim = int(rest.pop(0))
+                    if rest and isinstance(rest[0], (bool, np.bool_)):
+                        keepdim = bool(rest.pop(0))
+                elif getattr(first, "ndim", None) == 0:
+                    raise NotImplementedError(
+                        "min/max with a 0-d positional argument is "
+                        "ambiguous (dim vs elementwise); use the dim= "
+                        "or other= keyword spelling")
+                elif other is None:
                     other = rest.pop(0)
-            elif rest and dim is None:
-                dim = rest.pop(0)
-                if rest and isinstance(rest[0], bool):
-                    keepdim = rest.pop(0)
             if rest:
                 raise NotImplementedError(
                     f"min/max argument pattern {args!r} unsupported")
@@ -599,6 +597,117 @@ def _flatten(x, start, end):
     return x.reshape(new)
 
 
+_VIEW_METHODS = frozenset({
+    "view", "reshape", "transpose", "permute", "expand", "expand_as",
+    "squeeze", "unsqueeze", "narrow", "select", "t", "swapaxes",
+    "swapdims", "movedim", "moveaxis", "diagonal", "flatten", "unfold",
+})
+
+
+def _check_inplace_through_views(graph):
+    """torch propagates an in-place mutation to every alias; this
+    executor rebinds only the direct TARGET node. Any OTHER alias of the
+    target (its base chain, sibling views, views created earlier) read
+    after the mutation would see the stale value — fail loud at compile
+    time instead (the bridge's coverage contract: unsupported aliasing
+    raises, never miscomputes)."""
+    import torch.fx
+
+    order = {n: i for i, n in enumerate(graph.nodes)}
+
+    # Ops whose tuple results are FRESH tensors (no aliasing with the
+    # input): getitem on these extracts an independent tensor, unlike
+    # tensor indexing / chunk / split / unbind, which return views.
+    fresh_tuple = {"max", "min", "topk", "sort", "median", "mode",
+                   "kthvalue"}
+
+    def returns_fresh_tuple(n):
+        if not isinstance(n, torch.fx.Node):
+            return False
+        if n.op == "call_method":
+            return n.target in fresh_tuple
+        if n.op == "call_function":
+            return getattr(n.target, "__name__", "") in fresh_tuple
+        return False
+
+    def is_view(n):
+        if not isinstance(n, torch.fx.Node):
+            return False
+        if n.op == "call_function" and n.target is operator.getitem:
+            base = n.args[0] if n.args else None
+            return not returns_fresh_tuple(base)
+        return n.op == "call_method" and n.target in _VIEW_METHODS
+
+    def node_base(n):
+        if n.args and isinstance(n.args[0], torch.fx.Node):
+            return n.args[0]
+        return None
+
+    views_of = {}
+    for n in graph.nodes:
+        if is_view(n):
+            b = node_base(n)
+            if b is not None:
+                views_of.setdefault(b, []).append(n)
+
+    def alias_set(node):
+        """node + every fx node sharing memory with it: climb the view
+        chain to the root base, then take the root's transitive views."""
+        root = node
+        while is_view(root) and node_base(root) is not None:
+            root = node_base(root)
+        out = set()
+        stack = [root]
+        while stack:
+            cur = stack.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            stack.extend(views_of.get(cur, ()))
+        return out
+
+    for node in graph.nodes:
+        target = None
+        if (node.op == "call_function"
+                and node.target is _op_setitem
+                and node.args
+                and isinstance(node.args[0], torch.fx.Node)):
+            target = node.args[0]
+        elif (node.op == "call_method" and node.target.endswith("_")
+              and not node.target.endswith("__") and node.args
+              and isinstance(node.args[0], torch.fx.Node)):
+            target = node.args[0]
+        if target is None:
+            continue
+        closure = alias_set(target)
+        if closure == {target}:
+            continue
+        # The executor rebinds only `target`. An alias is FRESH (sees
+        # the mutation) iff it is the target itself or a view created
+        # AFTER the mutation whose base is fresh — it was computed from
+        # the rebound value. Every other alias holds the stale
+        # pre-mutation array; reading one after the mutation diverges
+        # from torch.
+        fresh = set()
+        for a in sorted(closure, key=order.get):
+            if a is target:
+                fresh.add(a)
+            elif (is_view(a) and node_base(a) in fresh
+                    and order[a] > order[node]):
+                fresh.add(a)
+        stale = closure - fresh
+        late = sorted(
+            {u.name for a in stale for u in a.users
+             if u is not node and order[u] > order[node]})
+        if late:
+            raise NotImplementedError(
+                f"in-place op {node.name!r} mutates {target.name!r}, "
+                f"which aliases other tensors read afterwards "
+                f"({', '.join(late)}); torch view-aliasing of this form "
+                "is not representable in the fx→JAX bridge — rewrite "
+                "the module with out-of-place ops")
+
+
 class _JaxInterpreter:
     """Execute an fx GraphModule with jax values.
 
@@ -624,6 +733,7 @@ class _JaxInterpreter:
             if self._is_dropout_site(node):
                 self.site_of[node.name] = len(self.site_of)
         self._value_free = self._compute_value_free()
+        _check_inplace_through_views(self.graph)
 
     def _compute_value_free(self):
         """Names of nodes whose value depends on no placeholder's runtime

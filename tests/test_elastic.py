@@ -294,3 +294,36 @@ def test_elastic_host_exclusion(tmp_path):
     assert {e[3] for e in entries} >= {1, 2}, entries
     done = [line for line in open(log_path) if "DONE" in line]
     assert len(done) == 1, content
+
+
+def test_elastic_worker_failure_recovers_xla_plane(tmp_path):
+    """The kill test on the COMPILED data plane (xla-global over
+    jax.distributed): a membership change cannot re-form
+    jax.distributed in-process, so survivors persist their commit to
+    the driver's KV store and exit with RESTART_EXIT_CODE; the driver
+    respawns them fresh and training resumes at the new world size from
+    the last commit (reference semantics:
+    horovod/common/elastic.py:150-176)."""
+    phase_file = tmp_path / "phase"
+    phase_file.write_text("0")
+    log_path = tmp_path / "log"
+    discovery = _write_discovery(tmp_path, phase_file, [["localhost:2"]])
+
+    rc = _launch_elastic(tmp_path, discovery, log_path,
+                         ELASTIC_TEST_EPOCHS=6,
+                         ELASTIC_TEST_EPOCH_SLEEP=0.3,
+                         ELASTIC_TEST_KILL_WORKER="localhost:1",
+                         ELASTIC_TEST_KILL_EPOCH=2,
+                         HVDTPU_CPU_OPERATIONS="xla")
+    content = open(log_path).read() if log_path.exists() else "no log"
+    assert rc == 0, content
+    assert "KILLED epoch=2" in content
+    entries = _parse_log(log_path)
+    # The survivor restarts as a fresh process but restores its
+    # persisted commit: epochs stay monotonic, no restart from zero.
+    survivor = [e for e in entries if e[0] == "localhost:0"]
+    epochs = [e[1] for e in survivor]
+    assert epochs == sorted(epochs), survivor
+    assert max(epochs) == 5, survivor
+    done = [line for line in open(log_path) if "DONE" in line]
+    assert len(done) == 2, content

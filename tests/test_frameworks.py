@@ -53,3 +53,51 @@ def test_torch_binding():
     pytest.importorskip("torch")
     outs = _run("torch_worker.py")
     assert all("TORCH-BINDING OK" in o for o in outs)
+
+
+def test_tf_rank_size_ops_resolve_at_execution_time():
+    """rank_op/size_op are execution-time py_functions (reference:
+    horovod/tensorflow/mpi_ops.py:410-472): a tf.function that captured
+    them observes post-trace runtime changes rather than a stale
+    trace-time constant (the elastic shutdown();init() contract)."""
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+
+    @tf.function
+    def f():
+        return hvd.size_op() + 0
+
+    assert int(f()) == hvd.size()
+    assert int(hvd.rank_op()) == hvd.rank()
+    assert int(hvd.local_size_op()) == hvd.local_size()
+    assert int(hvd.process_set_included_op()) == 1
+    # execution-time resolution: monkey-swap the runtime answer and the
+    # SAME traced function must see the new value
+    import horovod_tpu.tensorflow as m
+    real_size = m.size
+    try:
+        m.size = lambda: 41
+        assert int(f()) == 41
+    finally:
+        m.size = real_size
+    assert int(f()) == hvd.size()
+
+
+def test_tf_size_op_compiles_through_bridge():
+    """size_op inside a tpu_compile'd function resolves to the current
+    topology value at trace time (EagerPyFunc dispatch) instead of
+    failing as an uncompilable host call."""
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+    import horovod_tpu.tensorflow as hvd
+    from horovod_tpu.tensorflow.compile import tpu_compile
+    hvd.init()
+
+    def f(x):
+        return x * tf.cast(hvd.size_op(), tf.float32) \
+            + tf.cast(hvd.rank_op(), tf.float32)
+
+    x = np.ones((4,), np.float32)
+    out = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+    np.testing.assert_allclose(out, x * hvd.size() + hvd.rank())

@@ -335,3 +335,101 @@ def test_int64_inputs_narrow():
     compiled = tpu_compile(fwd, example_inputs=(ids,))
     np.testing.assert_allclose(np.asarray(compiled(ids)),
                                (ids * 2).astype(np.float32))
+
+
+def _mha_model(use_causal_mask):
+    tf.keras.utils.set_random_seed(0)
+    inp = tf.keras.Input((32, 64))
+    h = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=16)(
+        inp, inp, use_causal_mask=use_causal_mask)
+    out = tf.keras.layers.Dense(8)(h)
+    return tf.keras.Model(inp, out)
+
+
+@pytest.mark.parametrize("use_causal_mask", [False, True])
+def test_keras_mha_flash_routing_parity(monkeypatch, use_causal_mask):
+    """The Einsum→[scale]→[mask]→Softmax→Einsum pattern lowers to the
+    Pallas flash kernel (keras's SelectV2 causal mask is recognized as
+    such after shape-derived const folding) with einsum-path parity."""
+    model = _mha_model(use_causal_mask)
+    x = np.random.RandomState(0).normal(size=(2, 32, 64)).astype(
+        np.float32)
+
+    def f(a):
+        return model(a, training=False)
+
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "never")
+    ref = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+
+    from horovod_tpu.ops import flash_attention as fa_mod
+    hits = []
+    orig = fa_mod.flash_attention
+
+    def spy(*args, **kwargs):
+        hits.append(kwargs.get("causal"))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
+    out = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+    assert hits == [use_causal_mask]
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_keras_mha_flash_training_gradients(monkeypatch):
+    """Training through the flash-routed attention still converges (the
+    kernel's custom VJP feeds the keras projection weights)."""
+    optax = pytest.importorskip("optax")
+    model = _mha_model(False)
+    x = np.random.RandomState(1).normal(size=(8, 32, 64)).astype(
+        np.float32)
+    y = np.random.RandomState(2).normal(size=(8, 32, 8)).astype(
+        np.float32)
+
+    def loss_fn(a, t):
+        pred = model(a, training=True)
+        return tf.reduce_mean(tf.square(pred - t))
+
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
+    compiled = tpu_compile(loss_fn,
+                           example_inputs=(tf.constant(x), tf.constant(y)))
+    step = compiled.make_train_step(optax.adam(1e-2))
+    losses = [float(step((x, y))) for _ in range(5)]
+    assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_keras_mha_flash_fallback_on_padding_mask(monkeypatch):
+    """A data-dependent key-padding mask cannot const-fold: the pattern
+    must fall back to the einsum lowering and stay correct."""
+    tf.keras.utils.set_random_seed(0)
+    inp = tf.keras.Input((32, 64))
+    mask_in = tf.keras.Input((32,), dtype="bool")
+    h = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=16)(
+        inp, inp, attention_mask=mask_in[:, None, :])
+    model = tf.keras.Model([inp, mask_in], h)
+    x = np.random.RandomState(0).normal(size=(2, 32, 64)).astype(
+        np.float32)
+    mask = np.ones((2, 32), bool)
+    mask[:, -7:] = False
+
+    def f(a, m):
+        return model([a, m], training=False)
+
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "never")
+    ref = np.asarray(tpu_compile(
+        f, example_inputs=(tf.constant(x), tf.constant(mask)))(x, mask))
+
+    from horovod_tpu.ops import flash_attention as fa_mod
+    hits = []
+    orig = fa_mod.flash_attention
+
+    def spy(*args, **kwargs):
+        hits.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
+    out = np.asarray(tpu_compile(
+        f, example_inputs=(tf.constant(x), tf.constant(mask)))(x, mask))
+    assert not hits, "padding mask must not route to the flash kernel"
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)

@@ -506,3 +506,88 @@ def test_flash_fallback_on_real_padding_mask(monkeypatch):
         torch_loss = float(model(input_ids=ids, attention_mask=attn,
                                  labels=labels).loss)
     np.testing.assert_allclose(loss2, torch_loss, rtol=1e-3, atol=1e-3)
+
+
+def test_min_max_integral_dim_spellings():
+    """np.integer dims select the reduce spelling; ambiguous 0-d
+    positional arguments fail loud instead of silently computing
+    elementwise (the bridge's coverage contract)."""
+    import jax.numpy as jnp
+    from horovod_tpu.torch.compile import _build_function_table
+
+    h = _build_function_table()[torch.max]
+    x = jnp.asarray(np.random.RandomState(0).normal(size=(3, 5)),
+                    jnp.float32)
+    out = h(x, np.int64(1))                       # np.integer dim
+    np.testing.assert_allclose(np.asarray(out.values),
+                               np.asarray(jnp.max(x, axis=1)))
+    np.testing.assert_allclose(np.asarray(out.indices),
+                               np.asarray(jnp.argmax(x, axis=1)))
+    with pytest.raises(NotImplementedError, match="ambiguous"):
+        h(x, jnp.asarray(0.5))                    # 0-d tensor positional
+    with pytest.raises(NotImplementedError, match="ambiguous"):
+        h(x, True)                                # bool positional
+    np.testing.assert_allclose(                   # keyword spelling works
+        np.asarray(h(x, other=jnp.asarray(0.5))),
+        np.asarray(jnp.maximum(x, 0.5)))
+
+
+def test_inplace_through_view_fails_loud():
+    """In-place mutation through a view whose base is read later cannot
+    be represented (the executor rebinds only the direct target) — it
+    must raise at compile time, never miscompute."""
+
+    class Net(torch.nn.Module):
+        def forward(self, x):
+            y = x.transpose(0, 1)
+            y.add_(1.0)
+            return x.sum() + y.sum()
+
+    with pytest.raises(NotImplementedError, match="view"):
+        tpu_compile(Net().eval())
+
+
+def test_inplace_on_fresh_tuple_getitem_allowed():
+    """getitem on torch.max's tuple extracts a FRESH tensor — in-place
+    ops on it are legal even when the tuple is read again later."""
+
+    class Net(torch.nn.Module):
+        def forward(self, x):
+            m = torch.max(x, 1)
+            vals = m[0]
+            vals.clamp_(min=0.0)
+            return vals.sum() + m[1].to(x.dtype).sum()
+
+    net = Net().eval()
+    x = torch.randn(3, 5)
+    compiled = tpu_compile(net)
+    ref = net(x)
+    np.testing.assert_allclose(np.asarray(compiled(x=x)),
+                               ref.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_inplace_on_base_with_live_view_fails_loud():
+    """Mutating a BASE whose view is read afterwards is the dual of the
+    view-target case — equally unrepresentable, equally loud."""
+
+    class Net(torch.nn.Module):
+        def forward(self, x):
+            y = x.transpose(0, 1)
+            x.add_(1.0)
+            return y.sum()
+
+    with pytest.raises(NotImplementedError, match="alias"):
+        tpu_compile(Net().eval())
+
+
+def test_inplace_with_sibling_view_fails_loud():
+    class Net(torch.nn.Module):
+        def forward(self, x):
+            z = x.flatten()
+            y = x.transpose(0, 1)
+            y.add_(1.0)
+            return z.sum()
+
+    with pytest.raises(NotImplementedError, match="alias"):
+        tpu_compile(Net().eval())
