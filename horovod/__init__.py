@@ -1,0 +1,66 @@
+"""Drop-in ``horovod`` namespace: every ``horovod.X`` import resolves to
+the SAME module object as ``horovod_tpu.X``.
+
+This is what lets verbatim reference training scripts
+(``import horovod.tensorflow as hvd``, ``import horovod.torch as hvd``,
+``import horovod.keras as hvd`` — reference:
+examples/tensorflow2/tensorflow2_mnist.py:17,
+examples/pytorch/pytorch_mnist.py:12, examples/keras/keras_mnist.py:9)
+run unmodified against this framework
+(tests/test_reference_examples.py). A meta-path finder — not a second
+package tree — so there is exactly ONE runtime: process sets, the
+native core, and jax state are shared no matter which name a module
+was imported under.
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+import horovod_tpu as _impl
+from horovod_tpu import *  # noqa: F401,F403 — top-level API surface
+
+__version__ = _impl.__version__
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, module):
+        self._module = module
+
+    def create_module(self, spec):
+        return self._module
+
+    def exec_module(self, module):
+        """No-op: the horovod_tpu module is already fully executed."""
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    """Resolve ``horovod.a.b`` to the already-imported (or importable)
+    ``horovod_tpu.a.b`` module object."""
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("horovod."):
+            return None
+        real = "horovod_tpu." + fullname[len("horovod."):]
+        try:
+            module = importlib.import_module(real)
+        except ImportError:
+            return None
+        spec = importlib.util.spec_from_loader(
+            fullname, _AliasLoader(module), origin=getattr(
+                module, "__file__", None))
+        if getattr(module, "__path__", None) is not None:
+            spec.submodule_search_locations = list(module.__path__)
+        return spec
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+
+def __getattr__(name):
+    """`import horovod; horovod.tensorflow` attribute-style access."""
+    module = importlib.import_module(f"horovod_tpu.{name}")
+    sys.modules[f"horovod.{name}"] = module
+    return module

@@ -103,13 +103,18 @@ def _spmd():
 
 
 # Graph-op variants (reference: horovod/tensorflow/mpi_ops.py:410-472
-# rank/size query ops usable inside graphs). Like the reference kernels,
-# these resolve at graph EXECUTION time — elastic mode re-forms the
-# runtime in-process (shutdown(); init()), so a tf.function that
-# captured one of these must observe the NEW rank/size after a reset, a
-# trace-time tf.constant would silently keep the stale value.
+# rank/size query ops usable inside graphs). Under ELASTIC mode they
+# resolve at graph EXECUTION time (py_function) — the runtime re-forms
+# with new ranks/sizes on membership changes, so a tf.function that
+# captured one must observe the NEW value after a reset. Outside elastic
+# mode rank/size genuinely are fixed for the process lifetime, and a
+# tf.constant keeps jit_compile=True / SavedModel export working
+# (EagerPyFunc is neither XLA-compilable nor serializable).
 def _runtime_scalar_op(fn, name):
     tf = _tf()
+    from ..utils import envparse
+    if not envparse.get_bool(envparse.ELASTIC):
+        return tf.constant(np.int32(fn()), name=name)
 
     def _value():
         return np.int32(fn())
@@ -176,12 +181,17 @@ def _np_of(tensor):
         tf.convert_to_tensor(tensor))
 
 
-def _eager(fn, tensors, out_dtypes, name):
+def _eager(fn, tensors, out_dtypes, name, shape_preserving=False):
     """Run fn (numpy -> list[numpy]) now if eager, else via py_function so
     it works inside tf.function graphs. Results are cast back to
     out_dtypes: the data plane runs x64-off, so float64/int64 inputs come
     back narrowed and the reference contract (result dtype == input
-    dtype) must be restored here."""
+    dtype) must be restored here.
+
+    shape_preserving: for ops whose output shape equals the input shape
+    (allreduce/broadcast families), re-attach the static shapes that
+    py_function erases — keras-3's optimizer engine calls
+    ``grad.shape.as_list()`` and chokes on unknown shapes otherwise."""
     tf = _tf()
 
     def restore(outs):
@@ -194,7 +204,12 @@ def _eager(fn, tensors, out_dtypes, name):
     def wrapper(*args):
         return restore(fn([a.numpy() for a in args]))
 
-    return tf.py_function(func=wrapper, inp=list(tensors), Tout=out_dtypes)
+    outs = tf.py_function(func=wrapper, inp=list(tensors),
+                          Tout=out_dtypes)
+    if shape_preserving:
+        outs = [tf.ensure_shape(o, tf.convert_to_tensor(t).shape)
+                for o, t in zip(outs, tensors)]
+    return outs
 
 
 def _result_np(x):
@@ -230,7 +245,8 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
                            process_set=process_set)
         return [_result_np(out)]
 
-    return _eager(fn, [tensor], [tensor.dtype], name)[0]
+    return _eager(fn, [tensor], [tensor.dtype], name,
+                  shape_preserving=True)[0]
 
 
 def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
@@ -253,7 +269,8 @@ def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
                                     process_set=process_set)
         return [_result_np(o) for o in outs]
 
-    return _eager(fn, tensors, [t.dtype for t in tensors], name)
+    return _eager(fn, tensors, [t.dtype for t in tensors], name,
+                  shape_preserving=True)
 
 
 def allgather(tensor, name=None, process_set=global_process_set):
@@ -276,7 +293,8 @@ def broadcast(tensor, root_rank, name=None,
         return [_result_np(_c.broadcast(arrs[0], root_rank, name=name,
                                         process_set=process_set))]
 
-    return _eager(fn, [tensor], [tensor.dtype], name)[0]
+    return _eager(fn, [tensor], [tensor.dtype], name,
+                  shape_preserving=True)[0]
 
 
 def alltoall(tensor, splits=None, name=None,
@@ -356,14 +374,37 @@ def allgather_object(obj, name=None):
 
 def broadcast_variables(variables, root_rank=0):
     """Assign every variable its root-rank value (fused broadcast;
-    reference: horovod/tensorflow/functions.py:66)."""
+    reference: horovod/tensorflow/functions.py:66). Works inside
+    tf.function graphs too — the reference examples call it from a
+    @tf.function training step (reference:
+    examples/tensorflow2/tensorflow2_mnist.py:75), so the host-side
+    exchange rides tf.py_function there, like every collective in this
+    binding."""
+    tf = _tf()
     from ..functions import broadcast_variables as _bv
     variables = list(variables)
     if not variables or not _spmd():
         return
-    outs = _bv([v.numpy() for v in variables], root_rank=root_rank)
-    for v, out in zip(variables, outs):
-        v.assign(np.asarray(out))
+
+    def assign_all(arrays):
+        outs = _bv(arrays, root_rank=root_rank)
+        for v, out in zip(variables, outs):
+            # keras-3 variables report dtype as a STRING; normalize.
+            np_dtype = tf.as_dtype(v.dtype).as_numpy_dtype
+            v.assign(np.asarray(out).astype(np_dtype, copy=False))
+        return [np.int32(0)]
+
+    if tf.executing_eagerly():
+        assign_all([v.numpy() for v in variables])
+        return
+
+    def wrapper(*args):
+        assign_all([a.numpy() for a in args])
+        return tf.constant(0, tf.int32)
+
+    tf.py_function(func=wrapper,
+                   inp=[tf.convert_to_tensor(v) for v in variables],
+                   Tout=[tf.int32])
 
 
 def join(device=-1):

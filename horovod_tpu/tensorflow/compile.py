@@ -636,6 +636,11 @@ def _hvd_query_op_value(opr):
         if ps is None:
             raise ValueError(f"no process set with id {m.group(1)}")
         return np.int32(1 if ps.included() else 0)
+    if "horovod_process_set_included" in leaf:
+        raise NotImplementedError(
+            f"EagerPyFunc {opr.name!r}: process_set_included_op over an "
+            "unregistered process set (id None) cannot be resolved in a "
+            "compiled program; add the process set before tracing")
     m = re.search(r"horovod_size_ps(\d+)", leaf)
     if m:
         from . import _process_set_size
@@ -644,7 +649,9 @@ def _hvd_query_op_value(opr):
         return np.int32(size())
     raise NotImplementedError(
         f"EagerPyFunc {opr.name!r}: arbitrary py_function host calls "
-        "cannot run inside a compiled TPU program")
+        "cannot run inside a compiled TPU program. If this is one of the "
+        "binding's rank/size ops created with a custom name=, keep the "
+        "default name — the bridge resolves them by their name markers")
 
 
 class _GraphInterpreter:

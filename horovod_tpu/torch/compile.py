@@ -400,20 +400,16 @@ def _build_function_table():
                     raise NotImplementedError(
                         "min/max bool positional argument is ambiguous")
                 if isinstance(first, numbers.Integral):
-                    # covers python int AND np.integer: the positional
-                    # integer spelling is torch.min(x, dim); a scalar
-                    # 'other' must use the keyword to disambiguate
+                    # covers python int AND np.integer: torch's dim must
+                    # be a python-level integer, so an Integral
+                    # positional is ALWAYS the dim spelling; tensors
+                    # (even 0-d) are always elementwise 'other'.
                     if dim is not None:
                         raise NotImplementedError(
                             "min/max got both positional and keyword dim")
                     dim = int(rest.pop(0))
                     if rest and isinstance(rest[0], (bool, np.bool_)):
                         keepdim = bool(rest.pop(0))
-                elif getattr(first, "ndim", None) == 0:
-                    raise NotImplementedError(
-                        "min/max with a 0-d positional argument is "
-                        "ambiguous (dim vs elementwise); use the dim= "
-                        "or other= keyword spelling")
                 elif other is None:
                     other = rest.pop(0)
             if rest:
@@ -553,9 +549,9 @@ def _method_table():
                 x, axis=dim, keepdims=keepdim),
             "pow": jnp.power,
             "tanh": jnp.tanh,
-            "split": lambda x, size, dim=-1: tuple(
+            "split": lambda x, size, dim=0: tuple(
                 jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
-            "chunk": lambda x, n, dim=-1: tuple(jnp.split(x, n, axis=dim)),
+            "chunk": lambda x, n, dim=0: tuple(jnp.split(x, n, axis=dim)),
             "flatten": lambda x, start=0, end=-1: _flatten(x, start, end),
             "repeat": lambda x, *reps: jnp.tile(x, _normalize_size(reps)),
             "t": lambda x: x.T,
@@ -601,6 +597,9 @@ _VIEW_METHODS = frozenset({
     "view", "reshape", "transpose", "permute", "expand", "expand_as",
     "squeeze", "unsqueeze", "narrow", "select", "t", "swapaxes",
     "swapdims", "movedim", "moveaxis", "diagonal", "flatten", "unfold",
+    # multi-output view ops: every element of the returned tuple aliases
+    # the input, so the tuple node itself joins the alias closure
+    "chunk", "split", "unbind", "tensor_split", "hsplit", "vsplit",
 })
 
 
@@ -633,9 +632,12 @@ def _check_inplace_through_views(graph):
     def is_view(n):
         if not isinstance(n, torch.fx.Node):
             return False
-        if n.op == "call_function" and n.target is operator.getitem:
-            base = n.args[0] if n.args else None
-            return not returns_fresh_tuple(base)
+        if n.op == "call_function":
+            if n.target is operator.getitem:
+                base = n.args[0] if n.args else None
+                return not returns_fresh_tuple(base)
+            # function spellings: torch.chunk/split/transpose/...
+            return getattr(n.target, "__name__", "") in _VIEW_METHODS
         return n.op == "call_method" and n.target in _VIEW_METHODS
 
     def node_base(n):

@@ -1,0 +1,196 @@
+"""Run a VERBATIM reference example script against this framework.
+
+    python -m example_runner /path/to/reference_example.py [args...]
+
+The script itself is executed byte-identical (``runpy``, ``__main__``
+semantics) — proving the drop-in claim of BASELINE.json ("existing
+examples/tensorflow2, examples/keras and examples/pytorch training
+scripts run unmodified"). What this runner prepares is the ENVIRONMENT
+the reference script assumes but CI does not have:
+
+- ``import horovod.X`` resolves to horovod_tpu.X via the repo's
+  ``horovod`` alias package (same module objects, one runtime).
+- Dataset downloads are stubbed: synthetic MNIST arrays served from
+  memory (this image has no network egress), and a minimal fake
+  ``torchvision`` (the reference pytorch example imports it; the real
+  package is not installed here).
+- TF1-era shims for keras_mnist.py (``tf.ConfigProto``, ``tf.Session``,
+  ``K.set_session``): the script predates TF2; modern TF removed these.
+  Documented known incompatibility of the SCRIPT with modern TF — the
+  shims are inert (GPU session config has no TPU meaning).
+- Smoke caps: ``tf.data.Dataset.take`` is bounded by
+  HVDTPU_EXAMPLE_MAX_STEPS (default 24) so the tf2 example's
+  10000-step loop stays CI-sized. Training math is untouched.
+"""
+
+import os
+import runpy
+import sys
+import types
+
+import numpy as np
+
+MAX_STEPS = int(os.environ.get("HVDTPU_EXAMPLE_MAX_STEPS", "24"))
+N_TRAIN = int(os.environ.get("HVDTPU_EXAMPLE_TRAIN_SAMPLES", "512"))
+N_TEST = int(os.environ.get("HVDTPU_EXAMPLE_TEST_SAMPLES", "256"))
+
+
+def _fake_mnist(n):
+    rng = np.random.RandomState(1234)
+    images = rng.randint(0, 256, size=(n, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, size=(n,)).astype(np.uint8)
+    return images, labels
+
+
+def _patch_keras_datasets():
+    import keras
+
+    def load_data(path="mnist.npz"):
+        del path
+        return _fake_mnist(N_TRAIN), _fake_mnist(N_TEST)
+
+    keras.datasets.mnist.load_data = load_data
+    try:
+        import tensorflow as tf
+        tf.keras.datasets.mnist.load_data = load_data
+    except (ImportError, AttributeError):
+        pass
+
+
+def _patch_tf1_shims():
+    import tensorflow as tf
+    import keras
+
+    class _GpuOptions:
+        allow_growth = False
+        visible_device_list = ""
+
+    class _ConfigProto:
+        def __init__(self, **kwargs):
+            self.gpu_options = _GpuOptions()
+
+    if not hasattr(tf, "ConfigProto"):
+        tf.ConfigProto = _ConfigProto
+    if not hasattr(tf, "Session"):
+        tf.Session = lambda config=None: None
+    if not hasattr(keras.backend, "set_session"):
+        keras.backend.set_session = lambda session: None
+
+
+def _patch_keras2_optimizer_compat():
+    """keras-2 scripts call ``opt.variables()``; keras 3 made it a list
+    property. Serve a list subclass that is also callable (returning
+    itself), so both spellings work."""
+    import keras
+
+    class _CallableList(list):
+        def __call__(self):
+            return self
+
+    for klass in type(keras.optimizers.Adam(0.1)).__mro__:
+        prop = vars(klass).get("variables")
+        if isinstance(prop, property):
+            fget = prop.fget
+            setattr(klass, "variables",
+                    property(lambda self, _f=fget: _CallableList(
+                        _f(self))))
+            break
+
+
+def _patch_dataset_take_cap():
+    import tensorflow as tf
+    orig_take = tf.data.Dataset.take
+
+    def take(self, count, name=None):
+        if isinstance(count, int) and count > MAX_STEPS:
+            count = MAX_STEPS
+        return orig_take(self, count) if name is None else orig_take(
+            self, count, name=name)
+
+    tf.data.Dataset.take = take
+
+
+def _install_fake_torchvision():
+    """Minimal torchvision surface for pytorch_mnist.py: MNIST dataset +
+    ToTensor/Normalize/Compose transforms, serving synthetic digits."""
+    import torch
+
+    tv = types.ModuleType("torchvision")
+    datasets_mod = types.ModuleType("torchvision.datasets")
+    transforms_mod = types.ModuleType("torchvision.transforms")
+
+    class Compose:
+        def __init__(self, fns):
+            self.fns = fns
+
+        def __call__(self, x):
+            for fn in self.fns:
+                x = fn(x)
+            return x
+
+    class ToTensor:
+        def __call__(self, x):
+            arr = np.asarray(x, dtype=np.float32) / 255.0
+            return torch.from_numpy(arr)[None]  # (1, H, W)
+
+    class Normalize:
+        def __init__(self, mean, std):
+            self.mean, self.std = mean[0], std[0]
+
+        def __call__(self, t):
+            return (t - self.mean) / self.std
+
+    class MNIST(torch.utils.data.Dataset):
+        def __init__(self, root, train=True, download=False,
+                     transform=None):
+            del root, download
+            images, labels = _fake_mnist(N_TRAIN if train else N_TEST)
+            self.images, self.labels = images, labels
+            self.transform = transform
+
+        def __len__(self):
+            return len(self.images)
+
+        def __getitem__(self, i):
+            x = self.images[i]
+            if self.transform is not None:
+                x = self.transform(x)
+            return x, int(self.labels[i])
+
+    datasets_mod.MNIST = MNIST
+    transforms_mod.Compose = Compose
+    transforms_mod.ToTensor = ToTensor
+    transforms_mod.Normalize = Normalize
+    tv.datasets = datasets_mod
+    tv.transforms = transforms_mod
+    sys.modules["torchvision"] = tv
+    sys.modules["torchvision.datasets"] = datasets_mod
+    sys.modules["torchvision.transforms"] = transforms_mod
+
+
+def main():
+    script = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    with open(script) as f:
+        text = f.read()
+    needs_tf = "tensorflow" in text or "keras" in text
+    if "torchvision" in text:
+        _install_fake_torchvision()
+    if needs_tf:
+        _patch_keras_datasets()
+        _patch_tf1_shims()
+        _patch_keras2_optimizer_compat()
+        _patch_dataset_take_cap()
+
+    runpy.run_path(script, run_name="__main__")
+    # The launcher asserts on exit code; a marker helps the test assert
+    # on output too.
+    print(f"EXAMPLE-RUNNER OK {os.path.basename(script)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -55,14 +55,17 @@ def test_torch_binding():
     assert all("TORCH-BINDING OK" in o for o in outs)
 
 
-def test_tf_rank_size_ops_resolve_at_execution_time():
-    """rank_op/size_op are execution-time py_functions (reference:
-    horovod/tensorflow/mpi_ops.py:410-472): a tf.function that captured
-    them observes post-trace runtime changes rather than a stale
-    trace-time constant (the elastic shutdown();init() contract)."""
+def test_tf_rank_size_ops_resolve_at_execution_time(monkeypatch):
+    """Under ELASTIC mode, rank_op/size_op are execution-time
+    py_functions (reference: horovod/tensorflow/mpi_ops.py:410-472): a
+    tf.function that captured them observes post-reset runtime changes
+    rather than a stale trace-time constant. Outside elastic mode they
+    are constants (rank/size are fixed for the process lifetime, and
+    constants keep jit_compile/SavedModel working)."""
     tf = pytest.importorskip("tensorflow")
     import horovod_tpu.tensorflow as hvd
     hvd.init()
+    monkeypatch.setenv("HVDTPU_ELASTIC", "1")
 
     @tf.function
     def f():
@@ -82,17 +85,23 @@ def test_tf_rank_size_ops_resolve_at_execution_time():
     finally:
         m.size = real_size
     assert int(f()) == hvd.size()
+    # non-elastic: a plain constant — XLA-compilable and serializable
+    monkeypatch.delenv("HVDTPU_ELASTIC")
+    const = hvd.size_op()
+    assert int(const) == hvd.size()
 
 
-def test_tf_size_op_compiles_through_bridge():
+def test_tf_size_op_compiles_through_bridge(monkeypatch):
     """size_op inside a tpu_compile'd function resolves to the current
     topology value at trace time (EagerPyFunc dispatch) instead of
-    failing as an uncompilable host call."""
+    failing as an uncompilable host call. Elastic mode is what makes
+    these ops py_functions in the first place."""
     tf = pytest.importorskip("tensorflow")
     import numpy as np
     import horovod_tpu.tensorflow as hvd
     from horovod_tpu.tensorflow.compile import tpu_compile
     hvd.init()
+    monkeypatch.setenv("HVDTPU_ELASTIC", "1")
 
     def f(x):
         return x * tf.cast(hvd.size_op(), tf.float32) \

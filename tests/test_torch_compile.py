@@ -523,8 +523,11 @@ def test_min_max_integral_dim_spellings():
                                np.asarray(jnp.max(x, axis=1)))
     np.testing.assert_allclose(np.asarray(out.indices),
                                np.asarray(jnp.argmax(x, axis=1)))
-    with pytest.raises(NotImplementedError, match="ambiguous"):
-        h(x, jnp.asarray(0.5))                    # 0-d tensor positional
+    # tensors (even 0-d) are ALWAYS elementwise 'other' in torch —
+    # dim must be a python-level integer
+    np.testing.assert_allclose(
+        np.asarray(h(x, jnp.asarray(0.5))),
+        np.asarray(jnp.maximum(x, 0.5)))
     with pytest.raises(NotImplementedError, match="ambiguous"):
         h(x, True)                                # bool positional
     np.testing.assert_allclose(                   # keyword spelling works
@@ -588,6 +591,20 @@ def test_inplace_with_sibling_view_fails_loud():
             y = x.transpose(0, 1)
             y.add_(1.0)
             return z.sum()
+
+    with pytest.raises(NotImplementedError, match="alias"):
+        tpu_compile(Net().eval())
+
+
+def test_inplace_on_chunk_view_fails_loud():
+    """chunk/split return VIEWS: mutating one while the base is read
+    later must raise, not silently drop the mutation from the base."""
+
+    class Net(torch.nn.Module):
+        def forward(self, x):
+            a = x.chunk(2, 0)[0]
+            a.add_(1.0)
+            return x.sum()
 
     with pytest.raises(NotImplementedError, match="alias"):
         tpu_compile(Net().eval())
