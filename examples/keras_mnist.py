@@ -20,9 +20,14 @@ import horovod_tpu.keras as hvd  # noqa: E402
 
 
 def main():
-    import keras
-
     hvd.init()
+    # On a TPU-VM an unmodified run should land on the chip: pick the
+    # jax keras backend (compiled model.fit via set_data_parallel)
+    # unless the user chose one explicitly. After init (the backend
+    # probe must not pre-empt jax.distributed), before keras imports.
+    from horovod_tpu.utils.engine import default_keras_backend_to_jax
+    default_keras_backend_to_jax()
+    import keras
     jax_backend = keras.backend.backend() == "jax"
     if jax_backend and hvd.size() == 1:
         # Single-controller mode: one process drives every local chip with

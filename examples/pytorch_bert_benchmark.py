@@ -70,10 +70,8 @@ def main():
     hvd.init()
     torch.manual_seed(42)
 
-    engine = args.engine
-    if engine == "auto":
-        import jax
-        engine = "tpu" if jax.default_backend() == "tpu" else "torch"
+    from horovod_tpu.utils.engine import resolve_engine
+    engine = resolve_engine(args.engine, host_engine="torch")
 
     model, cfg = build_model(args)
 
@@ -142,7 +140,13 @@ def main():
         f"{n_params / 1e6:.0f}M params, batch {args.batch_size}, "
         f"seq {args.seq_len}, ranks {hvd.size()}")
 
-    benchmark_step()  # warmup (tpu: compile) + hook registration
+    # Two warmups: the first compiles; the second absorbs the one-time
+    # re-jit after parameters become device-resident (their shardings
+    # change between init and step 1) — otherwise the first timed iter
+    # reports compile time as throughput.
+    benchmark_step()
+    finish()
+    benchmark_step()
     finish()
     samples = []
     for _ in range(args.num_iters):

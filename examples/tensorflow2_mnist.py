@@ -29,12 +29,16 @@ def synthetic_mnist(rank, samples=512):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--engine", choices=["tf", "tpu"], default="tf",
+    p.add_argument("--engine", choices=["auto", "tf", "tpu"],
+                   default="auto",
                    help="tf: eager TF step + host-plane collectives; "
                         "tpu: model math compiled on the chip via "
-                        "hvd.tpu_compile")
+                        "hvd.tpu_compile; auto (default): tpu iff a "
+                        "TPU is present (HVDTPU_ENGINE overrides)")
     args = p.parse_args()
     hvd.init()
+    from horovod_tpu.utils.engine import resolve_engine
+    args.engine = resolve_engine(args.engine)
 
     x, y = synthetic_mnist(hvd.rank())
     dataset = tf.data.Dataset.from_tensor_slices((x, y)) \

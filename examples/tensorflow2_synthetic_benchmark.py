@@ -44,10 +44,12 @@ def parse_args():
     p.add_argument("--num-warmup-batches", type=int, default=2)
     p.add_argument("--tiny", action="store_true",
                    help="tiny conv net + 32px images (CI smoke)")
-    p.add_argument("--engine", choices=["tf", "tpu"], default="tf",
+    p.add_argument("--engine", choices=["auto", "tf", "tpu"],
+                   default="auto",
                    help="tf: eager TF step with host-plane collectives; "
                         "tpu: graph compiled to one XLA program via "
-                        "hvd.tpu_compile")
+                        "hvd.tpu_compile; auto (default): tpu iff a "
+                        "TPU is present (HVDTPU_ENGINE overrides)")
     return p.parse_args()
 
 
@@ -66,6 +68,10 @@ def build_model(args):
 def main():
     args = parse_args()
     hvd.init()
+    # resolve AFTER init: probing jax.default_backend() earlier would
+    # initialize the backend before jax.distributed can form (xla-global)
+    from horovod_tpu.utils.engine import resolve_engine
+    args.engine = resolve_engine(args.engine)
 
     model, image = build_model(args)
     # Gradient averaging rides the DistributedGradientTape below; a

@@ -61,6 +61,13 @@ if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
 
 def __getattr__(name):
     """`import horovod; horovod.tensorflow` attribute-style access."""
-    module = importlib.import_module(f"horovod_tpu.{name}")
+    try:
+        module = importlib.import_module(f"horovod_tpu.{name}")
+    except ImportError as e:
+        # PEP 562: missing attributes must raise AttributeError —
+        # hasattr/getattr-with-default and star-import __all__ probes
+        # depend on it.
+        raise AttributeError(
+            f"module 'horovod' has no attribute {name!r}") from e
     sys.modules[f"horovod.{name}"] = module
     return module

@@ -55,6 +55,33 @@ def adasum_tree(stacked):
     return xs[0]
 
 
+def adasum_pair_np(a, b):
+    """Numpy float64 reference of the pairwise rule — the ONE oracle
+    shared by the host-plane SPMD test, the compiled-plane tests, and
+    the multichip dryrun leg (duplicating it risks the copies drifting
+    on the zero-norm guard / promotion details)."""
+    import numpy as np
+    af = np.asarray(a, np.float64).ravel()
+    bf = np.asarray(b, np.float64).ravel()
+    dot = float(af @ bf)
+    na = float(af @ af)
+    nb = float(bf @ bf)
+    ca = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+    cb = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+    return (ca * np.asarray(a, np.float64)
+            + cb * np.asarray(b, np.float64))
+
+
+def adasum_vhdd_np(stack):
+    """Numpy pairwise VHDD tree over a list/stack of tensors."""
+    import numpy as np
+    xs = [np.asarray(x, np.float64) for x in stack]
+    while len(xs) > 1:
+        xs = [adasum_pair_np(xs[i], xs[i + 1])
+              for i in range(0, len(xs), 2)]
+    return xs[0]
+
+
 def adasum_allreduce_stacked(backend, arrays, process_set, prescale=None,
                              postscale=None):
     """Eager stacked Adasum used by XlaSingleBackend (one jitted program per

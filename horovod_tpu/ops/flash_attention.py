@@ -137,10 +137,34 @@ def _keep_scale(dm_ref, dropout_rate):
     return dm_ref[0].astype(jnp.float32) * (1.0 / (1.0 - dropout_rate))
 
 
+def _seeded_keep_scale(lens_ref, qb, kb, block_q, block_k, dropout_rate):
+    """fp32 dropout multiplier drawn from the ON-CHIP prng (TPU only):
+    seeded per (batch·head, q-tile, k-tile), so the forward and both
+    backward kernels regenerate the exact same keep pattern without a
+    single byte of mask leaving VMEM — no bernoulli host program, no
+    O(S²) mask residual. The threshold compare gives keep probability
+    exact to 2^-32.
+
+    Mosaic accepts at most TWO seed words: the batch·head index folds
+    into the user seed via an odd multiplicative hash (a bijection mod
+    2^32, so distinct bh stay distinct), and the tile coordinates pack
+    into the second word (16 bits each — tile counts beyond 65536 would
+    mean a >8M-token sequence)."""
+    bh = pl.program_id(0)
+    s1 = jnp.bitwise_xor(lens_ref[3], bh * jnp.int32(-1640531527))
+    s2 = qb * jnp.int32(65536) + kb
+    pltpu.prng_seed(s1, s2)
+    bits = pltpu.prng_random_bits((block_q, block_k))
+    bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32(int((1.0 - dropout_rate) * 4294967296.0))
+    return (bits < thresh).astype(jnp.float32) * (
+        1.0 / (1.0 - dropout_rate))
+
+
 def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, *rest, sm_scale, causal,
-                block_q, block_k, n_k, dropout_rate=0.0):
+                block_q, block_k, n_k, dropout_rate=0.0, seeded=False):
     # rest = [dm_ref?], o_ref, lse_ref, m_scr, l_scr, acc_scr
-    if dropout_rate > 0.0:
+    if dropout_rate > 0.0 and not seeded:
         dm_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
         dm_ref = None
@@ -194,7 +218,10 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, *rest, sm_scale, causal,
         # softmax, so the normalizer l uses the undropped p while the
         # value accumulation uses the dropped/rescaled weights).
         pv = p
-        if dm_ref is not None:
+        if dropout_rate > 0.0 and seeded:
+            pv = p * _seeded_keep_scale(lens_ref, qb, kb, block_q,
+                                        block_k, dropout_rate)
+        elif dm_ref is not None:
             pv = p * _keep_scale(dm_ref, dropout_rate)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             pv.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -225,7 +252,7 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, *rest, sm_scale, causal,
 
 
 def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
-              dm=None, dropout_rate=0.0):
+              dm=None, dropout_rate=0.0, seeded=False):
     bh, sq, d = q.shape
     sk = k.shape[1]
     n_q = sq // block_q
@@ -233,14 +260,14 @@ def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, n_k=n_k,
-        dropout_rate=dropout_rate)
+        dropout_rate=dropout_rate, seeded=seeded)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j, lens: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j, lens: (b, j, 0)),
     ]
     operands = [q, k, v]
-    if dropout_rate > 0.0:
+    if dropout_rate > 0.0 and not seeded:
         in_specs.append(pl.BlockSpec(
             (1, block_q, block_k), lambda b, i, j, lens: (b, i, j)))
         operands.append(dm)
@@ -283,9 +310,9 @@ def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
 
 def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, *rest, sm_scale, causal, block_q,
-                    block_k, n_q, dropout_rate=0.0):
+                    block_k, n_q, dropout_rate=0.0, seeded=False):
     # rest = [dm_ref?], dk_ref, dv_ref, dk_scr, dv_scr
-    if dropout_rate > 0.0:
+    if dropout_rate > 0.0 and not seeded:
         dm_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     else:
         dm_ref = None
@@ -336,8 +363,14 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         # because Σₖ Pᵢₖ dPᵢₖ = rowsum(dO∘O) = delta exactly as without
         # dropout (O already carries M̃).
         pv = p
-        if dm_ref is not None:
-            pv = p * _keep_scale(dm_ref, dropout_rate)
+        keep = None
+        if dropout_rate > 0.0 and seeded:
+            keep = _seeded_keep_scale(lens_ref, qb, kb, block_q,
+                                      block_k, dropout_rate)
+            pv = p * keep
+        elif dm_ref is not None:
+            keep = _keep_scale(dm_ref, dropout_rate)
+            pv = p * keep
         # MXU operands in the input dtype (bf16 in training; identity for
         # fp32 inputs), fp32 accumulation. fp32 operands would run the
         # matmuls at a fraction of MXU rate — the softmax weights and ds
@@ -348,8 +381,8 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bq, bk)
-        if dm_ref is not None:
-            dp = dp * _keep_scale(dm_ref, dropout_rate)
+        if keep is not None:
+            dp = dp * keep
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -372,9 +405,9 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, *rest, sm_scale, causal, block_q,
-                   block_k, n_k, dropout_rate=0.0):
+                   block_k, n_k, dropout_rate=0.0, seeded=False):
     # rest = [dm_ref?], dq_ref, dq_scr
-    if dropout_rate > 0.0:
+    if dropout_rate > 0.0 and not seeded:
         dm_ref, dq_ref, dq_scr = rest
     else:
         dm_ref = None
@@ -420,7 +453,10 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if dm_ref is not None:
+        if dropout_rate > 0.0 and seeded:
+            dp = dp * _seeded_keep_scale(lens_ref, qb, kb, block_q,
+                                         block_k, dropout_rate)
+        elif dm_ref is not None:
             dp = dp * _keep_scale(dm_ref, dropout_rate)
         ds = p * (dp - delta[:, None]) * sm_scale
         # input-dtype operand, fp32 accumulation (see _bwd_dkv_kernel).
@@ -443,7 +479,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
-              g_lse=None, dm=None, dropout_rate=0.0):
+              g_lse=None, dm=None, dropout_rate=0.0, seeded=False):
     bh, sq, d = q.shape
     sk = k.shape[1]
     n_q = sq // block_q
@@ -473,7 +509,7 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
         pl.BlockSpec((1, 1, block_q), lambda b, j, i, lens: (b, 0, i)),
     ]
     dkv_operands = [q, k, v, do, lse3, delta3]
-    if dropout_rate > 0.0:
+    if dropout_rate > 0.0 and not seeded:
         dkv_in_specs.append(pl.BlockSpec(
             (1, block_q, block_k), lambda b, j, i, lens: (b, i, j)))
         dkv_operands.append(dm)
@@ -493,7 +529,7 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_q=n_q,
-                          dropout_rate=dropout_rate),
+                          dropout_rate=dropout_rate, seeded=seeded),
         grid_spec=dkv_spec,
         out_shape=[
             _struct((bh, sk, d), k.dtype, q, k, v, do, lens),
@@ -512,7 +548,7 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
         pl.BlockSpec((1, 1, block_q), lambda b, i, j, lens: (b, 0, i)),
     ]
     dq_operands = [q, k, v, do, lse3, delta3]
-    if dropout_rate > 0.0:
+    if dropout_rate > 0.0 and not seeded:
         dq_in_specs.append(pl.BlockSpec(
             (1, block_q, block_k), lambda b, i, j, lens: (b, i, j)))
         dq_operands.append(dm)
@@ -530,7 +566,7 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
     (dq,) = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_k=n_k,
-                          dropout_rate=dropout_rate),
+                          dropout_rate=dropout_rate, seeded=seeded),
         grid_spec=dq_spec,
         out_shape=[_struct((bh, sq, d), q.dtype, q, k, v, do, lens)],
         compiler_params=compiler_params,
@@ -614,6 +650,33 @@ def _flash_dropout_bwd(sm_scale, causal, block_q, block_k, rate, res, g):
 _flash_dropout.defvjp(_flash_dropout_fwd, _flash_dropout_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_seeded(q, k, v, lens, sm_scale, causal, block_q, block_k,
+                  rate):
+    o, _ = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
+                     dropout_rate=rate, seeded=True)
+    return o
+
+
+def _flash_seeded_fwd(q, k, v, lens, sm_scale, causal, block_q, block_k,
+                      rate):
+    o, lse = _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
+                       dropout_rate=rate, seeded=True)
+    return o, (q, k, v, o, lse, lens)
+
+
+def _flash_seeded_bwd(sm_scale, causal, block_q, block_k, rate, res, g):
+    q, k, v, o, lse, lens = res
+    dq, dk, dv = _bwd_call(q, k, v, o, g, lse, lens, sm_scale, causal,
+                           block_q, block_k, dropout_rate=rate,
+                           seeded=True)
+    dlens = np.zeros((4,), jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash_seeded.defvjp(_flash_seeded_fwd, _flash_seeded_bwd)
+
+
 def _prepare(q, k, v, block_q, block_k):
     """Reshape (B,H,S,D)→(BH,S,D), pad D to a lane tile (64 when D<=64,
     else 128) and S to block multiples. Returns padded tensors +
@@ -654,7 +717,8 @@ def _varying(*xs):
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     q_offset=0, k_offset=0, kv_len=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    with_lse=False, dropout_mask=None, dropout_rate=0.0):
+                    with_lse=False, dropout_mask=None, dropout_rate=0.0,
+                    dropout_seed=None):
     """Flash attention over (batch, heads, seq, head_dim) tensors.
 
     Args:
@@ -673,6 +737,13 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
         einsum oracle; the torch/TF bridges generate it with
         jax.random.bernoulli per attention site.
       dropout_rate: the rate the mask was drawn with (for rescaling).
+      dropout_seed: TPU-only alternative to dropout_mask — an int32
+        scalar (may be traced) seeding the ON-CHIP prng; the keep
+        pattern is regenerated per tile inside the forward and both
+        backward kernels, so no mask is ever materialized in HBM (no
+        bernoulli program, no O(S²) residual). Unsupported in interpret
+        mode (pltpu prng has no CPU lowering) — callers on CPU use
+        dropout_mask instead.
     """
     orig_dtype = q.dtype
     b, h, sq, d = q.shape
@@ -680,11 +751,20 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
         sm_scale = 1.0 / np.sqrt(d)
     if kv_len is None:
         kv_len = k.shape[2]
-    has_dropout = dropout_mask is not None and dropout_rate > 0.0
+    if dropout_seed is not None and dropout_mask is not None:
+        raise ValueError(
+            "flash_attention: pass dropout_mask OR dropout_seed, not both")
+    has_dropout = (dropout_mask is not None or dropout_seed is not None) \
+        and dropout_rate > 0.0
     if has_dropout and with_lse:
         raise NotImplementedError(
-            "flash_attention: dropout_mask with with_lse is unsupported "
+            "flash_attention: dropout with with_lse is unsupported "
             "(ring/merged attention never uses attention dropout)")
+    if dropout_seed is not None and dropout_rate > 0.0 and _interpret():
+        raise NotImplementedError(
+            "flash_attention: dropout_seed needs the on-chip prng "
+            "(pltpu) — unavailable in interpret mode; pass an explicit "
+            "dropout_mask on CPU")
     if _interpret() and _varying(q, k, v, q_offset, k_offset):
         # Pallas's HLO interpreter cannot run with device-varying operands
         # inside shard_map (check_vma dynamic_slice limitation); on non-TPU
@@ -696,6 +776,12 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
             dropout_mask=dropout_mask, dropout_rate=dropout_rate)
     qp, kp, vp, dims, bq, bk = _prepare(q, k, v, block_q, block_k)
     lens = jnp.asarray([q_offset, k_offset, kv_len], jnp.int32)
+    if has_dropout and dropout_seed is not None:
+        lens4 = jnp.concatenate(
+            [lens, jnp.asarray(dropout_seed, jnp.int32).reshape(1)])
+        o = _flash_seeded(qp, kp, vp, lens4, float(sm_scale),
+                          bool(causal), bq, bk, float(dropout_rate))
+        return o[:, :sq, :d].reshape(b, h, sq, d).astype(orig_dtype)
     if has_dropout:
         # bf16 carries 0/1 exactly at half the HBM traffic of fp32.
         dm = dropout_mask.astype(jnp.bfloat16).reshape(b * h, sq, -1)

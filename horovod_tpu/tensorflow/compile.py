@@ -184,6 +184,11 @@ def _conv2d(x, w, strides, padding, dilations, data_format,
     if padding == "EXPLICIT":
         pads = list(explicit_paddings)
         padding = [(pads[2], pads[3]), (pads[4], pads[5])]
+    # Under compute_dtype the weights carry the chosen precision; graph
+    # constants (e.g. keras Rescaling) can drift activations back to
+    # fp32 — follow the weight (lax.conv requires matching dtypes).
+    if x.dtype != w.dtype:
+        x = x.astype(w.dtype)
     # Grouped convolution: TF keeps the op type Conv2D and encodes the
     # group count implicitly as in_channels / rhs_in_channels (e.g.
     # ConvNeXt's 7x7 depthwise is Conv2D with groups == channels).
@@ -207,6 +212,8 @@ def _depthwise_conv2d(x, w, strides, padding, dilations, data_format):
         raise NotImplementedError("DepthwiseConv2d: NHWC only")
     if isinstance(padding, bytes):
         padding = padding.decode()
+    if x.dtype != w.dtype:
+        x = x.astype(w.dtype)  # see _conv2d: weights carry compute_dtype
     h, kw, cin, mult = w.shape
     w = w.reshape(h, kw, 1, cin * mult)
     return lax.conv_general_dilated(
@@ -1151,21 +1158,40 @@ class CompiledFunction:
     ones (e.g. batch-norm moving stats), functionally updated from the
     graph's Assign ops after each training call."""
 
-    def __init__(self, cf, params, buffers, capture_values, fdefs):
+    def __init__(self, cf, params, buffers, capture_values, fdefs,
+                 compute_dtype=None):
         _init_tables()
         self._cf = cf
         self._interp = _GraphInterpreter(cf.graph, capture_values, fdefs)
         self.params = params
         self.buffers = buffers
+        self.compute_dtype = compute_dtype
         self._jitted = {}
 
     # -- functional core ---------------------------------------------------
     def apply(self, params, inputs, buffers=None, rng=None,
               training=False):
         """Pure forward: returns (structured_output, new_buffers).
-        Differentiable w.r.t. ``params``."""
+        Differentiable w.r.t. ``params``.
+
+        With ``compute_dtype`` set (the torch bridge's XLA_USE_BF16
+        analog), float params AND float inputs are cast on entry:
+        master weights and gradients stay fp32 while convs/matmuls ride
+        the MXU in bf16 — BatchNorm/softmax/CE handlers already compute
+        their statistics in fp32 internally."""
         import tensorflow as tf
         buffers = self.buffers if buffers is None else buffers
+        if self.compute_dtype is not None:
+            jnp = _jnp()
+
+            def cast(v):
+                if hasattr(v, "dtype") and jnp.issubdtype(
+                        jnp.asarray(v).dtype, jnp.floating):
+                    return jnp.asarray(v).astype(self.compute_dtype)
+                return v
+
+            params = {k: cast(v) for k, v in params.items()}
+            inputs = [cast(v) for v in inputs]
         flat, updates = self._interp.run(params, buffers, list(inputs),
                                          rng=rng, training=training)
         out = tf.nest.pack_sequence_as(self._cf.structured_outputs, flat)
@@ -1266,7 +1292,7 @@ class CompiledFunction:
 
 
 def tpu_compile(fn, example_inputs=None, input_signature=None,
-                dynamic_batch=True):
+                dynamic_batch=True, compute_dtype=None):
     """Compile a TF2 callable for TPU execution via graph→JAX.
 
     Args:
@@ -1335,7 +1361,8 @@ def tpu_compile(fn, example_inputs=None, input_signature=None,
 
     fdefs = {f.signature.name: f
              for f in cf.graph.as_graph_def().library.function}
-    return CompiledFunction(cf, params, buffers, capture_values, fdefs)
+    return CompiledFunction(cf, params, buffers, capture_values, fdefs,
+                            compute_dtype=compute_dtype)
 
 
 def def_function_type():

@@ -158,23 +158,45 @@ def _sdpa(rng_key, train, q=None, k=None, v=None, attn_mask=None,
                 and q.shape[:2] == k.shape[:2] == v.shape[:2]
                 and q.shape[-1] == k.shape[-1] == v.shape[-1]
                 and q.shape[-1] <= 128):
-            from ..ops.flash_attention import flash_attention
+            from ..ops.flash_attention import _interpret, flash_attention
             dm = None
+            seed = None
             rate = 0.0
             if dropout_p and train and rng_key is not None:
-                # The explicit (B,H,Sq,Sk) keep-mask costs O(S²) HBM —
-                # the same footprint the einsum fallback pays for its
-                # logits, so flash routing never loses memory headroom
-                # to it; long-context models that need O(S) attention
-                # memory run dropout-free (the native flagship path).
                 import jax
                 rate = float(dropout_p)
-                dm = jax.random.bernoulli(
-                    rng_key, 1.0 - rate,
-                    q.shape[:3] + (k.shape[2],))
+                mask_bytes = 2 * q.shape[0] * q.shape[1] \
+                    * q.shape[2] * k.shape[2]
+                limit = int(os.environ.get(
+                    "HVDTPU_FLASH_DROPOUT_MASK_LIMIT",
+                    str(128 * 1024 * 1024)))
+                mode = os.environ.get("HVDTPU_FLASH_DROPOUT",
+                                      "auto").lower()
+                use_mask = (mode == "mask"
+                            or _interpret()
+                            or (mode == "auto" and mask_bytes <= limit))
+                if use_mask:
+                    # Explicit bernoulli keep-mask: measured faster than
+                    # the per-tile on-chip prng at bench sizes, exactly
+                    # reproducible against the einsum oracle, and the
+                    # only option in interpret mode (pltpu prng has no
+                    # CPU lowering). Cost: an O(S²) bf16 residual per
+                    # attention site held for the backward pass.
+                    dm = jax.random.bernoulli(
+                        rng_key, 1.0 - rate,
+                        q.shape[:3] + (k.shape[2],))
+                else:
+                    # Big mask (long seq / large batch): seed the
+                    # on-chip prng instead — the keep pattern is
+                    # regenerated per tile in fwd and both bwd kernels,
+                    # no O(S²) residual, so configs whose masks OOM
+                    # still train.
+                    seed = jax.random.randint(
+                        rng_key, (), -2 ** 31, 2 ** 31 - 1,
+                        dtype=jnp.int32)
             return flash_attention(
                 q, k, v, causal=bool(is_causal), sm_scale=scale,
-                dropout_mask=dm, dropout_rate=rate)
+                dropout_mask=dm, dropout_rate=rate, dropout_seed=seed)
         if resolved is None:
             # Mask folded away but the shapes are outside kernel
             # coverage — still drop the dead mask from the einsum path.
@@ -248,6 +270,10 @@ def _layer_norm(x, normalized_shape, weight, bias, eps):
 
 
 def _linear(x, weight, bias=None):
+    # jnp.matmul(x, W.T) — measured FASTER on v5e than dot_general with
+    # transposed dimension numbers (47.8 vs 44.3 samples/s on 24-layer
+    # BERT-large): XLA folds the transpose into its preferred MXU
+    # layout; explicit rhs-minor contraction defeats that.
     jnp = _jnp()
     out = jnp.matmul(x, weight.T)
     if bias is not None:

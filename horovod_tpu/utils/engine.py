@@ -1,0 +1,47 @@
+"""Engine auto-selection for the example/bench scripts.
+
+The reference's scripts always run on the accelerator because its
+collectives live inside the framework's device kernels. Here the
+examples have two engines — host-framework eager math with host-plane
+collectives ('tf'/'torch'), or model math compiled onto the chip
+('tpu') — and an unmodified user on a TPU-VM must land on the fast one
+by default (round-4 review: on-chip must not be opt-in).
+"""
+
+import os
+
+
+def resolve_engine(requested="auto", host_engine="tf",
+                   env="HVDTPU_ENGINE"):
+    """Resolve an example's --engine flag.
+
+    'auto' (the default) picks 'tpu' iff the JAX runtime actually has a
+    TPU, else ``host_engine``; the HVDTPU_ENGINE env var overrides auto
+    (explicit opt-out without editing the command line). An explicit
+    non-auto request always wins.
+    """
+    valid = {"tpu", host_engine}
+    if requested != "auto":
+        return requested
+    forced = os.environ.get(env, "").strip().lower()
+    if forced and forced != "auto":
+        if forced not in valid:
+            raise ValueError(
+                f"{env}={forced!r} is not a valid engine; expected "
+                f"one of {sorted(valid)} or 'auto'")
+        return forced
+    import jax
+    return "tpu" if jax.default_backend() == "tpu" else host_engine
+
+
+def default_keras_backend_to_jax():
+    """Export KERAS_BACKEND=jax when a TPU is present and the user has
+    not chosen a backend — keras model.fit then compiles onto the chip
+    (set_data_parallel). Call BEFORE the first keras import."""
+    if os.environ.get("KERAS_BACKEND"):
+        return os.environ["KERAS_BACKEND"]
+    import jax
+    if jax.default_backend() == "tpu":
+        os.environ["KERAS_BACKEND"] = "jax"
+        return "jax"
+    return None

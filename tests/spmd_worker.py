@@ -140,13 +140,7 @@ def main():
     # -- Adasum on the host data plane ---------------------------------------
     # Oracle: VHDD == the pairwise tree a<-(1-dot/2|a|^2)a+(1-dot/2|b|^2)b
     # (reference: adasum/adasum.h:397-407); power-of-two sizes only.
-    def np_adasum(a, b):
-        dot = float((a * b).sum())
-        na = float((a * a).sum())
-        nb = float((b * b).sum())
-        ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
-        bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
-        return ac * a + bc * b
+    from horovod_tpu.ops.adasum import adasum_pair_np as np_adasum
 
     ada_rng = np.random.RandomState(7)
     ada_vecs = [ada_rng.randn(33).astype(np.float32)

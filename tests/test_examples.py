@@ -179,3 +179,43 @@ def test_ray_elastic_example_gates_cleanly():
     # message (when present, it runs the elastic executor for real).
     out = _run_example(["ray_elastic.py"], np=1)
     assert "done" in out
+
+
+def test_engine_auto_selection_logic(monkeypatch):
+    """auto picks the chip iff a TPU backs the runtime; HVDTPU_ENGINE
+    overrides; explicit flags always win (round-4 review: the
+    unmodified-user path must be the fast path on a TPU-VM)."""
+    import jax
+
+    from horovod_tpu.utils.engine import resolve_engine
+
+    monkeypatch.delenv("HVDTPU_ENGINE", raising=False)
+    assert resolve_engine("tf") == "tf"
+    assert resolve_engine("tpu") == "tpu"
+    # this suite runs on CPU: auto must stay on the host engine
+    assert jax.default_backend() != "tpu"
+    assert resolve_engine("auto") == "tf"
+    assert resolve_engine("auto", host_engine="torch") == "torch"
+    monkeypatch.setenv("HVDTPU_ENGINE", "tpu")
+    assert resolve_engine("auto") == "tpu"
+    monkeypatch.setenv("HVDTPU_ENGINE", "tf")
+    assert resolve_engine("auto") == "tf"
+    # fake a TPU runtime: auto lands on the chip
+    monkeypatch.delenv("HVDTPU_ENGINE", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_engine("auto") == "tpu"
+
+
+def test_keras_backend_defaults_to_jax_on_tpu(monkeypatch):
+    import jax
+
+    from horovod_tpu.utils.engine import default_keras_backend_to_jax
+
+    monkeypatch.setenv("KERAS_BACKEND", "torch")
+    assert default_keras_backend_to_jax() == "torch"  # user choice wins
+    monkeypatch.delenv("KERAS_BACKEND")
+    assert default_keras_backend_to_jax() is None     # CPU: no override
+    assert "KERAS_BACKEND" not in __import__("os").environ
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert default_keras_backend_to_jax() == "jax"
+    assert __import__("os").environ["KERAS_BACKEND"] == "jax"

@@ -206,3 +206,67 @@ def test_broadcast_object_single_mode(hvd):
     obj = {"epoch": 3, "lr": 0.1}
     assert hvd_jax.broadcast_object(obj) == obj
     assert hvd_jax.allgather_object(obj) == [obj]
+
+
+from horovod_tpu.ops.adasum import adasum_vhdd_np as _np_vhdd  # noqa: E402
+
+
+def test_adasum_axis_matches_pairwise_vhdd_oracle(hvd, n_devices):
+    """adasum_axis (ppermute VHDD inside shard_map — the compiled data
+    plane) is allclose to the numpy pairwise recursion, mirroring the
+    host-plane oracle (tests/spmd_worker.py)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops.adasum import adasum_axis
+
+    n = n_devices
+    rng = np.random.RandomState(3)
+    # scale the ranks very differently: Adasum's whole point is scale
+    # awareness, and mismatched norms exercise both coefficients
+    stacked = np.stack([
+        rng.normal(size=(4, 5)).astype(np.float32) * (10.0 ** (i % 3 - 1))
+        for i in range(n)])
+    mesh = Mesh(np.array(jax.devices()[:n]), ("r",))
+
+    out = jax.jit(jax.shard_map(
+        lambda x: adasum_axis(x[0], "r")[None],
+        mesh=mesh, in_specs=P("r"), out_specs=P("r")))(jnp.asarray(stacked))
+    expect = _np_vhdd(stacked)
+    for i in range(n):
+        np.testing.assert_allclose(np.asarray(out)[i], expect,
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"rank {i} diverges from the "
+                                           "pairwise VHDD recursion")
+
+
+def test_adasum_optimizer_matches_tree_oracle(hvd, n_devices):
+    """One DistributedAdasumOptimizer step equals a manual SGD update
+    with the numpy-VHDD combination of the per-shard gradients — the
+    compiled analog of the host plane's oracle-tested VhddAdasum."""
+    model = MLP(features=(8,), num_classes=3)
+    params = model.init(jax.random.PRNGKey(6), jnp.zeros((1, 2, 2, 1)))
+    opt = hvd_jax.DistributedAdasumOptimizer(optax.sgd(0.1))
+    step = hvd_jax.make_train_step(_loss_fn(model), opt, donate=False)
+    opt_state = opt.init(params)
+    batch = _make_data(n_devices, 4, key=7)
+    batch = (batch[0][:, :2, :2, :], batch[1] % 3)
+    p, s, loss = step(params, opt_state, batch)
+    assert np.isfinite(float(loss))
+
+    per = batch[0].shape[0] // n_devices
+    loss_fn = _loss_fn(model)
+    shard_grads = []
+    for i in range(n_devices):
+        shard = (batch[0][i * per:(i + 1) * per],
+                 batch[1][i * per:(i + 1) * per])
+        shard_grads.append(jax.grad(loss_fn)(params, shard))
+    leaves = [jax.tree.leaves(g) for g in shard_grads]
+    flat_params = jax.tree.leaves(params)
+    flat_new = jax.tree.leaves(p)
+    for leaf_idx, (p0, p1) in enumerate(zip(flat_params, flat_new)):
+        combined = _np_vhdd([np.asarray(leaves[i][leaf_idx])
+                             for i in range(n_devices)])
+        expected = np.asarray(p0, np.float64) - 0.1 * combined
+        np.testing.assert_allclose(np.asarray(p1), expected,
+                                   rtol=2e-4, atol=2e-5)

@@ -433,3 +433,25 @@ def test_keras_mha_flash_fallback_on_padding_mask(monkeypatch):
         f, example_inputs=(tf.constant(x), tf.constant(mask)))(x, mask))
     assert not hits, "padding mask must not route to the flash kernel"
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_compute_dtype_bf16_parity_and_training():
+    """compute_dtype=bf16 (the torch bridge's XLA_USE_BF16 analog on
+    the TF side): master weights stay fp32, forward parity holds at
+    bf16 tolerance, and training still converges."""
+    import jax.numpy as jnp
+    optax = pytest.importorskip("optax")
+    m = _ConvNet()
+    x, y = _mnist_batch()
+    c32 = tpu_compile(m.loss, example_inputs=(x, y))
+    c16 = tpu_compile(m.loss, example_inputs=(x, y),
+                      compute_dtype=jnp.bfloat16)
+    l32 = float(np.asarray(c32(x, y)))
+    l16 = float(np.asarray(c16(x, y)))
+    assert abs(l32 - l16) / max(abs(l32), 1e-6) < 0.05
+    # params stay fp32 masters
+    assert all(np.asarray(v).dtype == np.float32
+               for v in c16.params.values())
+    step = c16.make_train_step(optax.sgd(0.05))
+    losses = [float(step((x, y))) for _ in range(6)]
+    assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]

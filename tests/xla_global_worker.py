@@ -123,18 +123,10 @@ def main():
         out_ada = np.asarray(hvd.allreduce(jnp.asarray(ada[rank]),
                                            op=hvd.Adasum, name="ada"))
 
-        def np_adasum(a, b):
-            dot = float((a * b).sum())
-            na, nb = float((a * a).sum()), float((b * b).sum())
-            ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
-            bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
-            return ac * a + bc * b
+        from horovod_tpu.ops.adasum import adasum_vhdd_np
 
-        expect = [ada[i] for i in range(size)]
-        while len(expect) > 1:
-            expect = [np_adasum(expect[i], expect[i + 1])
-                      for i in range(0, len(expect), 2)]
-        np.testing.assert_allclose(out_ada, expect[0], rtol=1e-5,
+        expect = adasum_vhdd_np([ada[i] for i in range(size)])
+        np.testing.assert_allclose(out_ada, expect, rtol=1e-5,
                                    atol=1e-6)
 
     # -- barrier + alltoall still ride the native TCP plane ---------------
