@@ -337,29 +337,62 @@ def test_int64_inputs_narrow():
                                (ids * 2).astype(np.float32))
 
 
-def _mha_model(use_causal_mask):
-    tf.keras.utils.set_random_seed(0)
-    inp = tf.keras.Input((32, 64))
-    h = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=16)(
-        inp, inp, use_causal_mask=use_causal_mask)
-    out = tf.keras.layers.Dense(8)(h)
-    return tf.keras.Model(inp, out)
+def _attention_module(causal, heads=4, key_dim=16, d_model=64,
+                      out_dim=8):
+    """The exact op pattern keras-3 MultiHeadAttention emits (einsum
+    projections, scalar Mul scale, SelectV2 masked softmax, combine
+    einsum) hand-rolled with raw TF ops. keras itself binds to whichever
+    backend the test SESSION imported first (process-global), so
+    building a tf.keras layer here is not order-safe — the bridge's
+    pattern matcher sees the identical graph either way (it is
+    label-generic, verified standalone against real keras MHA)."""
+    tf.random.set_seed(0)
+
+    class MHA(tf.Module):
+        def __init__(self):
+            init = tf.random.normal
+            self.wq = tf.Variable(init([d_model, heads, key_dim],
+                                       stddev=0.05), name="wq")
+            self.wk = tf.Variable(init([d_model, heads, key_dim],
+                                       stddev=0.05), name="wk")
+            self.wv = tf.Variable(init([d_model, heads, key_dim],
+                                       stddev=0.05), name="wv")
+            self.wo = tf.Variable(init([heads, key_dim, out_dim],
+                                       stddev=0.05), name="wo")
+
+        def __call__(self, x):
+            q = tf.einsum("bsc,cnh->bsnh", x, self.wq)
+            k = tf.einsum("bsc,cnh->bsnh", x, self.wk)
+            v = tf.einsum("bsc,cnh->bsnh", x, self.wv)
+            s = tf.einsum("bqnh,bknh->bnqk", q, k)
+            s = s * (1.0 / float(key_dim) ** 0.5)
+            if causal:
+                n = tf.shape(x)[1]
+                rows = tf.range(n)
+                keep = rows[:, None] >= rows[None, :]
+                cond = tf.logical_and(tf.ones_like(s, tf.bool),
+                                      keep[None, None])
+                s = tf.where(cond, s, tf.constant(-1e9))
+            p = tf.nn.softmax(s)
+            out = tf.einsum("bnqk,bknh->bqnh", p, v)
+            return tf.einsum("bqnh,nho->bqo", out, self.wo)
+
+    return MHA()
 
 
 @pytest.mark.parametrize("use_causal_mask", [False, True])
-def test_keras_mha_flash_routing_parity(monkeypatch, use_causal_mask):
+def test_attention_pattern_flash_routing_parity(monkeypatch,
+                                                use_causal_mask):
     """The Einsum→[scale]→[mask]→Softmax→Einsum pattern lowers to the
-    Pallas flash kernel (keras's SelectV2 causal mask is recognized as
-    such after shape-derived const folding) with einsum-path parity."""
-    model = _mha_model(use_causal_mask)
+    Pallas flash kernel (the SelectV2 causal mask is recognized as such
+    after shape-derived const folding) with einsum-path parity."""
+    model = _attention_module(use_causal_mask)
     x = np.random.RandomState(0).normal(size=(2, 32, 64)).astype(
         np.float32)
 
-    def f(a):
-        return model(a, training=False)
-
     monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "never")
-    ref = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+    ref = np.asarray(tpu_compile(model, example_inputs=(
+        tf.constant(x),))(x))
 
     from horovod_tpu.ops import flash_attention as fa_mod
     hits = []
@@ -371,23 +404,24 @@ def test_keras_mha_flash_routing_parity(monkeypatch, use_causal_mask):
 
     monkeypatch.setattr(fa_mod, "flash_attention", spy)
     monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
-    out = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+    out = np.asarray(tpu_compile(model, example_inputs=(
+        tf.constant(x),))(x))
     assert hits == [use_causal_mask]
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
 
 
-def test_keras_mha_flash_training_gradients(monkeypatch):
+def test_attention_pattern_flash_training_gradients(monkeypatch):
     """Training through the flash-routed attention still converges (the
-    kernel's custom VJP feeds the keras projection weights)."""
+    kernel's custom VJP feeds the projection weights)."""
     optax = pytest.importorskip("optax")
-    model = _mha_model(False)
+    model = _attention_module(False)
     x = np.random.RandomState(1).normal(size=(8, 32, 64)).astype(
         np.float32)
     y = np.random.RandomState(2).normal(size=(8, 32, 8)).astype(
         np.float32)
 
     def loss_fn(a, t):
-        pred = model(a, training=True)
+        pred = model(a)
         return tf.reduce_mean(tf.square(pred - t))
 
     monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
@@ -398,26 +432,31 @@ def test_keras_mha_flash_training_gradients(monkeypatch):
     assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
-def test_keras_mha_flash_fallback_on_padding_mask(monkeypatch):
+def test_attention_pattern_flash_fallback_on_padding_mask(monkeypatch):
     """A data-dependent key-padding mask cannot const-fold: the pattern
     must fall back to the einsum lowering and stay correct."""
-    tf.keras.utils.set_random_seed(0)
-    inp = tf.keras.Input((32, 64))
-    mask_in = tf.keras.Input((32,), dtype="bool")
-    h = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=16)(
-        inp, inp, attention_mask=mask_in[:, None, :])
-    model = tf.keras.Model([inp, mask_in], h)
+    base = _attention_module(False)
+
+    def masked_model(x, mask):
+        q = tf.einsum("bsc,cnh->bsnh", x, base.wq)
+        k = tf.einsum("bsc,cnh->bsnh", x, base.wk)
+        v = tf.einsum("bsc,cnh->bsnh", x, base.wv)
+        s = tf.einsum("bqnh,bknh->bnqk", q, k) * 0.25
+        cond = tf.logical_and(tf.ones_like(s, tf.bool),
+                              mask[:, None, None, :])
+        s = tf.where(cond, s, tf.constant(-1e9))
+        p = tf.nn.softmax(s)
+        out = tf.einsum("bnqk,bknh->bqnh", p, v)
+        return tf.einsum("bqnh,nho->bqo", out, base.wo)
+
     x = np.random.RandomState(0).normal(size=(2, 32, 64)).astype(
         np.float32)
     mask = np.ones((2, 32), bool)
     mask[:, -7:] = False
 
-    def f(a, m):
-        return model([a, m], training=False)
-
     monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "never")
-    ref = np.asarray(tpu_compile(
-        f, example_inputs=(tf.constant(x), tf.constant(mask)))(x, mask))
+    ref = np.asarray(tpu_compile(masked_model, example_inputs=(
+        tf.constant(x), tf.constant(mask)))(x, mask))
 
     from horovod_tpu.ops import flash_attention as fa_mod
     hits = []
@@ -429,8 +468,8 @@ def test_keras_mha_flash_fallback_on_padding_mask(monkeypatch):
 
     monkeypatch.setattr(fa_mod, "flash_attention", spy)
     monkeypatch.setenv("HVDTPU_BRIDGE_FLASH", "always")
-    out = np.asarray(tpu_compile(
-        f, example_inputs=(tf.constant(x), tf.constant(mask)))(x, mask))
+    out = np.asarray(tpu_compile(masked_model, example_inputs=(
+        tf.constant(x), tf.constant(mask)))(x, mask))
     assert not hits, "padding mask must not route to the flash kernel"
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
@@ -455,3 +494,57 @@ def test_compute_dtype_bf16_parity_and_training():
     step = c16.make_train_step(optax.sgd(0.05))
     losses = [float(step((x, y))) for _ in range(6)]
     assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_real_keras_mha_flash_routing_subprocess():
+    """The REAL tf.keras MultiHeadAttention graph routes to the flash
+    kernel — run in a fresh interpreter because keras binds its backend
+    at first import (this test session may already hold the jax
+    backend), mirroring the bench.py isolation. Guards against a keras
+    upgrade changing the emitted attention pattern without the
+    hand-rolled replica tests noticing."""
+    import subprocess
+    from conftest import clean_spawn_env
+
+    script = r"""
+import os, sys
+sys.path.insert(0, os.environ["HVDTPU_REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+from horovod_tpu.tensorflow.compile import tpu_compile
+hvd.init()
+tf.keras.utils.set_random_seed(0)
+inp = tf.keras.Input((32, 64))
+h = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=16)(
+    inp, inp, use_causal_mask=True)
+model = tf.keras.Model(inp, h)
+x = np.random.RandomState(0).normal(size=(2, 32, 64)).astype(np.float32)
+def f(a):
+    return model(a, training=False)
+os.environ["HVDTPU_BRIDGE_FLASH"] = "never"
+ref = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+from horovod_tpu.ops import flash_attention as fa
+hits = []
+orig = fa.flash_attention
+def spy(*a, **kw):
+    hits.append(kw.get("causal")); return orig(*a, **kw)
+fa.flash_attention = spy
+os.environ["HVDTPU_BRIDGE_FLASH"] = "always"
+out = np.asarray(tpu_compile(f, example_inputs=(tf.constant(x),))(x))
+assert hits == [True], hits
+np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+print("MHA-FLASH OK")
+"""
+    import os
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_spawn_env(HVDTPU_REPO=repo)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, timeout=600)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out[-4000:]
+    assert "MHA-FLASH OK" in out
