@@ -91,6 +91,12 @@ def main():
     step = hvd_jax.make_train_step(loss_fn, opt, has_aux=True)
     opt_state = opt.init(params)
 
+    # Broadcast initial state so every process starts identically under
+    # hvdrun (fixed-seed init makes this a no-op today, but nothing
+    # enforces that; flagged by hvd-lint rule HVD202).
+    params = hvd_jax.broadcast_parameters(params, root_rank=0)
+    opt_state = hvd_jax.broadcast_optimizer_state(opt_state, root_rank=0)
+
     state = [params, aux, opt_state]
 
     def benchmark_step():

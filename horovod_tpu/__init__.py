@@ -20,7 +20,8 @@ from .basics import (  # noqa: F401
 )
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
-    DuplicateNameError, StalledTensorError,
+    DuplicateNameError, StalledTensorError, SubmissionOrderError,
+    CollectiveLintError,
 )
 from .ops.reduce_ops import (  # noqa: F401
     Average, Sum, Adasum, Min, Max, Product,
@@ -54,6 +55,13 @@ def __getattr__(name):
     if name == "run":
         from .runner import run
         return run
+    if name == "analysis":
+        # hvd.analysis.check_fn / lint_paths / SubmissionOrderGuard —
+        # lazy so importing the package never loads the analyzer.
+        # (importlib, not `from . import`: the latter resolves through
+        # this very __getattr__ and recurses.)
+        import importlib
+        return importlib.import_module(".analysis", __name__)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 

@@ -1,0 +1,70 @@
+"""Static + runtime collective-correctness analysis (``hvd-lint``).
+
+Three layers, one finding type (:class:`Diagnostic`):
+
+1. **jaxpr analyzer** (:func:`check_fn` / :func:`check_jaxpr`) — walks a
+   traced program and flags unbound collective axis names, collectives
+   under rank-dependent ``cond``/``while``, and mismatched paired
+   collectives across branches. Wired into the torch/tensorflow compile
+   bridges behind their ``verify=`` flag.
+2. **AST linter** (:func:`lint_paths` / :func:`lint_source`) — scans user
+   scripts for rank-guarded collectives, missing initial broadcasts, and
+   auto-named collectives under rank-dependent control flow. The
+   ``hvd-lint`` CLI (analysis/cli.py) fronts this layer.
+3. **runtime order guard** (:class:`SubmissionOrderGuard`) — the opt-in
+   ``HOROVOD_TPU_ORDER_CHECK=1`` dynamic backstop in the coordinator.
+
+Rule catalog and suppression syntax: docs/lint.md.
+"""
+
+from .diagnostics import (  # noqa: F401
+    Diagnostic, RULES, ERROR, WARNING, dedupe, worst_severity,
+)
+from .jaxpr_lint import check_fn, check_jaxpr  # noqa: F401
+from .ast_lint import (  # noqa: F401
+    lint_source, lint_file, lint_paths, iter_python_files,
+)
+from .order_guard import SubmissionOrderGuard  # noqa: F401
+
+
+def runtime_axis_sizes():
+    """Axis sizes the initialized runtime's replica mesh binds — the
+    default ``axis_sizes`` for verifying functions that will run under
+    ``make_train_step``/``shard_map`` on that mesh. Empty when the
+    runtime is not initialized."""
+    from .. import basics
+    if not basics.is_initialized():
+        return {}
+    return dict(basics.runtime().mesh.shape)
+
+
+def enforce(diags, mode, what="function", logger=None):
+    """Apply a ``verify=`` policy to analyzer findings.
+
+    ``mode`` False/None: no-op. ``"warn"``: log every finding. ``True``
+    or ``"error"``: log warnings, raise :class:`CollectiveLintError`
+    when any error-severity finding exists.
+    """
+    if not mode or not diags:
+        return diags
+    from ..exceptions import CollectiveLintError
+    if logger is None:
+        from ..utils.logging_util import get_logger
+        logger = get_logger()
+    errors = [d for d in diags if d.severity == ERROR]
+    for d in diags:
+        logger.warning("hvd-lint [%s]: %s", what, d.format())
+    if errors and mode is not False and mode != "warn":
+        raise CollectiveLintError(errors)
+    return diags
+
+
+def verify_traceable(fn, args, kwargs=None, axis_sizes=None, mode=True,
+                     what="compiled function"):
+    """Trace ``fn`` and enforce the findings — the hook the compile
+    bridges call behind ``verify=``. ``axis_sizes`` defaults to the
+    runtime mesh's axes."""
+    if axis_sizes is None:
+        axis_sizes = runtime_axis_sizes()
+    diags = check_fn(fn, *args, axis_sizes=axis_sizes, **(kwargs or {}))
+    return enforce(diags, mode, what=what)

@@ -1,0 +1,364 @@
+"""Layer 2: AST linter for user training scripts.
+
+Python-level divergence the jaxpr layer cannot see: the reference
+framework's best-known user bug is the *rank-guarded collective* —
+
+    if hvd.rank() == 0:
+        hvd.allreduce(tensor)        # other ranks never arrive: hang
+
+which the reference only diagnoses at runtime via the stall inspector
+(reference: horovod/common/stall_inspector.cc warning text). Here it is
+a static finding. Three rules:
+
+- **HVD201** (error) — a collective call inside an ``if``/``while``
+  whose condition depends on ``rank()`` and whose other branch performs
+  no collective: only some ranks reach it.
+- **HVD202** (warning) — a script that ``init()``s and builds a
+  ``DistributedOptimizer`` but never broadcasts initial state (no
+  ``broadcast_parameters``/``broadcast_optimizer_state``/Broadcast
+  callback, and no elastic state sync): ranks train from divergent
+  initializations.
+- **HVD203** (warning) — collectives *without an explicit* ``name=``
+  under rank-dependent control flow: auto-generated names are assigned
+  in call order, so name streams diverge across ranks and the
+  negotiation never matches them up.
+
+Suppression: append ``# hvd-lint: disable=HVD201`` (comma-separate for
+several rules, or ``disable=all``) to the flagged line or the line
+above it; ``# hvd-lint: disable-file=HVD202`` anywhere disables a rule
+for the whole file. Pure stdlib — no jax/torch/tf imports.
+"""
+
+import ast
+import os
+import re
+
+from .diagnostics import Diagnostic, dedupe
+
+# Eager named-tensor API (ops/collectives.py + functions.py) plus the
+# in-jit spellings (jax.lax collectives) users call inside step bodies.
+COLLECTIVE_CALLS = frozenset({
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_", "grouped_allreduce_async",
+    "grouped_allreduce_async_",
+    "allgather", "allgather_async", "grouped_allgather",
+    "grouped_allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "grouped_reducescatter",
+    "grouped_reducescatter_async",
+    "barrier", "join",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "broadcast_object", "allgather_object",
+})
+LAX_COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter",
+})
+# Exempt from HVD203 (the unnamed-collective warning): ops with no
+# user-visible name kwarg, lax collectives (paired by program point,
+# not name), and the object/state broadcast helpers, whose names are
+# fixed internally (functions.py) — never call-order dependent.
+_UNNAMED_OK = (frozenset({
+    "barrier", "join",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "broadcast_object", "allgather_object",
+}) | LAX_COLLECTIVE_CALLS)
+RANK_CALLS = frozenset({"rank", "local_rank", "cross_rank", "axis_index"})
+BROADCAST_STATE_CALLS = frozenset({
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "broadcast_object",
+})
+DIST_OPT_CALLS = frozenset({
+    "DistributedOptimizer", "DistributedAdasumOptimizer",
+})
+# Presence of any of these identifiers means initial-state sync happens
+# through a channel HVD202 should not second-guess.
+_SYNC_MARKERS = frozenset({
+    "BroadcastGlobalVariablesCallback", "broadcast_global_variables",
+})
+_ELASTIC_STATE_NAMES = frozenset({
+    "TorchState", "TensorFlowKerasState", "KerasState", "ObjectState",
+    "State",
+})
+
+_SUPPRESS_RE = re.compile(r"hvd-lint:\s*disable=([A-Za-z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"hvd-lint:\s*disable-file=([A-Za-z0-9,\s]+)")
+_DOC_HINT = "see docs/lint.md"
+
+
+def _root_name(node):
+    """Leftmost Name of an attribute chain (``hvd.torch.rank`` -> hvd)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _terminal_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _scan_statements(stmts):
+    """Yield nodes in statement bodies without descending into nested
+    function/class definitions (code there is defined, not executed,
+    under the guard)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Analyzer(ast.NodeVisitor):
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        self.hvd_aliases = set()    # names bound to horovod_tpu modules
+        self.hvd_names = set()      # functions imported from horovod_tpu
+        self.lax_aliases = {"lax"}  # `jax.lax` / `from jax import lax`
+        self.has_init = False
+        self.dist_opt_node = None
+        self.has_broadcast = False
+        self.uses_elastic = False
+        self._flagged = set()       # id(call) already reported
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node):
+        for alias in node.names:
+            target = alias.asname or alias.name.split(".")[0]
+            if alias.name.split(".")[0] in ("horovod_tpu", "horovod"):
+                self.hvd_aliases.add(target)
+                if "elastic" in alias.name:
+                    self.uses_elastic = True
+            if alias.name in ("jax.lax",):
+                self.lax_aliases.add(target)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if mod.split(".")[0] in ("horovod_tpu", "horovod"):
+            if "elastic" in mod:
+                self.uses_elastic = True
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if alias.name == "elastic" or name == "elastic":
+                    self.uses_elastic = True
+                    self.hvd_aliases.add(name)
+                elif alias.name in _ELASTIC_STATE_NAMES:
+                    self.uses_elastic = True
+                elif alias.name == "*":
+                    self.hvd_names |= (COLLECTIVE_CALLS | RANK_CALLS
+                                       | DIST_OPT_CALLS | {"init"})
+                else:
+                    self.hvd_names.add(name)
+        if mod == "jax":
+            for alias in node.names:
+                if alias.name == "lax":
+                    self.lax_aliases.add(alias.asname or "lax")
+        self.generic_visit(node)
+
+    # -- call classification ----------------------------------------------
+    def _is_hvd_call(self, call, names):
+        term = _terminal_name(call.func)
+        if term not in names:
+            return False
+        if isinstance(call.func, ast.Name):
+            # A bare name is horovod's only if it was imported from
+            # horovod (a file with no horovod imports has no horovod
+            # collectives — bare `broadcast(...)` there is someone
+            # else's function).
+            return term in self.hvd_names
+        root = _root_name(call.func)
+        return root in self.hvd_aliases
+
+    def _is_collective(self, call):
+        term = _terminal_name(call.func)
+        if term in LAX_COLLECTIVE_CALLS:
+            root = _root_name(call.func)
+            return root in self.lax_aliases or root == "jax"
+        return self._is_hvd_call(call, COLLECTIVE_CALLS)
+
+    def _is_rank_call(self, call):
+        term = _terminal_name(call.func)
+        if term == "axis_index":
+            root = _root_name(call.func)
+            return root in self.lax_aliases or root == "jax"
+        return self._is_hvd_call(call, RANK_CALLS)
+
+    def _is_rank_dependent(self, expr):
+        return any(isinstance(n, ast.Call) and self._is_rank_call(n)
+                   for n in ast.walk(expr))
+
+    def _collectives_in(self, stmts):
+        out = []
+        for node in _scan_statements(stmts):
+            if (isinstance(node, ast.Call) and self._is_collective(node)
+                    and id(node) not in self._flagged):
+                has_name = any(kw.arg == "name" for kw in node.keywords)
+                out.append((node, has_name))
+        out.sort(key=lambda item: (item[0].lineno, item[0].col_offset))
+        return out
+
+    # -- rules -------------------------------------------------------------
+    def _report_201(self, call, kind):
+        self._flagged.add(id(call))
+        fn = _terminal_name(call.func)
+        self.diags.append(Diagnostic.make(
+            "HVD201",
+            f"collective `{fn}` runs only on ranks satisfying the "
+            f"{kind} condition: the other ranks never enter it and the "
+            "job deadlocks (every rank must call every collective)",
+            file=self.filename, line=call.lineno,
+            hint="move the collective outside the rank guard — guard "
+                 "only the rank-local work (logging, checkpointing); "
+                 + _DOC_HINT))
+
+    def _report_203(self, call):
+        self._flagged.add(id(call))
+        fn = _terminal_name(call.func)
+        self.diags.append(Diagnostic.make(
+            "HVD203",
+            f"collective `{fn}` inside rank-dependent control flow has "
+            "no explicit name=: auto-generated names follow call order, "
+            "which differs across ranks here, so the negotiation never "
+            "matches them (DuplicateNameError / stall)",
+            file=self.filename, line=call.lineno,
+            hint="pass a stable name= shared by every rank; "
+                 + _DOC_HINT))
+
+    def visit_If(self, node):
+        if self._is_rank_dependent(node.test):
+            body_c = self._collectives_in(node.body)
+            else_c = self._collectives_in(node.orelse)
+            if body_c and else_c:
+                for call, has_name in body_c + else_c:
+                    if not has_name and (_terminal_name(call.func)
+                                         not in _UNNAMED_OK):
+                        self._report_203(call)
+            elif body_c or else_c:
+                for call, _ in (body_c or else_c):
+                    self._report_201(call, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self._is_rank_dependent(node.test):
+            for call, _ in self._collectives_in(node.body):
+                self._report_201(call, "while")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        term = _terminal_name(node.func)
+        if term == "init" and self._is_hvd_call(node, {"init"}):
+            self.has_init = True
+        elif term in DIST_OPT_CALLS:
+            if self.dist_opt_node is None:
+                self.dist_opt_node = node
+        elif term in BROADCAST_STATE_CALLS:
+            self.has_broadcast = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr in _SYNC_MARKERS:
+            self.has_broadcast = True
+        elif node.attr == "elastic" and _root_name(node) in self.hvd_aliases:
+            self.uses_elastic = True
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if node.id in _SYNC_MARKERS:
+            self.has_broadcast = True
+        self.generic_visit(node)
+
+    def finish(self):
+        if (self.has_init and self.dist_opt_node is not None
+                and not self.has_broadcast and not self.uses_elastic):
+            self.diags.append(Diagnostic.make(
+                "HVD202",
+                "script calls init() and builds a DistributedOptimizer "
+                "but never broadcasts initial state: ranks start from "
+                "divergent parameters/optimizer moments and silently "
+                "train different models",
+                file=self.filename, line=self.dist_opt_node.lineno,
+                hint="after building params/optimizer, call "
+                     "broadcast_parameters(...) and "
+                     "broadcast_optimizer_state(..., root_rank=0) (or "
+                     "use the Broadcast callback / elastic state); "
+                     + _DOC_HINT))
+        return self.diags
+
+
+def _apply_suppressions(diags, src):
+    lines = src.splitlines()
+    file_off = set()
+    per_line = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            file_off.update(r.strip().upper()
+                            for r in m.group(1).split(","))
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            per_line[i] = {r.strip().upper() for r in m.group(1).split(",")}
+
+    def suppressed(d):
+        if "ALL" in file_off or d.rule in file_off:
+            return True
+        for ln in (d.line, d.line - 1):
+            rules = per_line.get(ln)
+            if rules and ("ALL" in rules or d.rule in rules):
+                # Same-line marker always applies; a previous-line marker
+                # only applies if that line is a standalone comment.
+                if ln == d.line or lines[ln - 1].lstrip().startswith("#"):
+                    return True
+        return False
+
+    return [d for d in diags if not suppressed(d)]
+
+
+def lint_source(src, filename="<string>"):
+    """Lint python source text; returns a list of :class:`Diagnostic`."""
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as exc:
+        return [Diagnostic.make(
+            "HVD001", f"syntax error: {exc.msg}",
+            file=filename, line=exc.lineno or 0)]
+    analyzer = _Analyzer(filename)
+    analyzer.visit(tree)
+    diags = analyzer.finish()
+    diags = _apply_suppressions(diags, src)
+    return dedupe(sorted(diags, key=Diagnostic.sort_key))
+
+
+def lint_file(path):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), filename=path)
+
+
+def iter_python_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def lint_paths(paths):
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    diags = []
+    for path in iter_python_files(paths):
+        diags.extend(lint_file(path))
+    return diags

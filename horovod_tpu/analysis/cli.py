@@ -1,0 +1,81 @@
+"""``hvd-lint``: static collective-correctness linter CLI.
+
+Runs the AST layer over scripts/directories and prints structured
+findings with ``file:line`` + fix hints:
+
+    hvd-lint train.py examples/
+    hvd-lint --format json --fail-on warning src/
+    hvd-lint --list-rules
+
+Exit codes: 0 no findings at/above ``--fail-on``; 1 findings; 2 usage
+or internal error. The jaxpr layer needs traced inputs, so it is an API
+(``horovod_tpu.analysis.check_fn``) and a bridge flag (``verify=``)
+rather than a CLI mode — see docs/lint.md.
+"""
+
+import argparse
+import json
+import sys
+
+from . import ast_lint
+from .diagnostics import ERROR, RULES
+
+
+def _build_parser():
+    parser = argparse.ArgumentParser(
+        prog="hvd-lint",
+        description="Static collective-correctness linter for "
+                    "horovod_tpu training scripts.")
+    parser.add_argument("paths", nargs="*", default=["."],
+                        help="python files or directories (default: .)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule ids to enable "
+                             "(default: all)")
+    parser.add_argument("--fail-on", choices=("error", "warning", "never"),
+                        default="error",
+                        help="lowest severity that fails the run "
+                             "(default: error)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    return parser
+
+
+def main(argv=None):
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (severity, title) in sorted(RULES.items()):
+            print(f"{rule}  {severity:7s}  {title}")
+        return 0
+
+    only = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+    try:
+        diags = ast_lint.lint_paths(args.paths)
+    except OSError as exc:
+        print(f"hvd-lint: {exc}", file=sys.stderr)
+        return 2
+    if only:
+        diags = [d for d in diags if d.rule in only]
+    diags.sort(key=lambda d: d.sort_key())
+
+    if args.format == "json":
+        print(json.dumps([d.to_dict() for d in diags], indent=1))
+    else:
+        for d in diags:
+            print(d.format())
+        errors = sum(d.severity == ERROR for d in diags)
+        print(f"hvd-lint: {len(diags)} finding(s) "
+              f"({errors} error(s), {len(diags) - errors} warning(s))")
+
+    if args.fail_on == "never":
+        return 0
+    if args.fail_on == "warning":
+        return 1 if diags else 0
+    return 1 if any(d.severity == ERROR for d in diags) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

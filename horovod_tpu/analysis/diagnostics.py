@@ -1,0 +1,87 @@
+"""Structured diagnostics shared by every hvd-lint layer.
+
+A finding is a :class:`Diagnostic`: rule id + severity + message +
+``file:line`` + a fix hint. The rule catalog lives here so the jaxpr
+analyzer, the AST linter, the runtime guard, and the CLI agree on ids
+and severities (full prose catalog: docs/lint.md).
+"""
+
+import dataclasses
+
+ERROR = "error"
+WARNING = "warning"
+
+#: rule id -> (severity, one-line title)
+RULES = {
+    "HVD001": (ERROR, "file does not parse"),
+    # -- jaxpr layer -------------------------------------------------------
+    "HVD101": (ERROR, "collective axis name is not bound by any enclosing "
+                      "mesh/shard_map"),
+    "HVD102": (ERROR, "collective under rank-dependent control flow "
+                      "(SPMD deadlock shape)"),
+    "HVD103": (ERROR, "paired collectives disagree on dtype/shape across "
+                      "branches"),
+    # -- AST layer ---------------------------------------------------------
+    "HVD201": (ERROR, "collective call guarded by a rank condition"),
+    "HVD202": (WARNING, "initial broadcast_parameters/"
+                        "broadcast_optimizer_state missing after init()"),
+    "HVD203": (WARNING, "auto-named collective inside rank-dependent "
+                        "control flow"),
+}
+
+_SEV_ORDER = {ERROR: 0, WARNING: 1}
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    """One finding, renderable as text or JSON."""
+
+    rule: str
+    severity: str
+    message: str
+    file: str = "<unknown>"
+    line: int = 0
+    hint: str = ""
+
+    @classmethod
+    def make(cls, rule, message, file="<unknown>", line=0, hint=""):
+        severity = RULES.get(rule, (ERROR, ""))[0]
+        return cls(rule=rule, severity=severity, message=message,
+                   file=file, line=int(line or 0), hint=hint)
+
+    @property
+    def location(self):
+        return f"{self.file}:{self.line}"
+
+    def format(self):
+        out = f"{self.location}: {self.severity} {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def sort_key(self):
+        return (self.file, self.line, _SEV_ORDER.get(self.severity, 9),
+                self.rule)
+
+
+def dedupe(diags):
+    """Drop exact repeats (a fixpoint re-walk of a ``while`` body reports
+    the same eqn more than once), preserving first-seen order."""
+    seen, out = set(), []
+    for d in diags:
+        key = (d.rule, d.file, d.line, d.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(d)
+    return out
+
+
+def worst_severity(diags):
+    if any(d.severity == ERROR for d in diags):
+        return ERROR
+    if diags:
+        return WARNING
+    return None

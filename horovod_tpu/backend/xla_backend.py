@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from . import Backend
 from ..ops import reduce_ops
 from ..utils import envparse
+from ..utils.jax_compat import shard_map as _shard_map
 
 AXIS = "hvd"
 # Bound on cached compiled programs, the analog of the reference's
@@ -166,7 +167,7 @@ class XlaSingleBackend(Backend):
                     outs.append(y)
                 return tuple(outs)
 
-            sm = jax.shard_map(
+            sm = _shard_map(
                 body, mesh=mesh,
                 in_specs=(P(), P(AXIS)), out_specs=P(AXIS))
             return jax.jit(sm)
@@ -202,7 +203,7 @@ class XlaSingleBackend(Backend):
                     g = lax.all_gather(x, AXIS, axis=0, tiled=True)
                     outs.append(g.reshape((-1,) + g.shape[2:])[None])
                 return tuple(outs)
-            sm = jax.shard_map(body, mesh=mesh, in_specs=P(AXIS),
+            sm = _shard_map(body, mesh=mesh, in_specs=P(AXIS),
                                out_specs=P(AXIS))
             return jax.jit(sm)
 
@@ -248,7 +249,7 @@ class XlaSingleBackend(Backend):
                 # device memory at any mesh size (the gather holds n
                 # blocks per device before indexing one).
                 return tuple(_psum_broadcast(x, root_rank) for x in xs)
-            sm = jax.shard_map(body, mesh=mesh, in_specs=P(AXIS),
+            sm = _shard_map(body, mesh=mesh, in_specs=P(AXIS),
                                out_specs=P(AXIS))
             return jax.jit(sm)
 
@@ -298,7 +299,7 @@ class XlaSingleBackend(Backend):
                     y = lax.all_to_all(x, AXIS, split_axis=1, concat_axis=0,
                                        tiled=True)
                     return y.reshape((1, -1) + y.shape[2:])
-                sm = jax.shard_map(body, mesh=mesh, in_specs=P(AXIS),
+                sm = _shard_map(body, mesh=mesh, in_specs=P(AXIS),
                                    out_specs=P(AXIS))
                 return jax.jit(sm)
 
@@ -355,7 +356,7 @@ class XlaSingleBackend(Backend):
                             y = (y / n).astype(x.dtype)
                         res.append(y)
                     return tuple(res)
-                sm = jax.shard_map(body, mesh=mesh, in_specs=P(AXIS),
+                sm = _shard_map(body, mesh=mesh, in_specs=P(AXIS),
                                    out_specs=P(AXIS))
                 return jax.jit(sm)
 

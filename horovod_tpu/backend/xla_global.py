@@ -26,6 +26,7 @@ from .tcp_backend import TcpBackend
 from .. import native
 from ..exceptions import HorovodInternalError
 from ..utils import envparse
+from ..utils.jax_compat import shard_map as _shard_map
 from ..utils.logging_util import get_logger
 
 # Native wire enums (csrc/common.h).
@@ -314,16 +315,10 @@ class XlaGlobalBackend(TcpBackend):
             out_specs = P()
 
         # Replication-check off: all_gather-then-index outputs ARE
-        # replicated over 'hvd' but the inference can't prove it (kwarg
-        # name differs across jax versions).
-        if hasattr(jax, "shard_map"):
-            fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("hvd"),
-                                       out_specs=out_specs,
-                                       check_vma=False))
-        else:
-            from jax.experimental.shard_map import shard_map
-            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("hvd"),
-                                   out_specs=out_specs, check_rep=False))
+        # replicated over 'hvd' but the inference can't prove it (the
+        # compat shim maps check_vma onto check_rep on older jax).
+        fn = jax.jit(_shard_map(body, mesh=mesh, in_specs=P("hvd"),
+                                out_specs=out_specs, check_vma=False))
         self._fn_cache[key] = fn
         return fn
 

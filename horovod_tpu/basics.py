@@ -130,6 +130,11 @@ def init(comm=None, process_sets=None):
             ps_mod._setup(_runtime, process_sets or [])
             return _runtime
 
+        # Fresh runtime: auto-name counters restart with it so ranks
+        # that re-init (elastic restart) agree on generated names.
+        from .ops.collectives import reset_auto_name_counters
+        reset_auto_name_counters()
+
         # Honor an EXPLICIT platform request: some site plugins
         # force-select themselves into jax_platforms at import time,
         # which would make every worker of a CPU-plane test job

@@ -27,10 +27,16 @@ import numpy as np
 from .exceptions import DuplicateNameError, HorovodInternalError
 from .ops import reduce_ops
 from .utils import envparse
+from .utils.callsite import format_user_frame
 from .utils.logging_util import get_logger
 
 DEFAULT_CYCLE_TIME_MS = 1.0
 DEFAULT_FUSION_THRESHOLD = 128 * 1024 * 1024  # reference: operations.cc:491
+# Warn when a submitted op stays in flight this long (reference stall
+# inspector default, horovod/common/stall_inspector.cc).
+DEFAULT_STALL_WARN_S = 60.0
+# Seconds between SPMD submission-order cross-checks (ORDER_CHECK mode).
+DEFAULT_ORDER_CHECK_INTERVAL_S = 5.0
 # Fused element counts are rounded to a multiple of this so bucket boundaries
 # stay aligned for XLA tiling (reference: FUSION_BUFFER_ATOMIC_UNIT=64,
 # horovod/common/common.h:147).
@@ -105,7 +111,10 @@ class Coordinator:
         self.fusion_threshold = envparse.get_int(
             envparse.FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD)
         self._queue = []
-        self._pending_names = set()
+        # (process_set_id, name) -> [enqueue_time, callsite|None, warned]
+        # for every in-flight named op: duplicate detection + the stall
+        # warning scan (reference: tensor_queue + stall_inspector).
+        self._pending_names = {}
         self._lock = threading.Lock()
         self._wakeup = threading.Event()
         self._running = False
@@ -115,6 +124,33 @@ class Coordinator:
         self.cycles = 0
         self.bytes_processed = 0
         self.tensors_processed = 0
+        # Stall warning (HOROVOD_TPU_STALL_CHECK_TIME, legacy spelling
+        # STALL_CHECK_TIME_SECONDS; 0 / STALL_CHECK_DISABLE turns it off).
+        if envparse.get_bool(envparse.STALL_CHECK_DISABLE):
+            self.stall_warn_s = 0.0
+        else:
+            self.stall_warn_s = envparse.get_float(
+                envparse.STALL_CHECK_TIME, envparse.get_float(
+                    envparse.STALL_CHECK_TIME_SECONDS,
+                    DEFAULT_STALL_WARN_S))
+        self._stall_scan_period = max(1.0, min(self.stall_warn_s / 2.0,
+                                               10.0))
+        self._last_stall_scan = time.monotonic()
+        # Opt-in submission-order guard (HOROVOD_TPU_ORDER_CHECK=1).
+        # None when disabled: the hot path pays one attribute check and
+        # allocates nothing (see analysis/order_guard.py).
+        self._order_guard = None
+        self._order_error = None
+        self._order_thread = None
+        self._order_record_path = None
+        if envparse.get_bool(envparse.ORDER_CHECK):
+            from .analysis.order_guard import SubmissionOrderGuard
+            self._order_record_path = (
+                envparse.get_str(envparse.ORDER_CHECK_RECORD) or None)
+            spmd = getattr(runtime, "mode", None) == "spmd"
+            self._order_guard = SubmissionOrderGuard(
+                rank=runtime.topology.rank,
+                record=(not spmd) or bool(self._order_record_path))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -124,6 +160,13 @@ class Coordinator:
         self._thread = threading.Thread(
             target=self._loop, name="hvd-tpu-coordinator", daemon=True)
         self._thread.start()
+        if (self._order_guard is not None
+                and getattr(self.runtime, "mode", None) == "spmd"
+                and self.runtime.topology.size > 1):
+            self._order_thread = threading.Thread(
+                target=self._order_check_loop,
+                name="hvd-tpu-order-check", daemon=True)
+            self._order_thread.start()
 
     def stop(self):
         with self._lock:
@@ -134,6 +177,17 @@ class Coordinator:
             self._running = False
         self._wakeup.set()
         self._thread.join(timeout=10)
+        if self._order_thread is not None:
+            self._order_thread.join(timeout=10)
+        if (self._order_guard is not None
+                and self._order_record_path is not None):
+            try:
+                path = self._order_guard.dump(self._order_record_path)
+                self._log.info("submission-order record written to %s",
+                               path)
+            except OSError as exc:
+                self._log.warning("could not write ORDER_CHECK record: %s",
+                                  exc)
         with self._lock:
             stranded = self._queue
             self._queue = []
@@ -145,27 +199,53 @@ class Coordinator:
     # -- submission (framework-thread side) --------------------------------
     def submit(self, entry):
         key = (entry.process_set.process_set_id, entry.name)
+        guard = self._order_guard
+        # Call-site capture only in ORDER_CHECK mode: the default hot
+        # path stays a dict insert.
+        site = format_user_frame() if guard is not None else None
         with self._lock:
             if not self._running:
                 raise HorovodInternalError(
                     "Coordinator is shut down; cannot submit operations")
+            if guard is not None and self._order_error is not None:
+                raise self._order_error
             if entry.name and key in self._pending_names:
-                raise DuplicateNameError(
-                    f"Duplicate tensor name {entry.name!r} in flight for "
-                    f"process set {entry.process_set.process_set_id}; names "
-                    "must be unique among in-flight operations "
-                    "(reference: horovod/common/tensor_queue.cc)")
+                raise self._duplicate_error(entry, key)
             if entry.name:
-                self._pending_names.add(key)
+                self._pending_names[key] = [entry.enqueue_time, site,
+                                            False]
             self._queue.append(entry)
+            if (guard is not None and entry.name
+                    and not entry.name.startswith("hvdlint.")):
+                # Inside the lock so the digest stream mirrors the true
+                # queue order even with concurrent submitter threads.
+                # Guard-internal ops ("hvdlint.*") are excluded: the
+                # checker submits on a timer, so they would land at
+                # rank-dependent stream positions and poison the digest.
+                guard.record(entry.name, entry.kind, callsite=site)
         self._wakeup.set()
         return entry.handle
+
+    def _duplicate_error(self, entry, key):
+        first = self._pending_names[key]
+        first_site = first[1] or (
+            "<unknown; set HOROVOD_TPU_ORDER_CHECK=1 to record "
+            "submission call-sites>")
+        return DuplicateNameError(
+            f"Duplicate tensor name {entry.name!r} in flight for "
+            f"process set {entry.process_set.process_set_id}: first "
+            f"submitted at {first_site}, duplicate submitted at "
+            f"{format_user_frame()}. Names must be unique among "
+            "in-flight operations (reference: "
+            "horovod/common/tensor_queue.cc). If the name is "
+            "auto-generated, rank-divergent call orders are the usual "
+            "cause — see hvd-lint rule HVD203 (docs/lint.md).")
 
     def _release_name(self, entry):
         if entry.name:
             with self._lock:
-                self._pending_names.discard(
-                    (entry.process_set.process_set_id, entry.name))
+                self._pending_names.pop(
+                    (entry.process_set.process_set_id, entry.name), None)
 
     # -- background cycle --------------------------------------------------
     def _loop(self):
@@ -180,6 +260,8 @@ class Coordinator:
                 break
             time.sleep(self.cycle_time_s)
             self._run_cycle()
+            if self.stall_warn_s > 0:
+                self._check_stalls()
 
     def _loop_native(self, backend):
         """SPMD mode: the native core owns negotiation and fusion — local
@@ -213,6 +295,75 @@ class Coordinator:
                 # Candidate switches are cycle-count driven so every rank
                 # applies the same knob at the same negotiation round.
                 self.runtime.autotuner.record_cycle()
+            if self.stall_warn_s > 0:
+                self._check_stalls()
+
+    def _check_stalls(self, now=None):
+        """Warn (once per op) about submissions in flight longer than the
+        stall threshold — the python-plane analog of the reference's
+        stall inspector (horovod/common/stall_inspector.cc). Scans at
+        most every ``_stall_scan_period`` seconds; a cycle with nothing
+        stalled costs one clock read and a compare."""
+        if now is None:
+            now = time.monotonic()
+        if now - self._last_stall_scan < self._stall_scan_period:
+            return
+        self._last_stall_scan = now
+        stalled = []
+        with self._lock:
+            for key, info in self._pending_names.items():
+                if not info[2] and now - info[0] > self.stall_warn_s:
+                    info[2] = True
+                    stalled.append((key[1], now - info[0], info[1]))
+        if stalled:
+            desc = ", ".join(
+                f"{name} ({age:.0f}s"
+                + (f", submitted at {site})" if site else ")")
+                for name, age, site in stalled)
+            self._log.warning(
+                "One or more tensors were submitted but have not "
+                "completed for over %.0f s — ranks may have diverged "
+                "(some rank never submitted the matching op). Stalled: "
+                "%s. Run `hvd-lint` on the training script to check for "
+                "rank-dependent collectives (docs/lint.md); tune via "
+                "HOROVOD_TPU_STALL_CHECK_TIME.",
+                self.stall_warn_s, desc)
+
+    def _order_check_loop(self):
+        """SPMD cross-check of the submission-order digests: allgather
+        each rank's recent checkpoint digests through the normal eager
+        data plane and compare at a common submission index (see
+        analysis/order_guard.py). Runs on its own thread so the blocking
+        allgather never stalls the cycle driver."""
+        from .exceptions import SubmissionOrderError
+        from .ops import collectives
+        import jax.numpy as jnp
+
+        interval = envparse.get_float(envparse.ORDER_CHECK_INTERVAL,
+                                      DEFAULT_ORDER_CHECK_INTERVAL_S)
+        interval = max(0.2, interval)
+        round_no = 0
+        waited = 0.0
+        while self._running:
+            time.sleep(0.2)
+            waited += 0.2
+            if waited < interval or not self._running:
+                continue
+            waited = 0.0
+            round_no += 1
+            try:
+                payload = jnp.asarray(self._order_guard.sync_payload())
+                gathered = collectives.allgather(
+                    payload, name=f"hvdlint.order_check.{round_no}")
+                self._order_guard.verify(
+                    np.asarray(gathered), self.runtime.topology.size)
+            except SubmissionOrderError as exc:
+                self._order_error = exc
+                self._log.error("%s", exc)
+                return
+            except Exception as exc:  # noqa: BLE001 - advisory check
+                if self._running:
+                    self._log.debug("order check round skipped: %s", exc)
 
     def _run_cycle(self):
         with self._lock:
@@ -241,8 +392,8 @@ class Coordinator:
             with self._lock:
                 for e in batch:
                     if e.name:
-                        self._pending_names.discard(
-                            (e.process_set.process_set_id, e.name))
+                        self._pending_names.pop(
+                            (e.process_set.process_set_id, e.name), None)
 
     def _run_fused_allreduces(self, backend, entries, timeline):
         """Bucket by (process set, op, scales, dtype), concat flattened

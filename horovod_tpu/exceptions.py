@@ -59,3 +59,28 @@ class DuplicateNameError(ValueError):
 class StalledTensorError(RuntimeError):
     """A named tensor was submitted by some ranks but not all within the stall
     timeout (reference: horovod/common/stall_inspector.cc:26)."""
+
+
+class SubmissionOrderError(RuntimeError):
+    """Ranks submitted collectives in divergent orders (or with divergent
+    auto-generated names), detected by the opt-in runtime order guard
+    (``HOROVOD_TPU_ORDER_CHECK=1``; analysis/order_guard.py). The static
+    analog is hvd-lint rule HVD203.
+
+    Deliberately NOT a ``HorovodInternalError``: the divergence is a
+    deterministic program bug, so the elastic restore/retry loop (which
+    catches internal errors as recoverable) must surface it instead of
+    retrying into the same divergence forever."""
+
+
+class CollectiveLintError(ValueError):
+    """Static analysis (hvd-lint) found error-severity collective hazards
+    and ``verify=`` asked for enforcement. ``self.diagnostics`` carries
+    the structured findings (analysis/diagnostics.py)."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        lines = "\n".join(d.format() for d in self.diagnostics)
+        super().__init__(
+            f"hvd-lint found {len(self.diagnostics)} collective-"
+            f"correctness finding(s):\n{lines}")

@@ -39,6 +39,7 @@ HVD_AXIS = "hvd"
 
 
 from ..utils.jax_compat import pvary as _pvary  # noqa: E402
+from ..utils.jax_compat import shard_map as _shard_map  # noqa: E402
 
 
 def _reduce_in_axis(grads, op, axis_name, prescale=None, postscale=None):
@@ -296,13 +297,13 @@ def make_train_step(loss_fn, dist_opt, mesh=None, axis_name=HVD_AXIS,
                 lax.pmean(loss, axis_name))
 
     if has_aux:
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             body_aux, mesh=mesh,
             in_specs=(P(), P(), P(), P(axis_name)),
             out_specs=(P(), P(), P(), P()))
         donate_argnums = (0, 1, 2) if donate else ()
     else:
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             body_plain, mesh=mesh,
             in_specs=(P(), P(), P(axis_name)),
             out_specs=(P(), P(), P()))
@@ -454,7 +455,7 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
             del p
             return inner.init(jnp.zeros((shard_len,), dtype))
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             body, mesh=mesh, in_specs=(P(),),
             out_specs=state_spec))(params)
 
@@ -484,7 +485,7 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
     # (every rank contributes its shard and receives all others), but the
     # varying-axes type system cannot prove it and would reject the P()
     # out_spec.
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), state_spec, P(axis_name)),
         out_specs=(P(), state_spec, P()),

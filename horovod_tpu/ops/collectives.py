@@ -14,6 +14,7 @@ Input conventions by runtime mode (see basics.py):
   list of per-rank arrays instead.
 """
 
+import os
 import threading
 
 import jax.numpy as jnp
@@ -21,17 +22,60 @@ import jax.numpy as jnp
 from .. import basics
 from ..coordinator import Handle, TensorEntry
 from ..process_sets import global_process_set
+from ..utils import envparse
+from ..utils.callsite import user_frame
 from . import reduce_ops
 from .compression import Compression
 
 _name_counter = [0]
+_site_counters = {}
 _name_lock = threading.Lock()
+_legacy_names = None  # resolved lazily so tests can set the env first
 
 
 def _auto_name(kind):
+    """Deterministic per-call-site auto name.
+
+    The reference names unnamed tensors by a process-global counter
+    (reference: horovod/torch/mpi_ops.py _make_function handle naming).
+    A global counter diverges across ranks the moment submission
+    interleaving differs (two threads, a rank-local extra collective),
+    and then negotiation pairs the wrong tensors or stalls — hvd-lint
+    rule HVD203. Instead: name by the *user call-site*
+    (file:qualname:lineno) plus a per-site counter, which is identical
+    on every rank running the same program regardless of interleaving
+    between sites. HOROVOD_TPU_LEGACY_AUTO_NAMES=1 restores the old
+    global-counter scheme.
+    """
+    global _legacy_names
+    if _legacy_names is None:
+        _legacy_names = envparse.get_bool(envparse.LEGACY_AUTO_NAMES)
+    if _legacy_names:
+        with _name_lock:
+            _name_counter[0] += 1
+            return f"{kind}.noname.{_name_counter[0]}"
+    filename, lineno, qualname = user_frame(skip=2)
+    # basename, not the full path: venv/checkout prefixes legally differ
+    # across hosts of one job; the script's own name does not.
+    module = os.path.basename(filename)
+    if module.endswith(".py"):
+        module = module[:-3]
+    key = (kind, filename, lineno)
     with _name_lock:
-        _name_counter[0] += 1
-        return f"{kind}.noname.{_name_counter[0]}"
+        count = _site_counters.get(key, 0) + 1
+        _site_counters[key] = count
+    return f"{kind}.auto.{module}:{qualname}:{lineno}#{count}"
+
+
+def reset_auto_name_counters():
+    """Reset per-site auto-name counters (elastic restarts re-run the
+    program from a known point; counters must restart with it so ranks
+    that rejoin agree on names)."""
+    global _legacy_names
+    with _name_lock:
+        _site_counters.clear()
+        _name_counter[0] = 0
+        _legacy_names = None
 
 
 def _submit(entry):

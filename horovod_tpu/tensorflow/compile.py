@@ -1159,13 +1159,14 @@ class CompiledFunction:
     graph's Assign ops after each training call."""
 
     def __init__(self, cf, params, buffers, capture_values, fdefs,
-                 compute_dtype=None):
+                 compute_dtype=None, verify=False):
         _init_tables()
         self._cf = cf
         self._interp = _GraphInterpreter(cf.graph, capture_values, fdefs)
         self.params = params
         self.buffers = buffers
         self.compute_dtype = compute_dtype
+        self.verify = verify
         self._jitted = {}
 
     # -- functional core ---------------------------------------------------
@@ -1202,13 +1203,20 @@ class CompiledFunction:
     def __call__(self, *inputs, rng=None, training=False):
         import jax
         sig = (training, rng is not None, len(inputs))
+        inputs = tuple(self._coerce(v) for v in inputs)
         if sig not in self._jitted:
             def fwd(params, buffers, inputs, rng):
                 out, _ = self.apply(params, inputs, buffers=buffers,
                                     rng=rng, training=training)
                 return out
+            if self.verify:
+                # hvd-lint jaxpr layer over the rebuilt graph before it
+                # is jitted: once per signature, trace-only.
+                from .. import analysis
+                analysis.verify_traceable(
+                    fwd, (self.params, self.buffers, inputs, rng),
+                    mode=self.verify, what="tf-bridge forward")
             self._jitted[sig] = jax.jit(fwd)
-        inputs = tuple(self._coerce(v) for v in inputs)
         return self._jitted[sig](self.params, self.buffers, inputs, rng)
 
     @staticmethod
@@ -1292,7 +1300,7 @@ class CompiledFunction:
 
 
 def tpu_compile(fn, example_inputs=None, input_signature=None,
-                dynamic_batch=True, compute_dtype=None):
+                dynamic_batch=True, compute_dtype=None, verify=False):
     """Compile a TF2 callable for TPU execution via graph→JAX.
 
     Args:
@@ -1307,6 +1315,9 @@ def tpu_compile(fn, example_inputs=None, input_signature=None,
       input_signature: alternative to example_inputs — a list of
         ``tf.TensorSpec`` (None dims allowed; they resolve to the actual
         jax shapes at interpretation time).
+      verify: run the hvd-lint jaxpr analyzer over each signature before
+        jitting (True: raise on error-severity findings; ``"warn"``:
+        log only) — see docs/lint.md.
 
     Returns a :class:`CompiledFunction`.
     """
@@ -1362,7 +1373,7 @@ def tpu_compile(fn, example_inputs=None, input_signature=None,
     fdefs = {f.signature.name: f
              for f in cf.graph.as_graph_def().library.function}
     return CompiledFunction(cf, params, buffers, capture_values, fdefs,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype, verify=verify)
 
 
 def def_function_type():

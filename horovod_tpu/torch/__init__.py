@@ -730,16 +730,18 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
 
 def tpu_compile(module, input_names=None, example_inputs=None,
-                loss_key="loss", compute_dtype=None):
+                loss_key="loss", compute_dtype=None, verify=False):
     """Compile a torch module to run its math on the TPU via fx→JAX
     (see horovod_tpu/torch/compile.py — the TPU-first replacement for
     the reference's device-tensor adapter, mpi_ops_v2.cc:624).
     ``compute_dtype=jnp.bfloat16`` enables mixed precision (fp32 master
-    weights, bf16 matmuls — the torch-xla XLA_USE_BF16 analog)."""
+    weights, bf16 matmuls — the torch-xla XLA_USE_BF16 analog);
+    ``verify=True`` runs the hvd-lint jaxpr analyzer over each traced
+    signature before jitting (docs/lint.md)."""
     from .compile import tpu_compile as _impl
     return _impl(module, input_names=input_names,
                  example_inputs=example_inputs, loss_key=loss_key,
-                 compute_dtype=compute_dtype)
+                 compute_dtype=compute_dtype, verify=verify)
 
 
 def __getattr__(name):

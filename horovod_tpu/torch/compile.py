@@ -1056,13 +1056,14 @@ class CompiledModule:
     signature."""
 
     def __init__(self, gm, params, buffers, loss_key="loss", aliases=None,
-                 compute_dtype=None):
+                 compute_dtype=None, verify=False):
         import jax
         self._interp = _JaxInterpreter(gm, aliases=aliases)
         self.params = params
         self.buffers = buffers
         self.loss_key = loss_key
         self.compute_dtype = compute_dtype
+        self.verify = verify
         self._jitted = {}
         self._jax = jax
 
@@ -1085,12 +1086,20 @@ class CompiledModule:
     def __call__(self, rng=None, train=False, **inputs):
         import jax
         sig = (train, rng is not None, tuple(sorted(inputs)))
+        inputs = {k: self._coerce(v) for k, v in inputs.items()}
         if sig not in self._jitted:
             def fwd(params, buffers, inputs, rng):
                 return self._interp.run(params, buffers, inputs,
                                         rng=rng, train=train)
+            if self.verify:
+                # Static collective-correctness pass over the traced
+                # program before it is jitted (hvd-lint jaxpr layer):
+                # once per signature, trace-only, nothing runs on chip.
+                from .. import analysis
+                analysis.verify_traceable(
+                    fwd, (self.params, self.buffers, inputs, rng),
+                    mode=self.verify, what="torch-bridge forward")
             self._jitted[sig] = jax.jit(fwd)
-        inputs = {k: self._coerce(v) for k, v in inputs.items()}
         return self._jitted[sig](self.params, self.buffers, inputs, rng)
 
     @staticmethod
@@ -1183,7 +1192,7 @@ class CompiledModule:
 
 
 def tpu_compile(module, input_names=None, example_inputs=None,
-                loss_key="loss", compute_dtype=None):
+                loss_key="loss", compute_dtype=None, verify=False):
     """Compile a torch module for TPU execution via fx→JAX.
 
     HF transformers models are traced with ``transformers.utils.fx``
@@ -1196,6 +1205,10 @@ def tpu_compile(module, input_names=None, example_inputs=None,
     traced graph is compared against the eager module on these inputs
     and a mismatch fails loudly at compile time instead of training on
     the wrong branch.
+
+    ``verify`` runs the hvd-lint jaxpr analyzer over each forward
+    signature before it is jitted (True: raise on error-severity
+    findings; ``"warn"``: log only) — see docs/lint.md.
     """
     import torch
 
@@ -1234,4 +1247,5 @@ def tpu_compile(module, input_names=None, example_inputs=None,
         if n not in params and n not in aliases:
             params[n] = _t2j(p)
     return CompiledModule(gm, params, buffers, loss_key=loss_key,
-                          aliases=aliases, compute_dtype=compute_dtype)
+                          aliases=aliases, compute_dtype=compute_dtype,
+                          verify=verify)

@@ -9,7 +9,11 @@ drop-in compatibility with scripts written for the reference, the matching
 
 import os
 
-_PREFIXES = ("HVDTPU_", "HOROVOD_")
+# HOROVOD_TPU_ sits between the native spelling and the reference
+# fallback: it is the documented prefix for the TPU-only correctness
+# knobs (HOROVOD_TPU_ORDER_CHECK, HOROVOD_TPU_STALL_CHECK_TIME) that
+# have no reference analog.
+_PREFIXES = ("HVDTPU_", "HOROVOD_TPU_", "HOROVOD_")
 
 
 def get_env(name, default=None):
@@ -72,6 +76,19 @@ LOG_LEVEL = "LOG_LEVEL"
 STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
 STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
+# Short spelling for the coordinator's stall warning (documented as
+# HOROVOD_TPU_STALL_CHECK_TIME); falls back to STALL_CHECK_TIME_SECONDS.
+STALL_CHECK_TIME = "STALL_CHECK_TIME"
+# Submission-order guard (documented as HOROVOD_TPU_ORDER_CHECK): hash
+# the per-cycle tensor-name submission sequence, cross-check across
+# ranks in SPMD mode, record it otherwise (analysis/order_guard.py).
+ORDER_CHECK = "ORDER_CHECK"
+ORDER_CHECK_RECORD = "ORDER_CHECK_RECORD"      # JSON dump path for sequences
+ORDER_CHECK_INTERVAL = "ORDER_CHECK_INTERVAL"  # seconds between cross-checks
+# Restore the pre-lint process-global auto-name counter
+# ("<kind>.noname.<n>"), which can diverge across ranks when submission
+# interleaving differs (see ops/collectives.py _auto_name).
+LEGACY_AUTO_NAMES = "LEGACY_AUTO_NAMES"
 AUTOTUNE = "AUTOTUNE"
 AUTOTUNE_LOG = "AUTOTUNE_LOG"
 # Min buffer bytes before allreduce takes the two-level intra-host/

@@ -3,6 +3,25 @@
 from jax import lax
 
 
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma=True):
+    """``jax.shard_map`` across the API transition: newer releases
+    export it at top level with ``check_vma=``; older ones live in
+    ``jax.experimental.shard_map`` and spell the flag ``check_rep=``."""
+    import jax
+    top = getattr(jax, "shard_map", None)
+    if top is not None:
+        try:
+            return top(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check_vma)
+        except TypeError:
+            return top(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def pvary(x, axis_name):
     """Mark a value device-varying along ``axis_name`` (no-op if it
     already is). Papers over the lax.pcast / lax.pvary API transition."""
@@ -14,4 +33,8 @@ def pvary(x, axis_name):
         try:
             return lax.pvary(x, axis_name)
         except ValueError:
+            return x
+        except AttributeError:
+            # Pre-varying-types jax (<= 0.4.x): no pcast/pvary and no
+            # vma tracking to appease — identity is exactly right.
             return x

@@ -1,0 +1,24 @@
+"""Lint fixture (never executed): auto-named collectives under
+rank-dependent control flow — both branches exchange data, but the
+generated names follow per-rank call order and never match up.
+
+Expected findings: HVD203 at both allreduce calls.
+"""
+
+import horovod_tpu as hvd
+import jax.numpy as jnp
+
+
+def main():
+    hvd.init()
+    x = jnp.ones(8)
+
+    if hvd.rank() % 2 == 0:
+        y = hvd.allreduce(x * 2)
+    else:
+        y = hvd.allreduce(x + 1)
+    return y
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Lint fixture (never executed): trains through a DistributedOptimizer
+without ever broadcasting the initial state.
+
+Expected findings: HVD202 at the DistributedOptimizer call.
+"""
+
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+
+
+def main(model, params, batches):
+    hvd.init()
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-3))
+
+    def loss_fn(p, batch):
+        return model.apply(p, batch).mean()
+
+    step = hvd_jax.make_train_step(loss_fn, opt)
+    opt_state = opt.init(params)
+    # BUG: params/opt_state were initialized per-process and are never
+    # synchronized — every rank trains a different model.
+    for batch in batches:
+        params, opt_state, loss = step(params, opt_state, batch)
+    return params
+
+
+if __name__ == "__main__":
+    main(None, None, [])

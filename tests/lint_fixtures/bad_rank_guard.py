@@ -1,0 +1,30 @@
+"""Lint fixture (never executed): the classic rank-guarded collective.
+
+Expected findings: HVD201 at the allreduce (if-guard) and HVD201 at the
+allgather (rank-dependent while trip count).
+"""
+
+import horovod_tpu as hvd
+import jax.numpy as jnp
+
+
+def main():
+    hvd.init()
+    x = jnp.ones(8)
+
+    if hvd.rank() == 0:
+        # Only rank 0 arrives: every other rank waits forever.
+        x = hvd.allreduce(x, name="metrics.loss")
+
+    steps = 0
+    while steps < hvd.rank() + 2:
+        # Trip count differs per rank: collective call counts diverge.
+        x = hvd.allgather(x, name="gathered")
+        steps += 1
+
+    if hvd.rank() == 0:
+        print(float(x.sum()))
+
+
+if __name__ == "__main__":
+    main()

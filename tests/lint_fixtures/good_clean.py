@@ -1,0 +1,35 @@
+"""Lint fixture (never executed): the same training shape as the bad
+fixtures, written correctly. Expected findings: none.
+
+Rank guards wrap only rank-local work; the collectives run on every
+rank with stable names; initial state is broadcast after init.
+"""
+
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+
+
+def main(model, params, batches):
+    hvd.init()
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-3))
+
+    def loss_fn(p, batch):
+        return model.apply(p, batch).mean()
+
+    step = hvd_jax.make_train_step(loss_fn, opt)
+    opt_state = opt.init(params)
+    params = hvd_jax.broadcast_parameters(params, root_rank=0)
+    opt_state = hvd_jax.broadcast_optimizer_state(opt_state, root_rank=0)
+
+    for epoch, batch in enumerate(batches):
+        params, opt_state, loss = step(params, opt_state, batch)
+        loss = hvd.allreduce(loss, name=f"epoch_loss.{epoch}")
+        if hvd.rank() == 0:
+            print(epoch, float(loss))
+    return params
+
+
+if __name__ == "__main__":
+    main(None, None, [])

@@ -1,0 +1,21 @@
+"""Lint fixture (never executed): a deliberate rank-guarded collective
+with an explicit suppression — e.g. a single-rank debug path the author
+has reasoned about. Expected findings: none (suppressed)."""
+
+import horovod_tpu as hvd
+import jax.numpy as jnp
+
+
+def main():
+    hvd.init()
+    if hvd.size() == 1 and hvd.rank() == 0:
+        # Single-process smoke path; no peers to deadlock with.
+        hvd.allreduce(jnp.ones(4), name="smoke")  # hvd-lint: disable=HVD201
+
+    if hvd.rank() == 0:
+        # hvd-lint: disable=HVD201
+        hvd.barrier()
+
+
+if __name__ == "__main__":
+    main()

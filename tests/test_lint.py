@@ -1,0 +1,550 @@
+"""hvd-lint: jaxpr analyzer, AST linter, CLI, auto-naming, and the
+runtime submission-order guard / stall warning.
+
+Every lint rule has at least one positive and one negative case; the
+clean-sweep tests pin `hvd-lint` to zero findings over examples/ and
+horovod_tpu/models/ so the shipped code stays lint-clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from conftest import clean_spawn_env
+from horovod_tpu import analysis
+from horovod_tpu.analysis import ast_lint
+from horovod_tpu.analysis.order_guard import SubmissionOrderGuard
+from horovod_tpu.exceptions import (CollectiveLintError,
+                                    SubmissionOrderError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+AXES = {"hvd": 8}
+
+
+def rules_of(diags):
+    return sorted(d.rule for d in diags)
+
+
+# ==========================================================================
+# Layer 1: jaxpr analyzer
+# ==========================================================================
+class TestJaxprRules:
+    def test_unbound_axis_at_trace_time(self):
+        diags = analysis.check_fn(lambda x: lax.psum(x, "tp"),
+                                  jnp.ones(4), axis_sizes=AXES)
+        assert rules_of(diags) == ["HVD101"]
+
+    def test_unbound_axis_structural(self):
+        core = jax.core
+        with core.extend_axis_env_nd([("hvd", 8), ("tp", 2)]):
+            closed = jax.make_jaxpr(lambda x: lax.psum(x, "tp"))(1.0)
+        assert rules_of(analysis.check_jaxpr(
+            closed, bound_axes={"hvd"})) == ["HVD101"]
+        # negative: the axis IS declared bound
+        assert analysis.check_jaxpr(closed,
+                                    bound_axes={"hvd", "tp"}) == []
+
+    def test_shard_map_binds_its_axis(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()), ("hvd",))
+        fn = shard_map(lambda x: lax.psum(x, "hvd"), mesh=mesh,
+                       in_specs=P("hvd"), out_specs=P())
+        assert analysis.check_fn(fn, jnp.ones(8)) == []
+
+    def test_declared_axis_is_clean(self):
+        assert analysis.check_fn(lambda x: lax.pmean(x, "hvd"),
+                                 jnp.ones(4), axis_sizes=AXES) == []
+
+    def test_rank_dependent_cond(self):
+        def fn(x):
+            pred = lax.axis_index("hvd") == 0
+            return lax.cond(pred, lambda y: lax.psum(y, "hvd"),
+                            lambda y: y, x)
+        diags = analysis.check_fn(fn, jnp.float32(1.0), axis_sizes=AXES)
+        assert rules_of(diags) == ["HVD102"]
+        assert diags[0].line > 0  # carries a real source location
+
+    def test_data_dependent_cond_is_clean(self):
+        def fn(x):
+            return lax.cond(x.sum() > 0, lambda y: lax.psum(y, "hvd"),
+                            lambda y: -y, x)
+        assert analysis.check_fn(fn, jnp.ones(4), axis_sizes=AXES) == []
+
+    def test_rank_dependent_while(self):
+        def fn(x):
+            i = lax.axis_index("hvd")
+            return lax.while_loop(
+                lambda c: c[0] < i,
+                lambda c: (c[0] + 1, lax.psum(c[1], "hvd")),
+                (0, x))
+        diags = analysis.check_fn(fn, jnp.float32(1.0), axis_sizes=AXES)
+        assert "HVD102" in rules_of(diags)
+
+    def test_invariant_while_is_clean(self):
+        def fn(x):
+            return lax.while_loop(
+                lambda c: c[0] < 3,
+                lambda c: (c[0] + 1, lax.psum(c[1], "hvd")),
+                (0, x))
+        assert analysis.check_fn(fn, jnp.float32(1.0),
+                                 axis_sizes=AXES) == []
+
+    def test_mismatched_branch_collectives(self):
+        def fn(x):
+            pred = lax.axis_index("hvd") == 0
+            return lax.cond(
+                pred,
+                lambda y: lax.psum(y, "hvd"),
+                lambda y: lax.psum(y.astype(jnp.bfloat16),
+                                   "hvd").astype(jnp.float32), x)
+        diags = analysis.check_fn(fn, jnp.ones(4), axis_sizes=AXES)
+        assert "HVD103" in rules_of(diags)
+
+    def test_matching_branch_collectives_no_103(self):
+        def fn(x):
+            pred = lax.axis_index("hvd") == 0
+            return lax.cond(pred,
+                            lambda y: lax.psum(y * 2, "hvd"),
+                            lambda y: lax.psum(y + 1, "hvd"), x)
+        diags = analysis.check_fn(fn, jnp.ones(4), axis_sizes=AXES)
+        assert "HVD103" not in rules_of(diags)  # 102 still fires
+        assert "HVD102" in rules_of(diags)
+
+    def test_collective_through_jit_is_seen(self):
+        fn = jax.jit(lambda x: lax.psum(x, "tp"))
+        diags = analysis.check_fn(fn, jnp.ones(4), axis_sizes=AXES)
+        assert rules_of(diags) == ["HVD101"]
+
+    def test_clean_function(self):
+        assert analysis.check_fn(jax.jit(lambda x: x * 2),
+                                 jnp.ones(3)) == []
+
+    def test_enforce_raises_on_errors(self):
+        diags = analysis.check_fn(lambda x: lax.psum(x, "tp"),
+                                  jnp.ones(4), axis_sizes=AXES)
+        with pytest.raises(CollectiveLintError) as err:
+            analysis.enforce(diags, True, what="test")
+        assert "HVD101" in str(err.value)
+        # warn mode never raises
+        analysis.enforce(diags, "warn", what="test")
+        analysis.enforce(diags, False, what="test")
+
+
+# ==========================================================================
+# Layer 2: AST linter (fixture corpus)
+# ==========================================================================
+class TestAstRules:
+    def lint(self, name):
+        return ast_lint.lint_file(os.path.join(FIXTURES, name))
+
+    def test_rank_guard_fixture(self):
+        diags = self.lint("bad_rank_guard.py")
+        assert rules_of(diags) == ["HVD201", "HVD201"]
+
+    def test_missing_broadcast_fixture(self):
+        assert rules_of(self.lint("bad_missing_broadcast.py")) == \
+            ["HVD202"]
+
+    def test_auto_name_fixture(self):
+        assert rules_of(self.lint("bad_auto_name.py")) == \
+            ["HVD203", "HVD203"]
+
+    def test_clean_fixture(self):
+        assert self.lint("good_clean.py") == []
+
+    def test_suppression_comments(self):
+        assert self.lint("good_suppressed.py") == []
+
+    def test_rank_guarded_logging_is_clean(self):
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.init()\n"
+               "if hvd.rank() == 0:\n"
+               "    print('hello from rank 0')\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_elastic_state_satisfies_broadcast(self):
+        src = ("import horovod_tpu.torch as hvd\n"
+               "from horovod_tpu import elastic\n"
+               "hvd.init()\n"
+               "opt = hvd.DistributedOptimizer(opt)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_keras_callback_satisfies_broadcast(self):
+        src = ("import horovod_tpu.keras as hvd\n"
+               "hvd.init()\n"
+               "opt = hvd.DistributedOptimizer(opt)\n"
+               "cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_lax_collective_under_rank_guard(self):
+        src = ("import horovod_tpu as hvd\n"
+               "from jax import lax\n"
+               "def step(x):\n"
+               "    if hvd.rank() == 0:\n"
+               "        x = lax.psum(x, 'hvd')\n"
+               "    return x\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD201"]
+
+    def test_fixed_name_broadcast_helpers_exempt_from_203(self):
+        """broadcast_object & co. use fixed internal names (functions.py)
+        — never call-order dependent, so no HVD203 for them even under
+        rank-dependent branching."""
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.init()\n"
+               "if hvd.rank() == 0:\n"
+               "    hvd.broadcast_object(cfg)\n"
+               "else:\n"
+               "    cfg = hvd.broadcast_object(None)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_unrelated_broadcast_name_is_not_horovod(self):
+        src = ("class Bus:\n"
+               "    def emit(self):\n"
+               "        broadcast(self)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_syntax_error_reported(self):
+        assert rules_of(ast_lint.lint_source("def broken(:\n")) == \
+            ["HVD001"]
+
+    def test_file_level_suppression(self):
+        src = ("# hvd-lint: disable-file=HVD201\n"
+               "import horovod_tpu as hvd\n"
+               "if hvd.rank() == 0:\n"
+               "    hvd.barrier()\n")
+        assert ast_lint.lint_source(src) == []
+
+
+def test_clean_sweep_examples_and_models():
+    """Acceptance: zero findings over examples/ and horovod_tpu/models/."""
+    diags = ast_lint.lint_paths([os.path.join(REPO, "examples"),
+                                 os.path.join(REPO, "horovod_tpu",
+                                              "models")])
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+# ==========================================================================
+# CLI (console entry point behavior via python -m)
+# ==========================================================================
+def _run_cli(*args):
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.cli", *args],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_detects_fixture_corpus():
+    proc = _run_cli(FIXTURES, "--format", "json", "--fail-on", "warning")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    findings = json.loads(proc.stdout)
+    found = {d["rule"] for d in findings}
+    assert {"HVD201", "HVD202", "HVD203"} <= found
+    files = {os.path.basename(d["file"]) for d in findings}
+    assert "good_clean.py" not in files
+    assert "good_suppressed.py" not in files
+
+
+def test_cli_clean_sweep_and_rule_listing():
+    """The shipped examples and models lint clean through the CLI (the
+    CI usage documented in docs/lint.md), and --list-rules works."""
+    proc = _run_cli(os.path.join(REPO, "examples"),
+                    os.path.join(REPO, "horovod_tpu", "models"),
+                    "--fail-on", "warning")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+    listing = _run_cli("--list-rules")
+    assert listing.returncode == 0
+    assert "HVD201" in listing.stdout
+
+
+# ==========================================================================
+# Deterministic auto-naming (ops/collectives.py)
+# ==========================================================================
+class TestAutoNames:
+    def test_per_site_counter_and_determinism(self):
+        from horovod_tpu.ops import collectives as C
+
+        def site_a():
+            return C._auto_name("allreduce")
+
+        def site_b():
+            return C._auto_name("allreduce")
+
+        C.reset_auto_name_counters()
+        first = [site_a(), site_a(), site_b()]
+        # Same site twice -> same stem, bumped counter; different site ->
+        # different stem.
+        assert first[0].endswith("#1") and first[1].endswith("#2")
+        assert first[0].rsplit("#", 1)[0] == first[1].rsplit("#", 1)[0]
+        assert first[2].rsplit("#", 1)[0] != first[0].rsplit("#", 1)[0]
+        assert "site_a" in first[0] and "site_b" in first[2]
+        # A second process running the same program (simulated by a
+        # counter reset) generates the identical stream — the property
+        # that keeps auto names rank-invariant.
+        C.reset_auto_name_counters()
+        assert [site_a(), site_a(), site_b()] == first
+
+    def test_interleaving_does_not_shift_names(self):
+        from horovod_tpu.ops import collectives as C
+
+        def site_a():
+            return C._auto_name("allreduce")
+
+        def site_b():
+            return C._auto_name("allgather")
+
+        C.reset_auto_name_counters()
+        rank0 = [site_a(), site_b(), site_a()]
+        C.reset_auto_name_counters()
+        # "rank 1" interleaves the sites differently (an extra rank-local
+        # call order change); per-site names still match pairwise.
+        rank1 = [site_b(), site_a(), site_a()]
+        assert sorted(rank0) == sorted(rank1)
+
+    def test_legacy_env_knob(self, monkeypatch):
+        from horovod_tpu.ops import collectives as C
+        monkeypatch.setenv("HOROVOD_TPU_LEGACY_AUTO_NAMES", "1")
+        C.reset_auto_name_counters()
+        try:
+            name = C._auto_name("allreduce")
+            assert name == "allreduce.noname.1"
+        finally:
+            monkeypatch.delenv("HOROVOD_TPU_LEGACY_AUTO_NAMES")
+            C.reset_auto_name_counters()
+
+
+# ==========================================================================
+# Layer 3: submission-order guard
+# ==========================================================================
+class TestOrderGuard:
+    def test_identical_streams_pass(self):
+        guards = [SubmissionOrderGuard(rank=r) for r in range(2)]
+        for g in guards:
+            for i in range(200):
+                g.record(f"grad.{i % 7}", "allreduce")
+        idx = SubmissionOrderGuard.compare_payloads(
+            [g.sync_payload() for g in guards])
+        assert idx is not None and idx >= 1
+
+    def test_divergent_order_is_caught(self):
+        """Acceptance: an intentionally rank-divergent submission order
+        (same multiset of names, different order) raises."""
+        g0, g1 = SubmissionOrderGuard(rank=0), SubmissionOrderGuard(rank=1)
+        names = [f"t{i}" for i in range(64)]
+        for n in names:
+            g0.record(n)
+        for n in reversed(names):
+            g1.record(n)
+        with pytest.raises(SubmissionOrderError) as err:
+            SubmissionOrderGuard.compare_payloads(
+                [g0.sync_payload(), g1.sync_payload()])
+        assert "hvd-lint" in str(err.value)
+
+    def test_skewed_counts_compare_at_common_checkpoint(self):
+        """A rank that is merely AHEAD (same prefix) must not be flagged
+        — comparison is count-aligned, not instantaneous."""
+        g0, g1 = SubmissionOrderGuard(rank=0), SubmissionOrderGuard(rank=1)
+        for i in range(64):
+            g0.record(f"t{i}")
+            g1.record(f"t{i}")
+        for i in range(64, 100):  # rank 1 ran ahead within checkpoint 2
+            g1.record(f"t{i}")
+        idx = SubmissionOrderGuard.compare_payloads(
+            [g0.sync_payload(), g1.sync_payload()])
+        assert idx == 1
+
+    def test_no_common_checkpoint_yet(self):
+        g0, g1 = SubmissionOrderGuard(rank=0), SubmissionOrderGuard(rank=1)
+        g0.record("a")  # below checkpoint_every: nothing to compare
+        assert SubmissionOrderGuard.compare_payloads(
+            [g0.sync_payload(), g1.sync_payload()]) is None
+
+    def test_verify_reshapes_gathered_rows(self):
+        g = SubmissionOrderGuard(rank=0)
+        for i in range(70):
+            g.record(f"t{i}")
+        stacked = np.stack([g.sync_payload(), g.sync_payload()])
+        assert g.verify(stacked.reshape(-1), num_ranks=2) == 1
+
+    def test_record_and_dump(self, tmp_path):
+        g = SubmissionOrderGuard(rank=3, record=True)
+        g.record("alpha", "allreduce", callsite="train.py:10 (main)")
+        g.record("beta", "allgather")
+        path = g.dump(str(tmp_path / "order.{rank}.json"))
+        data = json.loads(open(path).read())
+        assert path.endswith("order.3.json")
+        assert data["count"] == 2
+        assert [e["name"] for e in data["sequence"]] == ["alpha", "beta"]
+        assert data["sequence"][0]["site"] == "train.py:10 (main)"
+
+
+# ==========================================================================
+# Coordinator integration: stall warning, duplicate-name call-sites,
+# ORDER_CHECK wiring, disabled-by-default hot path
+# ==========================================================================
+class _LogRecorder:
+    def __init__(self):
+        self.messages = []
+
+    def warning(self, fmt, *args):
+        self.messages.append(fmt % args if args else fmt)
+
+    error = info = debug = warning
+
+
+def _stub_runtime():
+    return types.SimpleNamespace(
+        topology=types.SimpleNamespace(rank=0, size=1),
+        mode="single", backend=None, timeline=None, autotuner=None)
+
+
+class TestCoordinatorGuards:
+    def test_order_guard_disabled_by_default(self, hvd):
+        import horovod_tpu.basics as basics
+        coord = basics.runtime().coordinator
+        assert coord._order_guard is None
+
+    def test_disabled_hot_path_skips_callsite_capture(self, hvd,
+                                                      monkeypatch):
+        """With ORDER_CHECK off, submit() must not walk the stack (the
+        no-new-work-when-disabled guarantee)."""
+        import horovod_tpu.coordinator as coord_mod
+
+        def bomb():
+            raise AssertionError("callsite captured on disabled hot path")
+
+        monkeypatch.setattr(coord_mod, "format_user_frame", bomb)
+        out = hvd.allreduce(jnp.ones(len(jax.devices())), op=hvd.Sum,
+                            name="lint.hotpath.check")
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_duplicate_name_error_mentions_sites_and_rule(self, hvd,
+                                                          n_devices):
+        import horovod_tpu.basics as basics
+        from horovod_tpu.exceptions import DuplicateNameError
+        coord = basics.runtime().coordinator
+        saved = coord.cycle_time_s
+        coord.cycle_time_s = 1.0  # hold the cycle open
+        try:
+            x = jnp.ones((n_devices, 2))
+            h1 = hvd.allreduce_async(x, op=hvd.Sum, name="lint.dup")
+            with pytest.raises(DuplicateNameError) as err:
+                hvd.allreduce_async(x, op=hvd.Sum, name="lint.dup")
+        finally:
+            coord.cycle_time_s = saved
+        hvd.synchronize(h1)
+        msg = str(err.value)
+        assert "HVD203" in msg
+        assert "duplicate submitted at" in msg
+        assert "test_lint.py" in msg  # the raise-time call-site
+
+    def test_stall_warning_fires_once_per_op(self):
+        from horovod_tpu.coordinator import Coordinator
+        coord = Coordinator(_stub_runtime())
+        log = _LogRecorder()
+        coord._log = log
+        now = time.monotonic()
+        coord._pending_names[(0, "stuck.grad")] = [
+            now - 2 * coord.stall_warn_s, "train.py:42 (main)", False]
+        coord._last_stall_scan = now - coord._stall_scan_period - 1
+        coord._check_stalls(now=now)
+        stall_msgs = [m for m in log.messages if "stuck.grad" in m]
+        assert len(stall_msgs) == 1
+        assert "train.py:42" in stall_msgs[0]
+        assert "hvd-lint" in stall_msgs[0]
+        # marked warned: a second scan stays quiet
+        coord._last_stall_scan = now - coord._stall_scan_period - 1
+        coord._check_stalls(now=now)
+        assert len([m for m in log.messages if "stuck.grad" in m]) == 1
+
+    def test_stall_knob_spellings(self, monkeypatch):
+        from horovod_tpu.coordinator import Coordinator
+        monkeypatch.setenv("HOROVOD_TPU_STALL_CHECK_TIME", "7.5")
+        assert Coordinator(_stub_runtime()).stall_warn_s == 7.5
+        monkeypatch.delenv("HOROVOD_TPU_STALL_CHECK_TIME")
+        monkeypatch.setenv("HVDTPU_STALL_CHECK_TIME_SECONDS", "9")
+        assert Coordinator(_stub_runtime()).stall_warn_s == 9.0
+        monkeypatch.setenv("HVDTPU_STALL_CHECK_DISABLE", "1")
+        assert Coordinator(_stub_runtime()).stall_warn_s == 0.0
+
+    def test_order_check_records_submissions(self, tmp_path):
+        """HOROVOD_TPU_ORDER_CHECK=1 end to end in a fresh process:
+        submissions are recorded in order and dumped on shutdown."""
+        record = str(tmp_path / "order.json")
+        script = (
+            "import horovod_tpu as hvd, jax.numpy as jnp\n"
+            "hvd.init()\n"
+            "import horovod_tpu.basics as basics\n"
+            "coord = basics.runtime().coordinator\n"
+            "assert coord._order_guard is not None\n"
+            "import jax\n"
+            "n = len(jax.devices())\n"
+            "for i in range(3):\n"
+            "    hvd.allreduce(jnp.ones((n, 2)), name=f'g.{i}')\n"
+            "hvd.allreduce(jnp.ones((n, 2)))\n"
+            "assert coord._order_guard.count == 4\n"
+            "hvd.shutdown()\n"
+            "print('ORDER-OK')\n")
+        env = clean_spawn_env(
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                          ""),
+            HOROVOD_TPU_ORDER_CHECK="1",
+            HOROVOD_TPU_ORDER_CHECK_RECORD=record)
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ORDER-OK" in proc.stdout
+        data = json.loads(open(record).read())
+        names = [e["name"] for e in data["sequence"]]
+        assert names[:3] == ["g.0", "g.1", "g.2"]
+        assert names[3].startswith("allreduce.auto.")  # deterministic stem
+        assert all(e["site"] for e in data["sequence"])
+
+
+# ==========================================================================
+# verify= wiring in the compile bridges
+# ==========================================================================
+class TestVerifyFlag:
+    def test_bridges_expose_verify(self):
+        import inspect
+        from horovod_tpu.torch.compile import tpu_compile as torch_compile
+        from horovod_tpu.tensorflow.compile import (tpu_compile as
+                                                    tf_compile)
+        assert "verify" in inspect.signature(torch_compile).parameters
+        assert "verify" in inspect.signature(tf_compile).parameters
+
+    def test_verify_traceable_clean_and_bad(self):
+        assert analysis.verify_traceable(
+            lambda x: x * 2, (jnp.ones(3),), axis_sizes=AXES) == []
+        with pytest.raises(CollectiveLintError):
+            analysis.verify_traceable(
+                lambda x: lax.psum(x, "tp"), (jnp.ones(3),),
+                axis_sizes=AXES)
+
+    def test_torch_bridge_verify_runs_clean(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.torch import tpu_compile
+
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = torch.nn.Linear(4, 3)
+
+            def forward(self, x):
+                return torch.tanh(self.fc(x))
+
+        compiled = tpu_compile(Net().eval(), verify=True)
+        out = compiled(x=torch.ones(2, 4))
+        assert np.asarray(out).shape == (2, 3)
