@@ -388,6 +388,14 @@ def main():
     import horovod_tpu as hvd
     import horovod_tpu.jax as hvd_jax
 
+    # Metrics ride along with every bench run: the archived snapshot
+    # (fusion efficiency, per-collective bytes/latency) is the measured
+    # substrate future perf PRs cite next to the BENCH json. Any prefix
+    # spelling of the knob (HOROVOD_TPU_METRICS=0 included) wins over
+    # this default.
+    from horovod_tpu.utils import envparse
+    if envparse.get_env(envparse.METRICS) is None:
+        os.environ["HVDTPU_METRICS"] = "1"
     hvd.init()
     on_tpu = jax.default_backend() == "tpu"
 
@@ -464,6 +472,27 @@ def main():
     # Headline last (the driver records the final line); metric name kept
     # compatible with round 1 for cross-round comparison.
     emit(_bench_resnet, hvd, hvd_jax, on_tpu)
+    _dump_metrics_snapshot(hvd)
+
+
+def _dump_metrics_snapshot(hvd):
+    """Archive the run's telemetry next to the BENCH json (file, not
+    stdout: the driver records the final stdout line as the headline).
+    Inspect or compare runs with `hvd-metrics dump/diff`. Never allowed
+    to fail the bench."""
+    import os
+    try:
+        from horovod_tpu import telemetry
+        path = os.environ.get("HVDTPU_METRICS_SNAPSHOT",
+                              "BENCH_metrics.json")
+        with open(path, "w") as f:
+            f.write(telemetry.render_json(hvd.metrics_snapshot(),
+                                          indent=1))
+        print(f"# bench: metrics snapshot written to {path}",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        print(f"# bench: metrics snapshot failed: {e}",
+              file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

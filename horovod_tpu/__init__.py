@@ -14,9 +14,10 @@ from .version import __version__  # noqa: F401
 
 from .basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
-    cross_rank, cross_size, mesh, is_homogeneous, mpi_enabled, mpi_built,
-    gloo_enabled, gloo_built, nccl_built, ddl_built, ccl_built, cuda_built,
-    rocm_built, xla_built, mpi_threads_supported,
+    cross_rank, cross_size, mesh, is_homogeneous, metrics_snapshot,
+    mpi_enabled, mpi_built, gloo_enabled, gloo_built, nccl_built,
+    ddl_built, ccl_built, cuda_built, rocm_built, xla_built,
+    mpi_threads_supported,
 )
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
@@ -55,13 +56,13 @@ def __getattr__(name):
     if name == "run":
         from .runner import run
         return run
-    if name == "analysis":
-        # hvd.analysis.check_fn / lint_paths / SubmissionOrderGuard —
-        # lazy so importing the package never loads the analyzer.
+    if name in ("analysis", "telemetry"):
+        # hvd.analysis.check_fn / hvd.telemetry.counter etc. — lazy so
+        # importing the package never loads the subsystem.
         # (importlib, not `from . import`: the latter resolves through
         # this very __getattr__ and recurses.)
         import importlib
-        return importlib.import_module(".analysis", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 

@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from .telemetry import core as telemetry
 from .utils import envparse
 from .utils.logging_util import get_logger
 
@@ -89,6 +90,31 @@ class ParameterManager:
         self._last_bytes = 0
         self._last_time = time.monotonic()
         self.best = None             # set at convergence
+        # Autotune observability (NULL no-ops when metrics off): the
+        # knob gauges track the APPLIED values, decision counters the
+        # sweep's progress; gauges seed from the coordinator's current
+        # config so a scrape before the first candidate shows reality.
+        self._m_fusion = telemetry.gauge(
+            "hvd_autotune_fusion_threshold_bytes",
+            "Fusion threshold currently applied")
+        self._m_cycle = telemetry.gauge(
+            "hvd_autotune_cycle_time_ms",
+            "Coordinator cycle time currently applied")
+        self._m_bucket = telemetry.gauge(
+            "hvd_autotune_min_bucket",
+            "Delegated-plane min bucket currently applied")
+        self._m_switches = telemetry.counter(
+            "hvd_autotune_candidate_switches_total",
+            "Candidate knob applications")
+        self._m_rounds = telemetry.counter(
+            "hvd_autotune_rounds_total", "Completed halving rounds")
+        self._m_converged = telemetry.gauge(
+            "hvd_autotune_converged", "1 once the sweep has converged")
+        coord = runtime.coordinator
+        if coord is not None:
+            self._m_fusion.set(coord.fusion_threshold)
+            self._m_cycle.set(coord.cycle_time_s * 1000.0)
+        self._m_converged.set(0)
 
     # -- called once per coordinator cycle --------------------------------
     def record_cycle(self):
@@ -173,6 +199,7 @@ class ParameterManager:
             return
         self._active = survivors
         self._round += 1
+        self._m_rounds.inc()
         self._budget = self._round_budget(len(survivors))
         self._round_scores = {}
         self._set_position(0)
@@ -180,6 +207,7 @@ class ParameterManager:
     def _converge(self, winner):
         self.best = self._grid[winner]
         self._apply(self.best)
+        self._m_converged.set(1)
         # Last: observers poll `enabled`, so best/knobs must be in place
         # before the flag flips (the worker thread races this method).
         self.enabled = False
@@ -200,6 +228,11 @@ class ParameterManager:
         coord = self.runtime.coordinator
         coord.fusion_threshold = max(fusion, 1)
         coord.cycle_time_s = cycle_ms / 1000.0
+        self._m_switches.inc()
+        self._m_fusion.set(coord.fusion_threshold)
+        self._m_cycle.set(cycle_ms)
+        if bucket is not None:
+            self._m_bucket.set(bucket)
         backend = self.runtime.backend
         if hasattr(backend, "core"):
             # Push the threshold into the native controller (reference:

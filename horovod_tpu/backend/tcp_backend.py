@@ -12,12 +12,15 @@ loop. The Python coordinator detects ``drives_own_cycle`` and switches to
 submit/cycle/complete mode (see coordinator.py).
 """
 
+import time
+
 import numpy as np
 
 from . import Backend
 from .. import native
 from ..exceptions import HorovodInternalError, StalledTensorError
 from ..ops import reduce_ops
+from ..telemetry import core as telemetry
 from ..utils import envparse
 from ..utils.logging_util import get_logger
 
@@ -42,12 +45,17 @@ _OP_TO_RED = {
 class _Pending:
     """Bookkeeping from one TensorEntry to its native handles."""
 
-    __slots__ = ("entry", "handles", "unpack")
+    __slots__ = ("entry", "handles", "unpack", "t0", "nbytes")
 
     def __init__(self, entry, handles, unpack):
         self.entry = entry
         self.handles = handles
         self.unpack = unpack
+        # Telemetry (set by submit_entry only when metrics are on):
+        # submit-time stamp + payload bytes for the per-collective
+        # wall-time/byte series.
+        self.t0 = 0.0
+        self.nbytes = 0
 
 
 class TcpBackend(Backend):
@@ -99,6 +107,19 @@ class TcpBackend(Backend):
         # Set by the coordinator so in-flight tensor names release when the
         # entry completes (duplicate-name semantics live in Python too).
         self.entry_done_cb = None
+        # NULL no-ops when HOROVOD_TPU_METRICS is off (docs/metrics.md).
+        # Native-plane collectives are measured submit -> completion
+        # sweep, so the series includes negotiation time — the honest
+        # per-collective wall time on this plane.
+        self._metrics_on = telemetry.enabled()
+        self._m_time = telemetry.histogram(
+            "hvd_backend_collective_seconds",
+            "Per-collective backend wall time",
+            labelnames=("backend", "kind"))
+        self._m_bytes = telemetry.counter(
+            "hvd_backend_collective_bytes_total",
+            "Payload bytes through backend collectives",
+            labelnames=("backend", "kind"))
 
     # -- process sets -----------------------------------------------------
     def register_process_set(self, ps):
@@ -125,6 +146,9 @@ class TcpBackend(Backend):
         the entry failed synchronously (its handle is completed)."""
         try:
             pending = self._enqueue_entry(entry)
+            if self._metrics_on:
+                pending.t0 = time.perf_counter()
+                pending.nbytes = telemetry.payload_nbytes(entry.arrays)
             self._pending.append(pending)
             return True
         except Exception as exc:  # noqa: BLE001 - surfaced via the handle
@@ -307,6 +331,15 @@ class TcpBackend(Backend):
             else:  # all handles done
                 try:
                     result = p.unpack(self.core, p.handles)
+                    if self._metrics_on and p.t0:
+                        kind = p.entry.kind
+                        self._m_time.labels(
+                            backend=self.name, kind=kind).observe(
+                                time.perf_counter() - p.t0)
+                        if p.nbytes:
+                            self._m_bytes.labels(
+                                backend=self.name,
+                                kind=kind).inc(p.nbytes)
                     if self.entry_done_cb:
                         self.entry_done_cb(p.entry)
                     p.entry.handle._complete(result)

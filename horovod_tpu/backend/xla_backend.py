@@ -21,6 +21,8 @@ cache (reference: horovod/common/response_cache.cc) — a steady-state training
 step re-dispatches a cached executable with zero negotiation.
 """
 
+import functools
+import time
 from collections import OrderedDict
 
 import jax
@@ -31,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import Backend
 from ..ops import reduce_ops
+from ..telemetry import core as telemetry
 from ..utils import envparse
 from ..utils.jax_compat import shard_map as _shard_map
 
@@ -39,6 +42,29 @@ AXIS = "hvd"
 # response-cache capacity (reference: horovod/common/global_state.h:89,
 # HOROVOD_CACHE_CAPACITY read at operations.cc:516).
 DEFAULT_CACHE_CAPACITY = 1024
+
+
+def _timed(kind):
+    """Per-collective telemetry around a backend method: wall time (jax
+    dispatch is async, so this is submit-to-future time — first calls
+    include compilation) and payload bytes by op type. Zero work when
+    metrics are off."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, payload, *args, **kwargs):
+            if not self._metrics_on:
+                return fn(self, payload, *args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(self, payload, *args, **kwargs)
+            self._m_time.labels(backend=self.name, kind=kind).observe(
+                time.perf_counter() - t0)
+            nbytes = telemetry.payload_nbytes(payload)
+            if nbytes:
+                self._m_bytes.labels(backend=self.name,
+                                     kind=kind).inc(nbytes)
+            return out
+        return wrapper
+    return deco
 
 
 def _scale(x, factor):
@@ -91,6 +117,16 @@ class XlaSingleBackend(Backend):
         self._fns = OrderedDict()
         self._cache_capacity = envparse.get_int(
             envparse.CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
+        # NULL no-ops when HOROVOD_TPU_METRICS is off (docs/metrics.md).
+        self._metrics_on = telemetry.enabled()
+        self._m_time = telemetry.histogram(
+            "hvd_backend_collective_seconds",
+            "Per-collective backend wall time",
+            labelnames=("backend", "kind"))
+        self._m_bytes = telemetry.counter(
+            "hvd_backend_collective_bytes_total",
+            "Payload bytes through backend collectives",
+            labelnames=("backend", "kind"))
 
     # -- process sets ------------------------------------------------------
     def register_process_set(self, ps):
@@ -125,6 +161,7 @@ class XlaSingleBackend(Backend):
         return fn
 
     # -- allreduce ---------------------------------------------------------
+    @_timed("allreduce")
     def allreduce(self, arrays, op, process_set, prescale=None,
                   postscale=None):
         """Stacked allreduce: each array has leading axis == set size; output
@@ -186,6 +223,7 @@ class XlaSingleBackend(Backend):
             self, arrays, process_set, prescale, postscale)
 
     # -- allgather ---------------------------------------------------------
+    @_timed("allgather")
     def allgather(self, arrays, process_set):
         """Stacked allgather: (n, s0, ...) → (n, n*s0, ...), every slice the
         concatenation of all ranks' tensors (reference displacement logic:
@@ -211,6 +249,7 @@ class XlaSingleBackend(Backend):
         ins = tuple(self.shard(process_set, jnp.asarray(a)) for a in arrays)
         return list(fn(*ins))
 
+    @_timed("allgather")
     def allgather_uneven(self, per_rank_lists, process_set):
         """Allgather of per-rank tensors with differing dim-0 sizes.
 
@@ -237,6 +276,7 @@ class XlaSingleBackend(Backend):
         return outs
 
     # -- broadcast ---------------------------------------------------------
+    @_timed("broadcast")
     def broadcast(self, arrays, root_rank, process_set):
         """Stacked broadcast: every virtual rank receives slice ``root_rank``
         (reference: BroadcastOp, horovod/common/ops/collective_operations.h:181)."""
@@ -258,6 +298,7 @@ class XlaSingleBackend(Backend):
         return list(fn(*ins))
 
     # -- alltoall ----------------------------------------------------------
+    @_timed("alltoall")
     def alltoall(self, array, splits, process_set):
         """Stacked alltoall (reference: AlltoallOp::PrepareOutputAndParams,
         horovod/common/ops/collective_operations.h:195-273).
@@ -332,6 +373,7 @@ class XlaSingleBackend(Backend):
         return list(outs), recv_splits
 
     # -- reducescatter -----------------------------------------------------
+    @_timed("reducescatter")
     def reducescatter(self, arrays, op, process_set):
         """Stacked reduce-scatter: (n, s0, ...) → list of per-rank chunks of
         the reduction, dim-0 partitioned like the reference (earlier ranks

@@ -81,6 +81,7 @@ class Runtime:
         self.coordinator = None         # attached by coordinator.start
         self.timeline = None            # attached by timeline module on demand
         self.autotuner = None
+        self.metrics_pusher = None      # telemetry.MetricsPusher (SPMD)
         self._shutdown = False
 
     @property
@@ -209,6 +210,20 @@ def init(comm=None, process_sets=None):
                     envparse.TIMELINE_MARK_CYCLES))
             runtime.timeline.start()
 
+        # Metrics plane (docs/metrics.md): when the job has a launcher
+        # rendezvous, push this rank's snapshot to the driver KV store
+        # on a timer so its /metrics route can serve the cluster roll-up.
+        if envparse.get_bool(envparse.METRICS):
+            from .runner import rendezvous as rdv
+            from .telemetry import MetricsPusher
+            cfg = rdv.rendezvous_config()
+            if cfg is not None:
+                addr, port, token = cfg
+                runtime.metrics_pusher = MetricsPusher(
+                    addr, port, token, topology.rank,
+                    interval_s=envparse.get_float(
+                        envparse.METRICS_PUSH_INTERVAL, 5.0)).start()
+
         _runtime = runtime
         return _runtime
 
@@ -224,12 +239,46 @@ def shutdown():
             _runtime.coordinator.stop()
         if _runtime.timeline is not None:
             _runtime.timeline.stop()
+        if _runtime.metrics_pusher is not None:
+            # Final push so shutdown-time counters (elastic restarts)
+            # reach the driver before the store loses this rank.
+            _runtime.metrics_pusher.stop()
+            _runtime.metrics_pusher = None
+        _maybe_dump_metrics()
         if _runtime.backend is not None:
             _runtime.backend.close()
         from . import process_sets as ps_mod
         ps_mod._teardown(_runtime)
         _runtime._shutdown = True
         _runtime = None
+
+
+def _maybe_dump_metrics():
+    """Write a final JSON snapshot to HVDTPU_METRICS_DUMP (if set) —
+    the file `hvd-metrics diff` consumes and bench.py archives."""
+    path = envparse.get_str(envparse.METRICS_DUMP, "")
+    if not path or not envparse.get_bool(envparse.METRICS):
+        return
+    from . import telemetry
+    try:
+        with open(path, "w") as f:
+            f.write(telemetry.render_json(metrics_snapshot(), indent=1))
+    except OSError as exc:
+        get_logger().warning("could not write metrics dump %s: %s",
+                             path, exc)
+
+
+def metrics_snapshot():
+    """JSON-able snapshot of the metrics registry (docs/metrics.md),
+    with rank/size/mode context when the runtime is up. Families are
+    empty unless HOROVOD_TPU_METRICS is on."""
+    from . import telemetry
+    snap = telemetry.snapshot()
+    if _runtime is not None and not _runtime._shutdown:
+        snap["rank"] = _runtime.topology.rank
+        snap["size"] = _runtime.size
+        snap["mode"] = _runtime.mode
+    return snap
 
 
 atexit.register(shutdown)

@@ -26,6 +26,8 @@ import numpy as np
 
 from .exceptions import DuplicateNameError, HorovodInternalError
 from .ops import reduce_ops
+from .telemetry import span as tele_span
+from .telemetry import core as telemetry
 from .utils import envparse
 from .utils.callsite import format_user_frame
 from .utils.logging_util import get_logger
@@ -111,7 +113,7 @@ class Coordinator:
         self.fusion_threshold = envparse.get_int(
             envparse.FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD)
         self._queue = []
-        # (process_set_id, name) -> [enqueue_time, callsite|None, warned]
+        # (process_set_id, name) -> [enqueue_time, callsite|None]
         # for every in-flight named op: duplicate detection + the stall
         # warning scan (reference: tensor_queue + stall_inspector).
         self._pending_names = {}
@@ -136,6 +138,48 @@ class Coordinator:
         self._stall_scan_period = max(1.0, min(self.stall_warn_s / 2.0,
                                                10.0))
         self._last_stall_scan = time.monotonic()
+        self._stall_logged = set()
+        self._stall_last_log = -float("inf")
+        # Metrics plane (telemetry/): with HOROVOD_TPU_METRICS off every
+        # factory returns the shared NULL no-op, so the hot paths below
+        # stay unconditional; arithmetic-only sites additionally gate on
+        # the bool to skip clock reads and byte counting.
+        self._metrics_on = telemetry.enabled()
+        self._m_cycle_s = telemetry.histogram(
+            "hvd_coordinator_cycle_seconds",
+            "Duration of coordinator cycles that moved tensors")
+        self._m_queue_depth = telemetry.gauge(
+            "hvd_coordinator_queue_depth",
+            "Entries drained from the submission queue by the last cycle")
+        self._m_queue_wait_s = telemetry.histogram(
+            "hvd_coordinator_queue_wait_seconds",
+            "Time an entry waited between submit() and dispatch")
+        self._m_dispatch_s = telemetry.histogram(
+            "hvd_coordinator_dispatch_seconds",
+            "Backend dispatch latency per operation (span duration)",
+            labelnames=("kind",))
+        self._m_ops = telemetry.counter(
+            "hvd_coordinator_ops_total",
+            "Operations dispatched to the backend", labelnames=("kind",))
+        self._m_fused_bytes = telemetry.counter(
+            "hvd_coordinator_fused_bytes_total",
+            "Payload bytes through the fusion plane",
+            labelnames=("dtype",))
+        self._m_fusion_payload = telemetry.counter(
+            "hvd_coordinator_fusion_payload_bytes_total",
+            "Fused payload bytes (excluding atomic-unit padding)")
+        self._m_fusion_padding = telemetry.counter(
+            "hvd_coordinator_fusion_padding_bytes_total",
+            "Bytes of atomic-unit padding the fusion plane would add")
+        self._m_fusion_eff = telemetry.gauge(
+            "hvd_coordinator_fusion_efficiency",
+            "payload / (payload + padding) of the last fused buffer")
+        self._m_stalled = telemetry.gauge(
+            "hvd_coordinator_stalled_ops",
+            "In-flight operations older than the stall threshold")
+        self._m_stalled_oldest = telemetry.gauge(
+            "hvd_coordinator_stalled_oldest_age_seconds",
+            "Age of the oldest stalled operation")
         # Opt-in submission-order guard (HOROVOD_TPU_ORDER_CHECK=1).
         # None when disabled: the hot path pays one attribute check and
         # allocates nothing (see analysis/order_guard.py).
@@ -212,8 +256,7 @@ class Coordinator:
             if entry.name and key in self._pending_names:
                 raise self._duplicate_error(entry, key)
             if entry.name:
-                self._pending_names[key] = [entry.enqueue_time, site,
-                                            False]
+                self._pending_names[key] = [entry.enqueue_time, site]
             self._queue.append(entry)
             if (guard is not None and entry.name
                     and not entry.name.startswith("hvdlint.")):
@@ -280,7 +323,11 @@ class Coordinator:
                 backend.submit_entry(e)
             self.cycles += 1
             cycle_ts_us = time.perf_counter_ns() // 1000
+            t0 = time.perf_counter() if self._metrics_on else 0.0
             processed = backend.run_cycle()
+            if self._metrics_on and processed:
+                self._m_cycle_s.observe(time.perf_counter() - t0)
+                self._m_queue_depth.set(len(batch))
             self.tensors_processed += processed
             self.bytes_processed = backend.core.bytes_processed()
             timeline = self.runtime.timeline
@@ -299,11 +346,14 @@ class Coordinator:
                 self._check_stalls()
 
     def _check_stalls(self, now=None):
-        """Warn (once per op) about submissions in flight longer than the
-        stall threshold — the python-plane analog of the reference's
-        stall inspector (horovod/common/stall_inspector.cc). Scans at
-        most every ``_stall_scan_period`` seconds; a cycle with nothing
-        stalled costs one clock read and a compare."""
+        """Scan for submissions in flight longer than the stall threshold
+        — the python-plane analog of the reference's stall inspector
+        (horovod/common/stall_inspector.cc). Feeds the stalled-op gauges
+        and emits ONE summary warning (count + oldest op + age) per
+        change of the stalled set — refreshed every ``stall_warn_s``
+        while the stall persists — instead of a log line per op. Scans
+        at most every ``_stall_scan_period`` seconds; a cycle with
+        nothing stalled costs one clock read and a compare."""
         if now is None:
             now = time.monotonic()
         if now - self._last_stall_scan < self._stall_scan_period:
@@ -312,22 +362,32 @@ class Coordinator:
         stalled = []
         with self._lock:
             for key, info in self._pending_names.items():
-                if not info[2] and now - info[0] > self.stall_warn_s:
-                    info[2] = True
-                    stalled.append((key[1], now - info[0], info[1]))
-        if stalled:
-            desc = ", ".join(
-                f"{name} ({age:.0f}s"
-                + (f", submitted at {site})" if site else ")")
-                for name, age, site in stalled)
-            self._log.warning(
-                "One or more tensors were submitted but have not "
-                "completed for over %.0f s — ranks may have diverged "
-                "(some rank never submitted the matching op). Stalled: "
-                "%s. Run `hvd-lint` on the training script to check for "
-                "rank-dependent collectives (docs/lint.md); tune via "
-                "HOROVOD_TPU_STALL_CHECK_TIME.",
-                self.stall_warn_s, desc)
+                age = now - info[0]
+                if age > self.stall_warn_s:
+                    stalled.append((key[1], age, info[1]))
+        if not stalled:
+            self._m_stalled.set(0)
+            self._m_stalled_oldest.set(0.0)
+            self._stall_logged = set()
+            return
+        stalled.sort(key=lambda item: -item[1])
+        oldest_name, oldest_age, oldest_site = stalled[0]
+        self._m_stalled.set(len(stalled))
+        self._m_stalled_oldest.set(oldest_age)
+        current = {name for name, _, _ in stalled}
+        if (current == self._stall_logged
+                and now - self._stall_last_log < self.stall_warn_s):
+            return
+        self._stall_logged = current
+        self._stall_last_log = now
+        self._log.warning(
+            "%d tensor(s) submitted over %.0f s ago have not completed "
+            "— ranks may have diverged (some rank never submitted the "
+            "matching op). Oldest: %s (%.0f s%s). Run `hvd-lint` on the "
+            "training script to check for rank-dependent collectives "
+            "(docs/lint.md); tune via HOROVOD_TPU_STALL_CHECK_TIME.",
+            len(stalled), self.stall_warn_s, oldest_name, oldest_age,
+            f", submitted at {oldest_site}" if oldest_site else "")
 
     def _order_check_loop(self):
         """SPMD cross-check of the submission-order digests: allgather
@@ -371,6 +431,8 @@ class Coordinator:
             self._queue = []
         if not batch:
             return
+        cycle_t0 = time.perf_counter() if self._metrics_on else 0.0
+        self._m_queue_depth.set(len(batch))
         self.cycles += 1
         if self.runtime.autotuner is not None:
             self.runtime.autotuner.record_cycle()
@@ -394,6 +456,8 @@ class Coordinator:
                     if e.name:
                         self._pending_names.pop(
                             (e.process_set.process_set_id, e.name), None)
+        if self._metrics_on:
+            self._m_cycle_s.observe(time.perf_counter() - cycle_t0)
 
     def _run_fused_allreduces(self, backend, entries, timeline):
         """Bucket by (process set, op, scales, dtype), concat flattened
@@ -434,69 +498,106 @@ class Coordinator:
         """
         e0 = bucket[0]
         names = [e.name for e in bucket]
+        if self._metrics_on:
+            self._record_fusion_stats(bucket)
         try:
-            if timeline:
-                timeline.begin(names, "FUSED_ALLREDUCE")
-            flat = []
-            for e in bucket:
-                flat.extend(e.arrays)
-            results = backend.allreduce(
-                flat, e0.op, e0.process_set,
-                prescale=e0.prescale, postscale=e0.postscale)
-            i = 0
-            for e in bucket:
-                k = len(e.arrays)
-                # Release the name BEFORE completing the handle: a waiter
-                # may legally resubmit the same name the moment wait()
-                # returns (reference: tensor_queue erases the entry when the
-                # response is handed to the op layer).
-                self._release_name(e)
-                e.handle._complete(results[i:i + k] if k > 1
-                                   else results[i])
-                self.tensors_processed += k
-                self.bytes_processed += sum(_nbytes(a) for a in e.arrays)
-                i += k
-            if timeline:
-                timeline.end(names, "FUSED_ALLREDUCE")
+            with tele_span(names, "FUSED_ALLREDUCE", timeline=timeline,
+                           histogram=self._m_dispatch_s.labels(
+                               kind="fused_allreduce")):
+                flat = []
+                for e in bucket:
+                    flat.extend(e.arrays)
+                results = backend.allreduce(
+                    flat, e0.op, e0.process_set,
+                    prescale=e0.prescale, postscale=e0.postscale)
+                i = 0
+                for e in bucket:
+                    k = len(e.arrays)
+                    # Release the name BEFORE completing the handle: a
+                    # waiter may legally resubmit the same name the moment
+                    # wait() returns (reference: tensor_queue erases the
+                    # entry when the response is handed to the op layer).
+                    self._release_name(e)
+                    e.handle._complete(results[i:i + k] if k > 1
+                                       else results[i])
+                    self.tensors_processed += k
+                    self.bytes_processed += sum(_nbytes(a)
+                                                for a in e.arrays)
+                    i += k
         except Exception as exc:  # noqa: BLE001 - propagate to handles
             self._log.error("fused allreduce failed: %s", exc)
             for e in bucket:
                 e.handle._fail(_wrap_error(exc))
 
+    def _record_fusion_stats(self, bucket):
+        """Fusion-plane accounting (metrics on only): queue-wait per
+        entry, payload bytes by dtype, and fusion efficiency =
+        payload / (payload + atomic-unit padding) — on TPU the fused
+        element count rounds up to FUSION_ATOMIC_UNIT for XLA tiling, so
+        the padding share is what a too-small bucket wastes."""
+        now = time.monotonic()
+        payload_elems = 0
+        payload_bytes = 0
+        for e in bucket:
+            self._m_queue_wait_s.observe(now - e.enqueue_time)
+            for a in e.arrays:
+                payload_elems += int(np.prod(a.shape))
+                payload_bytes += _nbytes(a)
+        self._m_ops.labels(kind="allreduce").inc(len(bucket))
+        itemsize = bucket[0].arrays[0].dtype.itemsize
+        padded_elems = (-(-payload_elems // FUSION_ATOMIC_UNIT)
+                        * FUSION_ATOMIC_UNIT)
+        padding_bytes = (padded_elems - payload_elems) * itemsize
+        self._m_fused_bytes.labels(
+            dtype=str(bucket[0].arrays[0].dtype)).inc(payload_bytes)
+        self._m_fusion_payload.inc(payload_bytes)
+        self._m_fusion_padding.inc(padding_bytes)
+        total = payload_bytes + padding_bytes
+        if total:
+            self._m_fusion_eff.set(payload_bytes / total)
+
     def _run_single(self, backend, e, timeline):
+        if self._metrics_on:
+            self._m_queue_wait_s.observe(time.monotonic()
+                                         - e.enqueue_time)
+            self._m_ops.labels(kind=e.kind).inc()
         try:
-            if timeline:
-                timeline.begin([e.name], e.kind.upper())
-            if e.kind == "allgather":
-                if e.uneven:
-                    out = backend.allgather_uneven([e.arrays], e.process_set)[0]
-                else:
-                    out = backend.allgather(e.arrays, e.process_set)
-                    out = out[0] if len(e.arrays) == 1 else out
-            elif e.kind == "broadcast":
-                out = backend.broadcast(e.arrays, e.root_rank, e.process_set)
-                out = out[0] if len(e.arrays) == 1 else out
-            elif e.kind == "alltoall":
-                out = backend.alltoall(e.arrays[0], e.splits, e.process_set)
-            elif e.kind == "reducescatter":
-                out = backend.reducescatter(e.arrays, e.op, e.process_set)
-                out = out[0] if len(e.arrays) == 1 else out
-            elif e.kind == "barrier":
-                backend.barrier(e.process_set)
-                out = None
-            else:
-                raise ValueError(f"Unknown op kind {e.kind}")
-            self.tensors_processed += len(e.arrays)
-            self.bytes_processed += sum(
-                _nbytes(np.asarray(a)) if not hasattr(a, "dtype") else
-                _nbytes(a) for a in e.arrays)
-            self._release_name(e)
-            e.handle._complete(out)
-            if timeline:
-                timeline.end([e.name], e.kind.upper())
+            with tele_span([e.name], e.kind.upper(), timeline=timeline,
+                           histogram=self._m_dispatch_s.labels(
+                               kind=e.kind)):
+                out = self._dispatch_single(backend, e)
+                self._release_name(e)
+                e.handle._complete(out)
         except Exception as exc:  # noqa: BLE001
             self._log.error("%s failed for %s: %s", e.kind, e.name, exc)
             e.handle._fail(_wrap_error(exc))
+
+    def _dispatch_single(self, backend, e):
+        if e.kind == "allgather":
+            if e.uneven:
+                out = backend.allgather_uneven([e.arrays],
+                                               e.process_set)[0]
+            else:
+                out = backend.allgather(e.arrays, e.process_set)
+                out = out[0] if len(e.arrays) == 1 else out
+        elif e.kind == "broadcast":
+            out = backend.broadcast(e.arrays, e.root_rank, e.process_set)
+            out = out[0] if len(e.arrays) == 1 else out
+        elif e.kind == "alltoall":
+            out = backend.alltoall(e.arrays[0], e.splits, e.process_set)
+        elif e.kind == "reducescatter":
+            out = backend.reducescatter(e.arrays, e.op, e.process_set)
+            out = out[0] if len(e.arrays) == 1 else out
+        elif e.kind == "barrier":
+            backend.barrier(e.process_set)
+            out = None
+        else:
+            raise ValueError(f"Unknown op kind {e.kind}")
+        self.tensors_processed += len(e.arrays)
+        self.bytes_processed += sum(
+            _nbytes(np.asarray(a)) if not hasattr(a, "dtype") else
+            _nbytes(a) for a in e.arrays)
+        return out
 
 
 def _wrap_error(exc):

@@ -25,7 +25,28 @@ import time
 from . import basics
 from .exceptions import (RESTART_EXIT_CODE, HorovodInternalError,
                          HostsUpdatedInterrupt)
+from .telemetry import core as telemetry
 from .utils.logging_util import get_logger
+
+
+# Elastic events are rare (one per commit / failure / reset), so the
+# counters resolve through the registry at call time — NULL no-ops when
+# HOROVOD_TPU_METRICS is off (docs/metrics.md).
+def _m_commits():
+    return telemetry.counter("hvd_elastic_commits_total",
+                             "State commits (restore points marked)")
+
+
+def _m_failures():
+    return telemetry.counter(
+        "hvd_elastic_failures_total",
+        "Elastic interruptions by cause", labelnames=("cause",))
+
+
+def _m_restarts():
+    return telemetry.counter(
+        "hvd_elastic_restarts_total",
+        "Successful runtime resets (shutdown + re-init + re-sync)")
 
 
 class State:
@@ -56,6 +77,7 @@ class State:
         (reference: elastic.py:70 — commit marks a restore point; raising
         here, between steps, is what keeps restore consistent)."""
         self.save()
+        _m_commits().inc()
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -280,16 +302,19 @@ def run_fn(func, reset=_reset):
             except HorovodInternalError as e:
                 log.info("elastic: collective failure (%s); restoring "
                          "last commit", e)
+                _m_failures().labels(cause="internal").inc()
                 state.restore()
                 skip_sync = False
                 if _restart_mode():
                     _persist_and_exit(state, log, rereq=True)
             except HostsUpdatedInterrupt as e:
                 log.info("elastic: hosts updated; re-rendezvousing")
+                _m_failures().labels(cause="hosts_updated").inc()
                 skip_sync = e.skip_sync
                 if _restart_mode():
                     _persist_and_exit(state, log, rereq=False)
             _retry_reset(reset, log)
+            _m_restarts().inc()
             state.on_reset()
 
     return wrapper

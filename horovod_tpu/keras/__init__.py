@@ -39,6 +39,7 @@ local_rank = basics.local_rank
 local_size = basics.local_size
 cross_rank = basics.cross_rank
 cross_size = basics.cross_size
+metrics_snapshot = basics.metrics_snapshot
 
 __all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
            "DistributedOptimizer", "broadcast_global_variables",

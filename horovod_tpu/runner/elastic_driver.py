@@ -23,6 +23,7 @@ from .http_server import RendezvousServer, new_job_token
 from .job import _rendezvous_ip
 from ..exceptions import RESTART_EXIT_CODE
 from .rendezvous import ASSIGN_SCOPE, ELASTIC_SCOPE, PEER_SCOPE, VERSION_KEY
+from ..telemetry import core as telemetry
 from ..utils.logging_util import get_logger
 
 RUNNING, SUCCEEDED, FAILED = "running", "succeeded", "failed"
@@ -108,6 +109,16 @@ class ElasticDriver:
         self.log = get_logger()
         self._last_targets = []
         self._discovery_failures = 0
+        # Driver-side elastic counters (NULL no-ops when metrics off).
+        self._m_resets = telemetry.counter(
+            "hvd_elastic_driver_resets_total",
+            "Membership versions published after the initial cohort")
+        self._m_worker_failures = telemetry.counter(
+            "hvd_elastic_driver_worker_failures_total",
+            "Worker processes that exited non-zero")
+        self._m_blacklisted = telemetry.gauge(
+            "hvd_elastic_driver_blacklisted_hosts",
+            "Hosts excluded after repeated worker failures")
 
     DISCOVERY_FAIL_LIMIT = 30  # consecutive failures before aborting
 
@@ -289,10 +300,12 @@ class ElasticDriver:
                     "reset; respawned fresh", wid)
             else:
                 w.state = FAILED
+                self._m_worker_failures.inc()
                 self.fail_counts[w.host] = self.fail_counts.get(w.host,
                                                                 0) + 1
                 if self.fail_counts[w.host] >= self.elastic.host_fail_limit:
                     self.blacklist.add(w.host)
+                    self._m_blacklisted.set(len(self.blacklist))
                     self.log.warning(
                         "elastic driver: blacklisting host %s after %d "
                         "failures", w.host, self.fail_counts[w.host])
@@ -339,6 +352,7 @@ class ElasticDriver:
                     changed = True
                 if changed and not self.completing:
                     self.resets += 1
+                    self._m_resets.inc()
                     if (self.elastic.reset_limit is not None
                             and self.resets > self.elastic.reset_limit):
                         raise RuntimeError(

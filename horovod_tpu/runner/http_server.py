@@ -7,6 +7,11 @@ horovod/runner/http/http_server.py:35-192 — ``KVStoreHandler`` GET/PUT,
 authenticated with a per-job token carried in a header, the analog of the
 reference's HMAC-signed service messages
 (horovod/runner/common/util/secret.py).
+
+The server also exposes the metrics plane: ``GET /metrics`` serves the
+local telemetry registry as Prometheus text plus the cluster roll-up of
+worker-pushed rank snapshots, ``GET /metrics.json`` the raw snapshots —
+both behind the same job token (docs/metrics.md).
 """
 
 import secrets
@@ -41,6 +46,9 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         if not self._authorized():
             return
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) == 1 and parts[0] in ("metrics", "metrics.json"):
+            return self._serve_metrics(parts[0] == "metrics.json")
         scope, key = self._split()
         if scope is None:
             return self._reply(400, b"")
@@ -77,6 +85,36 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
             else:
                 self.server.store.get(scope, {}).pop(key, None)
         self._reply(200, b"")
+
+    def _serve_metrics(self, json_mode):
+        """Token-gated metrics exposition (docs/metrics.md): the local
+        process's registry as Prometheus v0.0.4 text plus, when workers
+        have pushed rank snapshots into the ``metrics`` scope, the
+        cluster roll-up (``*_cluster{stat=...}``). ``/metrics.json``
+        returns ``{"local": ..., "ranks": {rank: snapshot}}``."""
+        import json as _json
+
+        from ..telemetry import (METRICS_SCOPE, PROMETHEUS_CONTENT_TYPE,
+                                 aggregate_snapshots, parse_rank_snapshots,
+                                 render_prometheus, snapshot)
+        local = snapshot()
+        with self.server.store_lock:
+            raw = dict(self.server.store.get(METRICS_SCOPE, {}))
+        snaps = parse_rank_snapshots(raw)
+        if json_mode:
+            body = _json.dumps({"local": local, "ranks": snaps}).encode()
+            ctype = "application/json"
+        else:
+            text = render_prometheus(local)
+            if snaps:
+                text += render_prometheus(aggregate_snapshots(snaps))
+            body = text.encode()
+            ctype = PROMETHEUS_CONTENT_TYPE
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _reply(self, code, body):
         self.send_response(code)

@@ -1,0 +1,31 @@
+"""horovod_tpu.telemetry: the metrics & observability plane.
+
+The quantitative counterpart to the Chrome timeline: counters, gauges
+and log-bucketed histograms with labels (core.py), a span API feeding
+both the histograms and the timeline (spans.py), Prometheus/JSON
+exposition (exposition.py) served from the runner HTTP server's
+token-gated ``/metrics`` route, driver-side cluster roll-ups
+(aggregate.py), and the ``hvd-metrics`` console CLI (cli.py).
+
+Enable with ``HOROVOD_TPU_METRICS=1``; when off, every factory returns
+a shared no-op and instrumented hot paths cost one dead method call.
+Snapshot programmatically via ``hvd.metrics_snapshot()``.
+"""
+
+from .core import (  # noqa: F401
+    NULL, BYTES_BUCKETS, SECONDS_BUCKETS, Counter, Gauge, Histogram,
+    Registry, counter, enabled, gauge, histogram, log_buckets, registry,
+    reset, snapshot,
+)
+from .spans import NULL_SPAN, Span, span  # noqa: F401
+from .exposition import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE, parse_prometheus, render_json,
+    render_prometheus,
+)
+from .aggregate import (  # noqa: F401
+    METRICS_SCOPE, MetricsPusher, parse_rank_snapshots, push_snapshot,
+    quantile_from_buckets, store_snapshots,
+)
+# The roll-up function under a non-module-shadowing name (the submodule
+# stays reachable as telemetry.aggregate).
+from .aggregate import aggregate as aggregate_snapshots  # noqa: F401

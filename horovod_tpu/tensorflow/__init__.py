@@ -46,6 +46,7 @@ ddl_built = basics.ddl_built
 ccl_built = basics.ccl_built
 cuda_built = basics.cuda_built
 rocm_built = basics.rocm_built
+metrics_snapshot = basics.metrics_snapshot
 
 from . import elastic  # noqa: E402,F401  (hvd.elastic.TensorFlowKerasState)
 

@@ -24,7 +24,6 @@ class Timeline:
         self._thread = None
         self._running = False
         self._file = None
-        self._first = True
         self._pids = {}
         # Optional device-side story: a jax.profiler trace alongside the
         # host timeline (the SURVEY-stated TPU equivalent of NVTX ranges,
@@ -59,9 +58,15 @@ class Timeline:
             return
         self._file = open(self.path, "w")
         self._file.write("[\n")
-        self._first = True
+        # Fresh queue per session, and the writer gets its file
+        # explicitly: a start() after a stop() whose join timed out must
+        # not let the OLD writer steal this session's events/sentinel or
+        # race its close against the NEW file (the straggler finishes
+        # draining its own queue into its own file and exits).
+        self._queue = queue.Queue()
         self._running = True
         self._thread = threading.Thread(target=self._writer,
+                                        args=(self._file, self._queue),
                                         name="hvd-tpu-timeline", daemon=True)
         self._thread.start()
         if self._jax_profiler_dir:
@@ -84,33 +89,62 @@ class Timeline:
                 pass
             self._jax_profiling = False
         self._queue.put(None)
+        # The WRITER owns closing the file: if this join times out the
+        # thread is still draining, and closing here would race its
+        # writes (ValueError on a closed file). It closes after the
+        # sentinel whether or not we are still waiting.
         self._thread.join(timeout=5)
-        try:
-            self._file.write("\n]\n")
-            self._file.close()
-        except (OSError, ValueError):
-            pass
 
     # -- writer thread -----------------------------------------------------
-    def _emit(self, event):
-        if not self._first:
-            self._file.write(",\n")
-        self._first = False
-        self._file.write(json.dumps(event))
+    # ``first`` is a writer-local [bool] (is the next event the file's
+    # first?), not instance state: a straggler writer from a previous
+    # session must not corrupt this session's JSON comma placement.
+    def _emit(self, file, event, first):
+        if not first[0]:
+            file.write(",\n")
+        first[0] = False
+        file.write(json.dumps(event))
 
-    def _writer(self):
-        while True:
-            item = self._queue.get()
-            if item is None:
-                break
-            phase, names, activity, ts_us = item
-            for name in names:
-                tid = self._pids.setdefault(name, len(self._pids) + 1)
-                if phase == "I":
-                    self._emit({"name": activity, "ph": "i", "ts": ts_us,
-                                "pid": 0, "tid": tid, "s": "g"})
-                else:
-                    self._emit({"name": activity, "cat": "hvd",
-                                "ph": phase, "ts": ts_us, "pid": 0,
-                                "tid": tid, "args": {"tensor": name}})
-            self._file.flush()
+    def _emit_item(self, file, item, first):
+        phase, names, activity, ts_us = item
+        for name in names:
+            tid = self._pids.setdefault(name, len(self._pids) + 1)
+            if phase == "I":
+                self._emit(file, {"name": activity, "ph": "i",
+                                  "ts": ts_us, "pid": 0, "tid": tid,
+                                  "s": "g"}, first)
+            else:
+                self._emit(file, {"name": activity, "cat": "hvd",
+                                  "ph": phase, "ts": ts_us, "pid": 0,
+                                  "tid": tid, "args": {"tensor": name}},
+                           first)
+
+    def _writer(self, file, q):
+        """Drain-then-flush loop: one blocking get, then everything the
+        producers queued meanwhile, then ONE flush for the whole drain —
+        a busy cycle emitting hundreds of events pays one syscall, not
+        one per event. Ends (and closes the file) at the stop sentinel."""
+        first = [True]
+        try:
+            stop = False
+            while not stop:
+                item = q.get()
+                if item is None:
+                    break
+                self._emit_item(file, item, first)
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        stop = True
+                        break
+                    self._emit_item(file, item, first)
+                file.flush()
+        finally:
+            try:
+                file.write("\n]\n")
+                file.close()
+            except (OSError, ValueError):
+                pass

@@ -44,6 +44,7 @@ ddl_built = basics.ddl_built
 ccl_built = basics.ccl_built
 cuda_built = basics.cuda_built
 rocm_built = basics.rocm_built
+metrics_snapshot = basics.metrics_snapshot
 
 
 def start_timeline(file_path, mark_cycles=None, jax_profiler_dir=None):

@@ -91,6 +91,13 @@ ORDER_CHECK_INTERVAL = "ORDER_CHECK_INTERVAL"  # seconds between cross-checks
 LEGACY_AUTO_NAMES = "LEGACY_AUTO_NAMES"
 AUTOTUNE = "AUTOTUNE"
 AUTOTUNE_LOG = "AUTOTUNE_LOG"
+# Metrics plane (documented as HOROVOD_TPU_METRICS*): enable the
+# telemetry registry + hot-path instrumentation; push per-rank snapshots
+# to the driver KV store every PUSH_INTERVAL seconds; write a final JSON
+# snapshot to DUMP on shutdown (see docs/metrics.md).
+METRICS = "METRICS"
+METRICS_PUSH_INTERVAL = "METRICS_PUSH_INTERVAL"
+METRICS_DUMP = "METRICS_DUMP"
 # Min buffer bytes before allreduce takes the two-level intra-host/
 # cross-host path on multi-host jobs; 0 disables (reference knob analog:
 # HOROVOD_HIERARCHICAL_ALLREDUCE).

@@ -122,7 +122,8 @@ _SHARED_SURFACE = ["start_timeline", "stop_timeline", "ProcessSet",
                    "remove_process_set", "Compression", "init",
                    "shutdown", "rank", "size", "elastic", "mpi_built",
                    "mpi_threads_supported", "gloo_built", "nccl_built",
-                   "ddl_built", "ccl_built", "cuda_built", "rocm_built"]
+                   "ddl_built", "ccl_built", "cuda_built", "rocm_built",
+                   "metrics_snapshot"]
 
 
 @pytest.mark.parametrize("mod_name,required,extra", [

@@ -227,10 +227,13 @@ class TestAstRules:
 
 
 def test_clean_sweep_examples_and_models():
-    """Acceptance: zero findings over examples/ and horovod_tpu/models/."""
+    """Acceptance: zero findings over examples/, horovod_tpu/models/,
+    and the telemetry subsystem."""
     diags = ast_lint.lint_paths([os.path.join(REPO, "examples"),
                                  os.path.join(REPO, "horovod_tpu",
-                                              "models")])
+                                              "models"),
+                                 os.path.join(REPO, "horovod_tpu",
+                                              "telemetry")])
     assert diags == [], "\n".join(d.format() for d in diags)
 
 
@@ -261,6 +264,7 @@ def test_cli_clean_sweep_and_rule_listing():
     CI usage documented in docs/lint.md), and --list-rules works."""
     proc = _run_cli(os.path.join(REPO, "examples"),
                     os.path.join(REPO, "horovod_tpu", "models"),
+                    os.path.join(REPO, "horovod_tpu", "telemetry"),
                     "--fail-on", "warning")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s)" in proc.stdout
@@ -450,24 +454,39 @@ class TestCoordinatorGuards:
         assert "duplicate submitted at" in msg
         assert "test_lint.py" in msg  # the raise-time call-site
 
-    def test_stall_warning_fires_once_per_op(self):
+    def test_stall_warning_is_one_summary_line(self):
+        """N stalled ops produce ONE summary (count + oldest op + age +
+        call-site), not N lines; an unchanged stalled set within the
+        threshold stays quiet on later scans."""
         from horovod_tpu.coordinator import Coordinator
         coord = Coordinator(_stub_runtime())
         log = _LogRecorder()
         coord._log = log
         now = time.monotonic()
         coord._pending_names[(0, "stuck.grad")] = [
-            now - 2 * coord.stall_warn_s, "train.py:42 (main)", False]
+            now - 2 * coord.stall_warn_s, "train.py:42 (main)"]
+        coord._pending_names[(0, "stuck.bias")] = [
+            now - 1.5 * coord.stall_warn_s, None]
         coord._last_stall_scan = now - coord._stall_scan_period - 1
         coord._check_stalls(now=now)
-        stall_msgs = [m for m in log.messages if "stuck.grad" in m]
-        assert len(stall_msgs) == 1
-        assert "train.py:42" in stall_msgs[0]
-        assert "hvd-lint" in stall_msgs[0]
-        # marked warned: a second scan stays quiet
+        assert len(log.messages) == 1
+        msg = log.messages[0]
+        assert "2 tensor(s)" in msg
+        assert "stuck.grad" in msg       # the oldest op is named
+        assert "stuck.bias" not in msg   # the rest are only counted
+        assert "train.py:42" in msg
+        assert "hvd-lint" in msg
+        # same stalled set, within the refresh period: quiet
         coord._last_stall_scan = now - coord._stall_scan_period - 1
         coord._check_stalls(now=now)
-        assert len([m for m in log.messages if "stuck.grad" in m]) == 1
+        assert len(log.messages) == 1
+        # a NEW op crossing the threshold re-triggers the summary
+        coord._pending_names[(0, "stuck.new")] = [
+            now - 3 * coord.stall_warn_s, None]
+        coord._last_stall_scan = now - coord._stall_scan_period - 1
+        coord._check_stalls(now=now)
+        assert len(log.messages) == 2
+        assert "3 tensor(s)" in log.messages[1]
 
     def test_stall_knob_spellings(self, monkeypatch):
         from horovod_tpu.coordinator import Coordinator
