@@ -17,6 +17,7 @@ import time
 import numpy as np
 
 from . import Backend
+from .. import chaos
 from .. import native
 from ..exceptions import HorovodInternalError, StalledTensorError
 from ..ops import reduce_ops
@@ -112,6 +113,9 @@ class TcpBackend(Backend):
         # sweep, so the series includes negotiation time — the honest
         # per-collective wall time on this plane.
         self._metrics_on = telemetry.enabled()
+        # Chaos 'backend_submit' point (HVDTPU_CHAOS); cached bool so
+        # the disabled path costs one compare per submission.
+        self._chaos_on = chaos.enabled()
         self._m_time = telemetry.histogram(
             "hvd_backend_collective_seconds",
             "Per-collective backend wall time",
@@ -145,6 +149,12 @@ class TcpBackend(Backend):
         """Translate a TensorEntry into native enqueues; returns False if
         the entry failed synchronously (its handle is completed)."""
         try:
+            if self._chaos_on:
+                # A matching fail rule raises HorovodInternalError here,
+                # which the except below routes to the entry's handle —
+                # exactly the path a native enqueue failure takes.
+                chaos.inject("backend_submit", name=entry.name,
+                             kind=entry.kind)
             pending = self._enqueue_entry(entry)
             if self._metrics_on:
                 pending.t0 = time.perf_counter()

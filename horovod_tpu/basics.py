@@ -164,6 +164,11 @@ def init(comm=None, process_sets=None):
             from .runner import rendezvous as rdv
             if rdv.rendezvous_config() is not None:
                 rdv.elastic_bootstrap()
+                # Liveness lease: one background beat thread for the
+                # whole process lifetime (re-inits must not stop it — a
+                # worker mid-reset is alive; docs/fault_tolerance.md).
+                from .runner import heartbeat
+                heartbeat.start_worker_heartbeat()
         topology = Topology.from_env()
         spmd = (envparse.get_env(envparse.SIZE) is not None
                 and envparse.get_env(envparse.RANK) is not None)

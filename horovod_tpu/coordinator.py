@@ -24,6 +24,7 @@ import time
 
 import numpy as np
 
+from . import chaos
 from .exceptions import DuplicateNameError, HorovodInternalError
 from .ops import reduce_ops
 from .telemetry import span as tele_span
@@ -145,6 +146,9 @@ class Coordinator:
         # stay unconditional; arithmetic-only sites additionally gate on
         # the bool to skip clock reads and byte counting.
         self._metrics_on = telemetry.enabled()
+        # Chaos 'collective' point (HVDTPU_CHAOS): cached like the
+        # metrics flag so the default submit path pays one bool check.
+        self._chaos_on = chaos.enabled()
         self._m_cycle_s = telemetry.histogram(
             "hvd_coordinator_cycle_seconds",
             "Duration of coordinator cycles that moved tensors")
@@ -242,6 +246,11 @@ class Coordinator:
 
     # -- submission (framework-thread side) --------------------------------
     def submit(self, entry):
+        if self._chaos_on:
+            # Raises HorovodInternalError on a matching fail rule — the
+            # same exception a real collective failure surfaces, so the
+            # elastic restore path is exercised end to end.
+            chaos.inject("collective", name=entry.name, kind=entry.kind)
         key = (entry.process_set.process_set_id, entry.name)
         guard = self._order_guard
         # Call-site capture only in ORDER_CHECK mode: the default hot

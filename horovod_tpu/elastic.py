@@ -20,12 +20,16 @@ simpler and costs one HTTP GET per commit).
 
 import functools
 import os
+import signal
+import threading
 import time
 
 from . import basics
-from .exceptions import (RESTART_EXIT_CODE, HorovodInternalError,
-                         HostsUpdatedInterrupt)
+from .chaos import inject as _chaos_inject
+from .exceptions import (PREEMPT_EXIT_CODE, RESTART_EXIT_CODE,
+                         HorovodInternalError, HostsUpdatedInterrupt)
 from .telemetry import core as telemetry
+from .utils import envparse
 from .utils.logging_util import get_logger
 
 
@@ -56,6 +60,7 @@ class State:
     def __init__(self):
         self._reset_callbacks = []
         self._last_check = 0.0
+        self._commits = 0
         self._check_interval = float(
             os.environ.get("HVDTPU_ELASTIC_CHECK_INTERVAL", "0.2"))
 
@@ -78,11 +83,20 @@ class State:
         here, between steps, is what keeps restore consistent)."""
         self.save()
         _m_commits().inc()
+        self._commits += 1
+        # Chaos 'worker' point: commit boundaries are where preemption /
+        # hang scenarios are injected (after_commits matcher). Fires
+        # AFTER save() so a preempt hand-off persists current progress.
+        _chaos_inject("worker", commits=self._commits)
         self.check_host_updates()
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt when the driver published a newer
         membership version than the one this worker joined at."""
+        if preempt_requested():
+            # SIGTERM arrived since the last commit: hand off now, at a
+            # consistent restore point (the commit just saved).
+            raise HostsUpdatedInterrupt(skip_sync=True)
         now = time.monotonic()
         if now - self._last_check < self._check_interval:
             return
@@ -154,6 +168,75 @@ def _reset():
 
 
 # ---------------------------------------------------------------------------
+# Graceful preemption: SIGTERM → hand off at the next commit boundary
+# ---------------------------------------------------------------------------
+#
+# Cloud preemption (and the driver's own scale-down stop) arrives as
+# SIGTERM. The default disposition is an abrupt death mid-step: in-memory
+# progress since the last persisted commit is lost and the driver counts
+# a failure against the host. With the handler installed, SIGTERM only
+# sets a flag; the next commit boundary raises HostsUpdatedInterrupt at a
+# consistent restore point, the worker persists that commit to the
+# driver's KV store, and exits with PREEMPT_EXIT_CODE — which the driver
+# treats as a membership change, not a failure (docs/fault_tolerance.md).
+
+_PREEMPT = {"installed": False, "requested": False}
+
+
+def preempt_requested():
+    """True once SIGTERM has been received (elastic workers only)."""
+    return _PREEMPT["requested"]
+
+
+def _reset_preempt_state():
+    """Test hook."""
+    _PREEMPT["requested"] = False
+
+
+def _install_preempt_handler(log):
+    """Install the SIGTERM→flag handler. Elastic workers only (gated by
+    the caller), main thread only (signal API constraint), idempotent."""
+    if _PREEMPT["installed"]:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return
+
+    def _on_sigterm(signum, frame):
+        _PREEMPT["requested"] = True
+        log.warning("elastic: SIGTERM received; handing off at the "
+                    "next commit boundary")
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        return
+    _PREEMPT["installed"] = True
+
+
+def _graceful_preempt_exit(state, log):
+    """Persist the last commit (so a replacement slot — or the whole
+    respawned cohort in restart mode — can restore it) and leave with
+    PREEMPT_EXIT_CODE. Persistence is best-effort: a state that cannot
+    pickle, or a store that is already gone, must not turn a graceful
+    exit back into a hang."""
+    import sys
+    _m_failures().labels(cause="preempted").inc()
+    try:
+        _persist_state(state)
+        log.info("elastic: preemption hand-off — last commit persisted")
+    except Exception as e:  # noqa: BLE001 — exit regardless
+        log.warning("elastic: could not persist commit during "
+                    "preemption hand-off: %s", e)
+    try:
+        basics.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(PREEMPT_EXIT_CODE)
+
+
+# ---------------------------------------------------------------------------
 # Exit-restart reset: elastic over the compiled (xla-global) data plane
 # ---------------------------------------------------------------------------
 #
@@ -200,12 +283,16 @@ def _state_payload(state):
     return payload
 
 
-def _persist_and_exit(state, log, rereq):
-    """Persist the last commit to the driver's KV store and leave the
-    process; the driver respawns this slot fresh (see module note)."""
+def _persist_state(state):
+    """Write this worker's restore point to the driver's KV store under
+    its worker id AND the cohort's "any" fallback (last-writer: all
+    survivors persist the same step-aligned restore point, so any write
+    is as good as another for a replacement slot with no history).
+    Returns the resolved ``(addr, port, token, wid)`` so callers with
+    follow-up KV writes reuse one validation."""
     import base64
+    import json
     import pickle
-    import sys
 
     from .runner import http_client
     from .runner import rendezvous as rdv
@@ -213,21 +300,28 @@ def _persist_and_exit(state, log, rereq):
     wid = os.environ.get("HVDTPU_WORKER_ID", "")
     if cfg is None or not wid:
         raise HorovodInternalError(
-            "exit-restart elastic requires the hvdrun launcher's "
+            "persisting elastic state requires the hvdrun launcher's "
             "rendezvous (HVDTPU_RENDEZVOUS_ADDR/PORT)")
     addr, port, token = cfg
-    import json
     payload = base64.b64encode(
         pickle.dumps(_state_payload(state))).decode()
     json_blob = json.dumps({"version": _joined_version(),
                             "payload": payload})
     http_client.put_kv(addr, port, _STATE_SCOPE, wid, json_blob,
                        token=token)
-    # "any": last-writer fallback for replacement workers whose slot has
-    # no history (all survivors persist the same restore point — commits
-    # are step-aligned by the training collectives).
     http_client.put_kv(addr, port, _STATE_SCOPE, "any", json_blob,
                        token=token)
+    return addr, port, token, wid
+
+
+def _persist_and_exit(state, log, rereq):
+    """Persist the last commit to the driver's KV store and leave the
+    process; the driver respawns this slot fresh (see module note)."""
+    import sys
+
+    from .runner import http_client
+    from .runner import rendezvous as rdv
+    addr, port, token, wid = _persist_state(state)
     if rereq:
         # A transport failure with no process death changes no
         # membership; ask the driver to bump the version so the fresh
@@ -291,6 +385,10 @@ def run_fn(func, reset=_reset):
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        if envparse.get_bool(envparse.ELASTIC):
+            # Launcher-spawned elastic worker: convert SIGTERM (cloud
+            # preemption / driver stop) into a commit-boundary hand-off.
+            _install_preempt_handler(log)
         if _restart_mode():
             _maybe_restore_persisted(state, log)
         skip_sync = False
@@ -302,15 +400,21 @@ def run_fn(func, reset=_reset):
             except HorovodInternalError as e:
                 log.info("elastic: collective failure (%s); restoring "
                          "last commit", e)
-                _m_failures().labels(cause="internal").inc()
                 state.restore()
                 skip_sync = False
+                if preempt_requested():
+                    # Counted once, as cause="preempted", inside the
+                    # hand-off — the failure causes are disjoint.
+                    _graceful_preempt_exit(state, log)
+                _m_failures().labels(cause="internal").inc()
                 if _restart_mode():
                     _persist_and_exit(state, log, rereq=True)
             except HostsUpdatedInterrupt as e:
                 log.info("elastic: hosts updated; re-rendezvousing")
-                _m_failures().labels(cause="hosts_updated").inc()
                 skip_sync = e.skip_sync
+                if preempt_requested():
+                    _graceful_preempt_exit(state, log)
+                _m_failures().labels(cause="hosts_updated").inc()
                 if _restart_mode():
                     _persist_and_exit(state, log, rereq=False)
             _retry_reset(reset, log)

@@ -14,6 +14,12 @@ written the same way: a recoverable collective failure raises
 # jax-importing elastic module into the supervisor process.
 RESTART_EXIT_CODE = 79
 
+# Exit code for a graceful preemption hand-off: the worker caught
+# SIGTERM, persisted its last commit at a commit boundary, and left.
+# The elastic driver treats this as a membership change, NOT a failure
+# (no blacklist count) — see docs/fault_tolerance.md.
+PREEMPT_EXIT_CODE = 83
+
 
 class HorovodInternalError(RuntimeError):
     """Internal error raised when a collective routine fails.
@@ -71,6 +77,14 @@ class SubmissionOrderError(RuntimeError):
     deterministic program bug, so the elastic restore/retry loop (which
     catches internal errors as recoverable) must surface it instead of
     retrying into the same divergence forever."""
+
+
+class ChaosInjectedError(RuntimeError):
+    """A chaos ``fail`` injection fired at a point with no more specific
+    error type (``HVDTPU_CHAOS``; docs/fault_tolerance.md). KV points
+    raise transport errors and collective points raise
+    ``HorovodInternalError`` instead, so recovery paths see exactly the
+    exceptions real faults produce."""
 
 
 class CollectiveLintError(ValueError):

@@ -18,21 +18,53 @@ import time
 from types import SimpleNamespace
 
 from . import spawn
+from . import heartbeat as heartbeat_mod
 from .hosts import HostInfo
 from .http_server import RendezvousServer, new_job_token
 from .job import _rendezvous_ip
-from ..exceptions import RESTART_EXIT_CODE
+from ..exceptions import PREEMPT_EXIT_CODE, RESTART_EXIT_CODE
 from .rendezvous import ASSIGN_SCOPE, ELASTIC_SCOPE, PEER_SCOPE, VERSION_KEY
 from ..telemetry import core as telemetry
+from ..utils import envparse
 from ..utils.logging_util import get_logger
 
 RUNNING, SUCCEEDED, FAILED = "running", "succeeded", "failed"
 
 
+def _check_heartbeat_config(timeout_s, worker_env):
+    """True (and a warning logged) when the liveness timeout is below
+    ~2 beat intervals — every healthy worker would read as hung and be
+    killed on repeat, with logs blaming the workers instead of the
+    configuration. The interval is read from the WORKER env when the
+    job overrides it there, else from this process's knobs."""
+    if timeout_s <= 0:
+        return False
+    interval = None
+    for prefix in ("HVDTPU_", "HOROVOD_TPU_", "HOROVOD_"):
+        value = (worker_env or {}).get(prefix + "HEARTBEAT_INTERVAL")
+        if value:
+            try:
+                interval = float(value)
+            except ValueError:
+                pass
+            break
+    if interval is None:
+        interval = heartbeat_mod.heartbeat_interval()
+    if timeout_s < 2 * interval:
+        get_logger().warning(
+            "elastic driver: heartbeat timeout %.1fs is below twice the "
+            "worker beat interval %.1fs — healthy workers WILL be "
+            "failed as hung; raise HVDTPU_HEARTBEAT_TIMEOUT or lower "
+            "HVDTPU_HEARTBEAT_INTERVAL", timeout_s, interval)
+        return True
+    return False
+
+
 class ElasticSettings:
     def __init__(self, settings, discovery_script=None, min_np=1,
                  max_np=None, reset_limit=None, host_fail_limit=3,
-                 discovery_interval=1.0):
+                 discovery_interval=1.0, heartbeat_timeout=None,
+                 sigkill_deadline=None):
         self.base = settings
         self.discovery_script = discovery_script
         self.min_np = min_np
@@ -40,6 +72,17 @@ class ElasticSettings:
         self.reset_limit = reset_limit
         self.host_fail_limit = host_fail_limit
         self.discovery_interval = discovery_interval
+        # Liveness: a worker whose heartbeat lease stops moving for this
+        # long is failed (0 disables; docs/fault_tolerance.md).
+        self.heartbeat_timeout = (
+            heartbeat_mod.heartbeat_timeout() if heartbeat_timeout is None
+            else heartbeat_timeout)
+        # SIGTERM->SIGKILL escalation window for workers being stopped.
+        self.sigkill_deadline = (
+            envparse.get_float(envparse.SIGKILL_DEADLINE, 10.0)
+            if sigkill_deadline is None else sigkill_deadline)
+        _check_heartbeat_config(self.heartbeat_timeout,
+                                getattr(settings, "env", None))
 
 
 class HostDiscovery:
@@ -119,6 +162,11 @@ class ElasticDriver:
         self._m_blacklisted = telemetry.gauge(
             "hvd_elastic_driver_blacklisted_hosts",
             "Hosts excluded after repeated worker failures")
+        self._m_heartbeat_failures = telemetry.counter(
+            "hvd_elastic_driver_heartbeat_failures_total",
+            "Workers failed for missing their heartbeat lease")
+        self._liveness = heartbeat_mod.LivenessTracker(
+            self.elastic.heartbeat_timeout)
 
     DISCOVERY_FAIL_LIMIT = 30  # consecutive failures before aborting
 
@@ -186,6 +234,9 @@ class ElasticDriver:
                       "workers", self.version, size)
 
     def _spawn(self, worker_id, host, slot_index):
+        # Belt and braces for the never-beaten exemption: whatever path
+        # led here, the fresh process must not inherit a stale lease.
+        self._drop_heartbeat(worker_id)
         env = dict(self.elastic.base.env)
         env.update({
             "HVDTPU_ELASTIC": "1",
@@ -215,7 +266,9 @@ class ElasticDriver:
                 if wid in self.rank_order:
                     self.rank_order.remove(wid)
                 w.proc.terminate()
-                self.stopping.append((w, time.monotonic() + 10))
+                self.stopping.append(
+                    (w, time.monotonic() + self.elastic.sigkill_deadline))
+                self._drop_heartbeat(wid)
                 self.log.info("elastic driver: host removed, stopping %s",
                               wid)
                 changed = True
@@ -234,11 +287,76 @@ class ElasticDriver:
         for w, kill_at in self.stopping:
             if w.proc.poll() is not None:
                 w.proc.wait()
+                # The lease may have been re-published between the stop
+                # request and the actual exit (a SIGTERM-trapping worker
+                # keeps beating until its commit-boundary hand-off);
+                # retire it NOW so a respawn of the same slot is judged
+                # by its own beats, not a dead predecessor's frozen one.
+                # UNLESS the slot was already respawned: the lease then
+                # belongs to the live successor — deleting it would
+                # blind hung-worker detection until its next beat.
+                if w.worker_id not in self.workers:
+                    self._drop_heartbeat(w.worker_id)
                 continue
             if now > kill_at:
                 w.proc.kill()
             still.append((w, kill_at))
         self.stopping = still
+
+    def _drop_heartbeat(self, wid):
+        """Forget a worker's liveness state and retire its lease key so
+        a respawn of the same slot starts with a clean record."""
+        self._liveness.forget(wid)
+        self.server.delete(heartbeat_mod.HEARTBEAT_SCOPE, wid)
+
+    def _count_host_failure(self, host):
+        """Failure accounting + blacklist escalation, shared by the
+        exit sweep and the heartbeat detector (one place to keep the
+        policy from drifting)."""
+        self.fail_counts[host] = self.fail_counts.get(host, 0) + 1
+        if self.fail_counts[host] >= self.elastic.host_fail_limit:
+            self.blacklist.add(host)
+            self._m_blacklisted.set(len(self.blacklist))
+            self.log.warning(
+                "elastic driver: blacklisting host %s after %d "
+                "failures", host, self.fail_counts[host])
+
+    def _check_heartbeats(self):
+        """Fail workers whose heartbeat lease stopped moving — the
+        hung-worker detector (`_sweep_exits` only sees exits). A missed
+        lease takes the same exit ramp as a crash: SIGTERM now, SIGKILL
+        after ``sigkill_deadline`` via the stopping reaper, a failure
+        count against the host, and a membership change so survivors
+        re-rendezvous. Workers that never published a beat are exempt
+        (startup is the start timeout's jurisdiction). Returns True when
+        membership changed."""
+        if self.elastic.heartbeat_timeout <= 0 or self.completing:
+            return False
+        changed = False
+        now = time.monotonic()
+        for wid in list(self.workers):
+            value = self.server.get(heartbeat_mod.HEARTBEAT_SCOPE, wid)
+            if value is None:
+                continue
+            if not self._liveness.observe(wid, value, now):
+                continue
+            w = self.workers.pop(wid)
+            if wid in self.rank_order:
+                self.rank_order.remove(wid)
+            w.state = FAILED
+            w.proc.terminate()
+            self.stopping.append(
+                (w, now + self.elastic.sigkill_deadline))
+            self._drop_heartbeat(wid)
+            self._m_heartbeat_failures.inc()
+            self._count_host_failure(w.host)
+            self.log.warning(
+                "elastic driver: worker %s missed its heartbeat lease "
+                "for over %.0fs; treating as hung (SIGTERM, SIGKILL "
+                "after %.0fs)", wid, self.elastic.heartbeat_timeout,
+                self.elastic.sigkill_deadline)
+            changed = True
+        return changed
 
     def _rereq_pending(self):
         """True when a live worker asked for a re-rendezvous at a version
@@ -275,6 +393,7 @@ class ElasticDriver:
                 continue
             w.proc.wait()
             del self.workers[wid]
+            self._drop_heartbeat(wid)
             # Drop the dead worker's rank slot NOW: if the same worker id
             # is respawned it must re-enter at the END of the order — a
             # fresh-state replacement taking rank 0 would make
@@ -286,6 +405,18 @@ class ElasticDriver:
                 self.succeeded.append(wid)
                 self.completing = True
                 self.log.info("elastic driver: worker %s finished", wid)
+            elif rc == PREEMPT_EXIT_CODE:
+                # Graceful preemption hand-off (elastic.py SIGTERM
+                # handler): the worker persisted its commit and left on
+                # purpose. A membership change, not a failure — no
+                # fail count, no blacklist pressure on a host that did
+                # everything right on its way out. Unconditional on
+                # ``completing`` (the re-publish below is gated anyway):
+                # a preemption during wind-down must not read as a crash.
+                self.log.info(
+                    "elastic driver: worker %s left after a graceful "
+                    "preemption hand-off", wid)
+                changed = True
             elif rc == RESTART_EXIT_CODE and not self.completing:
                 # Compiled-plane reset (elastic.py exit-restart): the
                 # worker persisted its commit and asked to be respawned
@@ -301,14 +432,7 @@ class ElasticDriver:
             else:
                 w.state = FAILED
                 self._m_worker_failures.inc()
-                self.fail_counts[w.host] = self.fail_counts.get(w.host,
-                                                                0) + 1
-                if self.fail_counts[w.host] >= self.elastic.host_fail_limit:
-                    self.blacklist.add(w.host)
-                    self._m_blacklisted.set(len(self.blacklist))
-                    self.log.warning(
-                        "elastic driver: blacklisting host %s after %d "
-                        "failures", w.host, self.fail_counts[w.host])
+                self._count_host_failure(w.host)
                 self.log.warning(
                     "elastic driver: worker %s failed (exit %d)", wid, rc)
                 changed = True
@@ -338,6 +462,7 @@ class ElasticDriver:
         try:
             while self.workers:
                 changed = self._sweep_exits()
+                changed |= self._check_heartbeats()
                 self._reap_stopping()
                 now = time.monotonic()
                 targets = None
@@ -377,6 +502,7 @@ class ElasticDriver:
                         while (len(self.workers) < self.elastic.min_np
                                and time.monotonic() < wait_until):
                             self._sweep_exits()
+                            self._check_heartbeats()
                             self._reap_stopping()
                             self._reconcile(self._discover_targets())
                             time.sleep(self.elastic.discovery_interval)

@@ -103,6 +103,18 @@ METRICS_DUMP = "METRICS_DUMP"
 # HOROVOD_HIERARCHICAL_ALLREDUCE).
 HIERARCHICAL_THRESHOLD = "HIERARCHICAL_THRESHOLD"
 ELASTIC = "ELASTIC"
+# Fault injection + control-plane hardening (docs/fault_tolerance.md):
+# chaos spec grammar in chaos/spec.py; KV client retry/backoff knobs;
+# worker heartbeat lease + driver liveness timeout; SIGTERM->SIGKILL
+# escalation deadline for workers that ignore a stop request.
+CHAOS = "CHAOS"
+CHAOS_LOG = "CHAOS_LOG"
+KV_RETRIES = "KV_RETRIES"
+KV_BACKOFF = "KV_BACKOFF"
+KV_DEADLINE = "KV_DEADLINE"
+HEARTBEAT_INTERVAL = "HEARTBEAT_INTERVAL"
+HEARTBEAT_TIMEOUT = "HEARTBEAT_TIMEOUT"
+SIGKILL_DEADLINE = "SIGKILL_DEADLINE"
 
 # Launcher-set topology env (analog of HOROVOD_RANK/SIZE/...; reference:
 # horovod/runner/gloo_run.py:65-77)

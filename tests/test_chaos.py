@@ -1,0 +1,699 @@
+"""Chaos subsystem + control-plane hardening unit tests.
+
+Covers the HVDTPU_CHAOS spec grammar, the injection engine's firing
+semantics (counters, markers, matchers) and its disabled-mode no-op
+guard (the same acceptance contract as telemetry's), the KV client's
+retry/backoff/classification, the wait_for_kv transient-error fix, the
+heartbeat lease + driver liveness detection, the SIGTERM→SIGKILL
+escalation in the driver's stopping reaper, graceful-preemption flag
+plumbing, and the hvd-chaos CLI. Whole-job chaos scenarios live in
+tests/test_chaos_matrix.py (slow lane).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from conftest import clean_spawn_env
+from horovod_tpu import chaos
+from horovod_tpu.chaos.spec import ChaosSpecError, parse_spec
+from horovod_tpu.exceptions import ChaosInjectedError, HorovodInternalError
+from horovod_tpu.runner import http_client
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                               ElasticSettings, _Worker)
+from horovod_tpu.runner.heartbeat import HeartbeatThread, LivenessTracker
+from horovod_tpu.runner.http_server import KVStoreServer
+from horovod_tpu.runner.job import Settings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos():
+    """Each test resolves HVDTPU_CHAOS from ITS env: clear the cached
+    plan (and any firing state) around every test."""
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("HVDTPU_CHAOS", spec)
+    chaos.reset()
+
+
+# ==========================================================================
+# Spec grammar
+# ==========================================================================
+class TestSpecParsing:
+    def test_issue_examples_parse(self):
+        rules = parse_spec(
+            "kv_get:fail:n=3;kv_put:delay:ms=500;worker:hang:rank=1;"
+            "worker:preempt:rank=2:after_commits=3;"
+            "collective:fail:name=grad_*:once")
+        assert [r.point for r in rules] == [
+            "kv_get", "kv_put", "worker", "worker", "collective"]
+        assert rules[0].n == 3
+        assert rules[1].ms == 500
+        assert rules[2].rank == 1
+        assert rules[3].after_commits == 3
+        assert rules[4].name == "grad_*" and rules[4].n == 1  # once
+
+    def test_colon_in_value_rejoined(self):
+        (rule,) = parse_spec("worker:hang:wid=localhost:1")
+        assert rule.wid == "localhost:1"
+
+    def test_empty_spec_and_separators(self):
+        assert parse_spec("") == []
+        assert len(parse_spec(" kv_get:fail ; ; kv_put:fail ")) == 2
+
+    @pytest.mark.parametrize("bad", [
+        "kv_get",                        # no action
+        "nosuchpoint:fail",              # unknown point
+        "kv_get:explode",                # unknown action
+        "kv_get:fail:bogus=1",           # unknown param
+        "kv_get:fail:n=three",           # non-integer
+        "kv_get:fail:p=2.0",             # p out of range
+        "kv_get:fail:err=nuke",          # unknown error kind
+        "kv_get:fail:n=3:once",          # ambiguous budget
+        "kv_get:fail:once:n=3",          # ambiguous budget, either order
+    ])
+    def test_invalid_specs_raise(self, bad):
+        with pytest.raises(ChaosSpecError):
+            parse_spec(bad)
+
+    def test_malformed_env_spec_fails_loud_at_injection(self, monkeypatch):
+        _arm(monkeypatch, "kv_get:explode")
+        with pytest.raises(ChaosSpecError):
+            chaos.inject("kv_get", scope="s", key="k")
+
+
+# ==========================================================================
+# Disabled mode: the no-op guard (acceptance criterion)
+# ==========================================================================
+class TestDisabledGuard:
+    def test_unset_resolves_to_shared_null_plan(self, monkeypatch):
+        monkeypatch.delenv("HVDTPU_CHAOS", raising=False)
+        chaos.reset()
+        assert chaos.plan() is chaos.NULL_PLAN
+        assert not chaos.enabled()
+        # Injection points are no-ops: no exception, no state, and the
+        # resolved plan is the shared singleton (nothing accumulates).
+        chaos.inject("kv_get", scope="s", key="k")
+        chaos.inject("collective", name="grad_w")
+        chaos.inject("worker", commits=99)
+        assert chaos.plan() is chaos.NULL_PLAN
+        assert chaos.plan().rules == ()
+
+    def test_hot_paths_cache_disabled_flag(self, hvd):
+        import horovod_tpu.basics as basics
+        assert not chaos.enabled()
+        coord = basics.runtime().coordinator
+        assert coord._chaos_on is False
+
+
+# ==========================================================================
+# Injection engine: counting, matchers, determinism
+# ==========================================================================
+class TestInjection:
+    def test_fail_counts_down_then_stops(self, monkeypatch):
+        _arm(monkeypatch, "kv_get:fail:n=2")
+        for _ in range(2):
+            with pytest.raises(urllib.error.URLError):
+                chaos.inject("kv_get", scope="s", key="k")
+        chaos.inject("kv_get", scope="s", key="k")  # budget spent
+
+    def test_after_skips_first_matches(self, monkeypatch):
+        _arm(monkeypatch, "kv_get:fail:after=1:n=1")
+        chaos.inject("kv_get", scope="s", key="k")  # skipped
+        with pytest.raises(urllib.error.URLError):
+            chaos.inject("kv_get", scope="s", key="k")
+        chaos.inject("kv_get", scope="s", key="k")  # n spent
+
+    def test_name_glob_matcher(self, monkeypatch):
+        _arm(monkeypatch, "collective:fail:name=grad_*:once")
+        chaos.inject("collective", name="loss")
+        with pytest.raises(HorovodInternalError):
+            chaos.inject("collective", name="grad_w")
+        chaos.inject("collective", name="grad_w")  # once
+
+    def test_scope_matcher(self, monkeypatch):
+        _arm(monkeypatch, "kv_get:fail:scope=elastic:n=1")
+        chaos.inject("kv_get", scope="peers.0", key="0")
+        with pytest.raises(urllib.error.URLError):
+            chaos.inject("kv_get", scope="elastic", key="version")
+
+    def test_rank_matcher_reads_env(self, monkeypatch):
+        monkeypatch.setenv("HVDTPU_RANK", "1")
+        _arm(monkeypatch, "worker:fail:rank=1")
+        with pytest.raises(ChaosInjectedError):
+            chaos.inject("worker", commits=1)
+        monkeypatch.setenv("HVDTPU_RANK", "0")
+        chaos.reset()
+        chaos.inject("worker", commits=1)  # wrong rank: no fire
+
+    def test_after_commits_matcher(self, monkeypatch):
+        _arm(monkeypatch, "worker:fail:after_commits=2")
+        chaos.inject("worker", commits=1)
+        chaos.inject("worker", commits=2)
+        with pytest.raises(ChaosInjectedError):
+            chaos.inject("worker", commits=3)
+
+    def test_delay_sleeps(self, monkeypatch):
+        _arm(monkeypatch, "kv_put:delay:ms=60")
+        t0 = time.monotonic()
+        chaos.inject("kv_put", scope="s", key="k")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_marker_fires_once_per_job(self, monkeypatch, tmp_path):
+        marker = tmp_path / "fired.marker"
+        _arm(monkeypatch, f"kv_put:fail:marker={marker}")
+        with pytest.raises(urllib.error.URLError):
+            chaos.inject("kv_put", scope="s", key="k")
+        assert marker.exists()
+        # A "respawned process" (fresh firing state) sees the marker and
+        # skips — the cross-process fire-once lease.
+        chaos.reset()
+        chaos.inject("kv_put", scope="s", key="k")
+
+    def test_err_kinds_shape_the_exception(self, monkeypatch):
+        _arm(monkeypatch, "kv_get:fail:err=timeout:n=1")
+        with pytest.raises(TimeoutError):
+            chaos.inject("kv_get", scope="s", key="k")
+        _arm(monkeypatch, "kv_get:fail:err=refused:n=1")
+        with pytest.raises(urllib.error.URLError) as ei:
+            chaos.inject("kv_get", scope="s", key="k")
+        assert isinstance(ei.value.reason, ConnectionRefusedError)
+
+    def test_chaos_log_records_firings(self, monkeypatch, tmp_path):
+        log = tmp_path / "chaos.log"
+        monkeypatch.setenv("HVDTPU_CHAOS_LOG", str(log))
+        _arm(monkeypatch, "kv_get:fail:n=2")
+        for _ in range(2):
+            with pytest.raises(urllib.error.URLError):
+                chaos.inject("kv_get", scope="s", key="k")
+        lines = log.read_text().splitlines()
+        assert len(lines) == 2
+        assert all("kv_get fail" in line for line in lines)
+
+    def test_seeded_sampling_is_deterministic(self, monkeypatch):
+        def outcomes():
+            _arm(monkeypatch, "kv_get:fail:p=0.5:seed=7")
+            fired = []
+            for _ in range(16):
+                try:
+                    chaos.inject("kv_get", scope="s", key="k")
+                    fired.append(False)
+                except urllib.error.URLError:
+                    fired.append(True)
+            return fired
+        first, second = outcomes(), outcomes()
+        assert first == second
+        assert any(first) and not all(first)
+
+
+# ==========================================================================
+# KV client: retry/backoff/classification (tentpole part 2)
+# ==========================================================================
+@pytest.fixture
+def kv_server():
+    server = KVStoreServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+class TestKVRetry:
+    def test_recovers_through_transient_failures(self, monkeypatch,
+                                                 kv_server):
+        http_client.put_kv("127.0.0.1", kv_server.port, "s", "k", "v")
+        _arm(monkeypatch, "kv_get:fail:n=3")
+        assert http_client.get_kv("127.0.0.1", kv_server.port,
+                                  "s", "k") == b"v"
+
+    def test_exhaustion_is_a_timeout_error(self):
+        # Nothing listens on this freshly released port: connection
+        # refused, classified retryable, budget exhausts.
+        probe = KVStoreServer()
+        dead_port = probe.start()
+        probe.stop()
+        with pytest.raises(http_client.KVRetryExhaustedError) as ei:
+            http_client.get_kv("127.0.0.1", dead_port, "s", "k",
+                               retries=1, backoff=0.01, deadline=0.5)
+        # The classification contract elastic._retry_reset relies on.
+        assert isinstance(ei.value, TimeoutError)
+        assert "get s/k" in str(ei.value)
+
+    def test_fatal_auth_error_names_scope_key_and_skips_retry(self):
+        server = KVStoreServer(job_token="sekrit")
+        port = server.start()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(http_client.KVFatalError) as ei:
+                http_client.delete_kv("127.0.0.1", port, "scopeX",
+                                      "keyY", token="wrong")
+            assert time.monotonic() - t0 < 1.0  # no backoff ladder
+            msg = str(ei.value)
+            assert "delete scopeX/keyY" in msg and "403" in msg
+            assert ei.value.code == 403
+        finally:
+            server.stop()
+
+    def test_404_returns_none_without_retry(self, kv_server):
+        t0 = time.monotonic()
+        assert http_client.get_kv("127.0.0.1", kv_server.port,
+                                  "s", "absent") is None
+        assert time.monotonic() - t0 < 1.0
+
+    def test_retry_outcomes_feed_telemetry(self, monkeypatch, kv_server):
+        from horovod_tpu import telemetry
+        monkeypatch.setenv("HOROVOD_TPU_METRICS", "1")
+        telemetry.reset()
+        try:
+            http_client.put_kv("127.0.0.1", kv_server.port, "s", "k", "v")
+            _arm(monkeypatch, "kv_get:fail:n=2")
+            assert http_client.get_kv("127.0.0.1", kv_server.port,
+                                      "s", "k") == b"v"
+            fam = telemetry.registry().families()["hvd_kv_retries_total"]
+            counts = {s["labels"]["outcome"]: s["value"]
+                      for s in fam.samples()
+                      if s["labels"]["op"] == "get"}
+            assert counts.get("retried") == 2
+            assert counts.get("recovered") == 1
+            inj = telemetry.registry().families()[
+                "hvd_chaos_injections_total"]
+            assert inj.labels(point="kv_get", action="fail").value == 2
+        finally:
+            monkeypatch.delenv("HOROVOD_TPU_METRICS", raising=False)
+            telemetry.reset()
+
+
+class TestWaitForKV:
+    def test_transient_errors_mid_poll_do_not_abort(self, monkeypatch,
+                                                    kv_server):
+        """Satellite fix: a transport error during the poll must be
+        swallowed until deadline_s — even a whole inner retry budget
+        exhausting (retries=0 makes every injected failure exhaust)."""
+        monkeypatch.setenv("HVDTPU_KV_RETRIES", "0")
+        _arm(monkeypatch, "kv_get:fail:n=6")
+        port = kv_server.port
+
+        def publish():
+            time.sleep(0.25)
+            kv_server.put("s", "late", b"arrived")
+
+        t = threading.Thread(target=publish)
+        t.start()
+        try:
+            value = http_client.wait_for_kv("127.0.0.1", port, "s",
+                                            "late", deadline_s=10,
+                                            poll_s=0.02)
+        finally:
+            t.join()
+        assert value == b"arrived"
+
+    def test_deadline_expiry_reports_last_transport_error(
+            self, monkeypatch, kv_server):
+        monkeypatch.setenv("HVDTPU_KV_RETRIES", "0")
+        _arm(monkeypatch, "kv_get:fail")  # unlimited blackout
+        with pytest.raises(TimeoutError) as ei:
+            http_client.wait_for_kv("127.0.0.1", kv_server.port, "s",
+                                    "never", deadline_s=0.3, poll_s=0.02)
+        assert "last transport error" in str(ei.value)
+
+    def test_kv_wait_fail_injection_is_swallowed(self, monkeypatch,
+                                                 kv_server):
+        """A kv_wait:fail injection is a transient transport error like
+        any other: it must not abort the wait before its deadline."""
+        _arm(monkeypatch, "kv_wait:fail:n=3")
+        kv_server.put("s", "k", b"v")
+        assert http_client.wait_for_kv("127.0.0.1", kv_server.port,
+                                       "s", "k", deadline_s=5,
+                                       poll_s=0.02) == b"v"
+
+    def test_fatal_errors_still_propagate(self, monkeypatch):
+        server = KVStoreServer(job_token="sekrit")
+        port = server.start()
+        try:
+            with pytest.raises(http_client.KVFatalError):
+                http_client.wait_for_kv("127.0.0.1", port, "s", "k",
+                                        token="wrong", deadline_s=5)
+        finally:
+            server.stop()
+
+
+# ==========================================================================
+# Heartbeat lease + liveness tracking (tentpole part 3)
+# ==========================================================================
+class TestHeartbeat:
+    def test_worker_thread_beats_and_values_change(self, kv_server):
+        hb = HeartbeatThread("127.0.0.1", kv_server.port, "", "w0",
+                             interval_s=0.05).start()
+        try:
+            deadline = time.monotonic() + 5
+            while (kv_server.get("heartbeat", "w0") is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            v1 = kv_server.get("heartbeat", "w0")
+            assert v1 is not None
+            deadline = time.monotonic() + 5
+            while (kv_server.get("heartbeat", "w0") == v1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert kv_server.get("heartbeat", "w0") != v1
+        finally:
+            hb.stop()
+
+    def test_beats_survive_injected_failures(self, monkeypatch,
+                                             kv_server):
+        _arm(monkeypatch, "heartbeat:fail:n=2")
+        hb = HeartbeatThread("127.0.0.1", kv_server.port, "", "w1",
+                             interval_s=0.05).start()
+        try:
+            deadline = time.monotonic() + 5
+            while (kv_server.get("heartbeat", "w1") is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            # The first two beats were injected away; the thread kept
+            # going and the lease still landed.
+            assert kv_server.get("heartbeat", "w1") is not None
+        finally:
+            hb.stop()
+
+    def test_liveness_tracker_change_detection(self):
+        t = LivenessTracker(0.1)
+        now = 100.0
+        assert not t.observe("w", b"a", now)          # first sight
+        assert not t.observe("w", b"a", now + 0.05)   # within timeout
+        assert t.observe("w", b"a", now + 0.25)       # expired
+        assert not t.observe("w", b"b", now + 0.3)    # changed: fresh
+        t.forget("w")
+        assert not t.observe("w", b"b", now + 9.0)    # forgotten: fresh
+
+
+class _FakeProc:
+    def __init__(self):
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return None
+
+    def wait(self, *a):
+        return 0
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+
+
+def _fake_spawn(driver):
+    def spawn(worker_id, host, idx):
+        driver.workers[worker_id] = _Worker(worker_id, host, idx,
+                                            _FakeProc())
+    return spawn
+
+
+class TestDriverLiveness:
+    def _driver(self, monkeypatch, **kw):
+        es = ElasticSettings(Settings(num_proc=2), min_np=1, **kw)
+        driver = ElasticDriver(es, ["true"])
+        monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+        driver._reconcile(driver._discover_targets())
+        return driver
+
+    def test_stale_lease_fails_worker_via_stopping_path(self,
+                                                        monkeypatch):
+        driver = self._driver(monkeypatch, heartbeat_timeout=0.15,
+                              sigkill_deadline=0.2)
+        try:
+            driver.server.put("heartbeat", "localhost:0", "7:1")
+            # First observation only starts the clock.
+            assert driver._check_heartbeats() is False
+            assert "localhost:0" in driver.workers
+            time.sleep(0.2)
+            assert driver._check_heartbeats() is True
+            assert "localhost:0" not in driver.workers
+            assert "localhost:1" in driver.workers  # never beat: exempt
+            (w, _), = driver.stopping
+            assert w.worker_id == "localhost:0"
+            assert w.proc.terminated
+            assert driver.fail_counts["localhost"] == 1
+            # Lease key retired so a respawn starts clean.
+            assert driver.server.get("heartbeat", "localhost:0") is None
+            # Slot is re-requested on the next reconcile.
+            driver._reconcile(driver._discover_targets())
+            assert "localhost:0" in driver.workers
+        finally:
+            driver.server.stop()
+
+    def test_changing_lease_is_live(self, monkeypatch):
+        driver = self._driver(monkeypatch, heartbeat_timeout=0.15)
+        try:
+            driver.server.put("heartbeat", "localhost:0", "7:1")
+            driver._check_heartbeats()
+            time.sleep(0.2)
+            driver.server.put("heartbeat", "localhost:0", "7:2")
+            assert driver._check_heartbeats() is False
+            assert "localhost:0" in driver.workers
+        finally:
+            driver.server.stop()
+
+    def test_reaped_stopping_worker_lease_is_retired(self, monkeypatch):
+        """A SIGTERM-trapping worker can re-publish its lease between
+        the stop request and its commit-boundary exit; the reaper must
+        retire the orphan so a respawn of the same slot is judged by
+        its own beats (the never-beaten exemption holds)."""
+        driver = self._driver(monkeypatch, heartbeat_timeout=0.15)
+        try:
+            w = driver.workers.pop("localhost:0")
+            driver.server.put("heartbeat", "localhost:0", "9:42")
+            w.proc.poll = lambda: 83  # exited after the re-publish
+            driver.stopping = [(w, time.monotonic() + 5)]
+            driver._reap_stopping()
+            assert driver.stopping == []
+            assert driver.server.get("heartbeat",
+                                     "localhost:0") is None
+        finally:
+            driver.server.stop()
+
+    def test_reaped_lease_kept_when_slot_already_respawned(self,
+                                                           monkeypatch):
+        """If the slot was respawned before the predecessor was reaped,
+        the lease now belongs to the live successor — reaping must not
+        delete it (that would blind hung-worker detection until the
+        successor's next beat)."""
+        driver = self._driver(monkeypatch, heartbeat_timeout=10)
+        try:
+            old = _Worker("localhost:0", "localhost", 0, _FakeProc())
+            old.proc.poll = lambda: 83
+            # Successor already running under the same wid, beating.
+            assert "localhost:0" in driver.workers
+            driver.server.put("heartbeat", "localhost:0", "new:1")
+            driver.stopping = [(old, time.monotonic() + 5)]
+            driver._reap_stopping()
+            assert driver.stopping == []
+            assert driver.server.get("heartbeat",
+                                     "localhost:0") == b"new:1"
+        finally:
+            driver.server.stop()
+
+    def test_heartbeat_config_sanity_warning(self):
+        from horovod_tpu.runner.elastic_driver import \
+            _check_heartbeat_config
+        # Worker env interval above half the timeout: misconfigured.
+        assert _check_heartbeat_config(
+            30.0, {"HVDTPU_HEARTBEAT_INTERVAL": "60"})
+        # Sane pairing, and disabled timeout, stay quiet.
+        assert not _check_heartbeat_config(
+            30.0, {"HVDTPU_HEARTBEAT_INTERVAL": "2"})
+        assert not _check_heartbeat_config(
+            0, {"HVDTPU_HEARTBEAT_INTERVAL": "60"})
+
+    def test_timeout_zero_disables_liveness(self, monkeypatch):
+        driver = self._driver(monkeypatch, heartbeat_timeout=0)
+        try:
+            driver.server.put("heartbeat", "localhost:0", "7:1")
+            driver._check_heartbeats()
+            time.sleep(0.05)
+            assert driver._check_heartbeats() is False
+            assert len(driver.workers) == 2
+        finally:
+            driver.server.stop()
+
+
+# ==========================================================================
+# SIGTERM→SIGKILL escalation (satellite: _reap_stopping coverage)
+# ==========================================================================
+class _ShimProc:
+    """SlotProcess-shaped wrapper over a raw Popen (process-group
+    signalling like the real thing)."""
+
+    def __init__(self, proc):
+        self.proc = proc
+
+    def poll(self):
+        return self.proc.poll()
+
+    def wait(self, timeout=None):
+        return self.proc.wait(timeout)
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+
+    def kill(self):
+        if self.proc.poll() is None:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+
+
+def test_reap_stopping_escalates_to_sigkill(monkeypatch):
+    """A worker that ignores SIGTERM must be SIGKILLed once its
+    sigkill_deadline passes, and its slot must be re-requested."""
+    code = ("import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(60)\n")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            start_new_session=True)
+    assert proc.stdout.readline().strip() == b"ready"
+    es = ElasticSettings(Settings(num_proc=1), min_np=1,
+                         sigkill_deadline=0.4)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        w = _Worker("localhost:0", "localhost", 0, _ShimProc(proc))
+        w.proc.terminate()
+        driver.stopping = [(w, time.monotonic() + 0.4)]
+        driver._reap_stopping()
+        time.sleep(0.15)
+        assert proc.poll() is None  # SIGTERM ignored, still alive
+        deadline = time.monotonic() + 10
+        while driver.stopping and time.monotonic() < deadline:
+            driver._reap_stopping()
+            time.sleep(0.05)
+        assert driver.stopping == []
+        assert proc.poll() == -signal.SIGKILL
+        # The freed slot is re-requested by the next reconcile.
+        monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+        driver._reconcile([("localhost:0", "localhost", 0)])
+        assert "localhost:0" in driver.workers
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+        driver.server.stop()
+
+
+# ==========================================================================
+# Graceful preemption plumbing (whole-job flow in test_chaos_matrix.py)
+# ==========================================================================
+class TestPreemption:
+    def test_commit_boundary_converts_flag_to_interrupt(self):
+        from horovod_tpu import elastic
+        from horovod_tpu.exceptions import HostsUpdatedInterrupt
+        st = elastic.ObjectState(x=1)
+        st.commit()  # flag unset: plain commit
+        elastic._PREEMPT["requested"] = True
+        try:
+            with pytest.raises(HostsUpdatedInterrupt) as ei:
+                st.commit()
+            assert ei.value.skip_sync
+        finally:
+            elastic._reset_preempt_state()
+
+    def test_handler_not_installed_without_elastic_env(self, monkeypatch):
+        from horovod_tpu import elastic
+        monkeypatch.delenv("HVDTPU_ELASTIC", raising=False)
+        before = signal.getsignal(signal.SIGTERM)
+
+        class S(elastic.State):
+            def save(self):
+                pass
+
+            def restore(self):
+                pass
+
+            def sync(self):
+                pass
+
+        wrapped = elastic.run_fn(lambda state: "ok", reset=lambda: None)
+        assert wrapped(S()) == "ok"
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_preempt_exit_code_is_not_a_failure(self, monkeypatch):
+        """Driver side: PREEMPT_EXIT_CODE changes membership without a
+        fail count (no blacklist pressure on a graceful exit)."""
+        from horovod_tpu.exceptions import PREEMPT_EXIT_CODE
+        es = ElasticSettings(Settings(num_proc=2), min_np=1)
+        driver = ElasticDriver(es, ["true"])
+        try:
+            monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+            driver._reconcile(driver._discover_targets())
+            w = driver.workers["localhost:0"]
+            w.proc.poll = lambda: PREEMPT_EXIT_CODE
+            assert driver._sweep_exits() is True
+            assert "localhost:0" not in driver.workers
+            assert driver.fail_counts == {}
+            assert driver.blacklist == set()
+            # A preemption during wind-down must not read as a crash
+            # either (the rc-83 branch is unconditional on completing).
+            driver.completing = True
+            w1 = driver.workers["localhost:1"]
+            w1.proc.poll = lambda: PREEMPT_EXIT_CODE
+            driver._sweep_exits()
+            assert driver.fail_counts == {}
+        finally:
+            driver.server.stop()
+
+
+# ==========================================================================
+# hvd-chaos CLI (console entry behavior via python -m)
+# ==========================================================================
+def _run_cli(*args, env_extra=None):
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("HVDTPU_CHAOS", None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.chaos.cli", *args],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_validates_good_spec():
+    proc = _run_cli("validate",
+                    "kv_get:fail:n=3;worker:preempt:rank=1:after_commits=2")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 rule(s)" in proc.stdout
+    assert "kv_get:fail" in proc.stdout
+
+
+def test_cli_rejects_bad_spec():
+    proc = _run_cli("validate", "kv_get:explode")
+    assert proc.returncode == 2
+    assert "explode" in proc.stderr
+
+
+def test_cli_validates_env_spec():
+    proc = _run_cli("validate",
+                    env_extra={"HVDTPU_CHAOS": "kv_put:delay:ms=500"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "kv_put:delay" in proc.stdout
+
+
+def test_cli_lists_points():
+    proc = _run_cli("points")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for point in ("kv_get", "collective", "worker", "heartbeat"):
+        assert point in proc.stdout
+    for action in ("fail", "delay", "hang", "preempt", "exit"):
+        assert action in proc.stdout

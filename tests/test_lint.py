@@ -228,12 +228,14 @@ class TestAstRules:
 
 def test_clean_sweep_examples_and_models():
     """Acceptance: zero findings over examples/, horovod_tpu/models/,
-    and the telemetry subsystem."""
+    and the telemetry + chaos subsystems."""
     diags = ast_lint.lint_paths([os.path.join(REPO, "examples"),
                                  os.path.join(REPO, "horovod_tpu",
                                               "models"),
                                  os.path.join(REPO, "horovod_tpu",
-                                              "telemetry")])
+                                              "telemetry"),
+                                 os.path.join(REPO, "horovod_tpu",
+                                              "chaos")])
     assert diags == [], "\n".join(d.format() for d in diags)
 
 
@@ -265,6 +267,7 @@ def test_cli_clean_sweep_and_rule_listing():
     proc = _run_cli(os.path.join(REPO, "examples"),
                     os.path.join(REPO, "horovod_tpu", "models"),
                     os.path.join(REPO, "horovod_tpu", "telemetry"),
+                    os.path.join(REPO, "horovod_tpu", "chaos"),
                     "--fail-on", "warning")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s)" in proc.stdout

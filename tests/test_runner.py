@@ -86,11 +86,13 @@ def test_kvstore_auth():
     server = KVStoreServer(job_token="sekrit")
     port = server.start()
     try:
-        import urllib.error
-        with pytest.raises(urllib.error.HTTPError) as ei:
+        # Auth rejections are fatal (never retried) and name the op,
+        # scope and key — the explicit HTTPError mapping.
+        with pytest.raises(http_client.KVFatalError) as ei:
             http_client.put_kv("127.0.0.1", port, "s", "k", "v",
                                token="wrong")
         assert ei.value.code == 403
+        assert "put s/k" in str(ei.value)
         http_client.put_kv("127.0.0.1", port, "s", "k", "v", token="sekrit")
         assert http_client.get_kv("127.0.0.1", port, "s", "k",
                                   token="sekrit") == b"v"
