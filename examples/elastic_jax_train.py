@@ -1,15 +1,22 @@
 """Elastic training example (reference: examples/elastic/* — same shape:
-commit state each epoch, survive membership changes and preemptions).
+commit state each epoch, survive membership changes and preemptions,
+and persist crash-safe checkpoints every few epochs).
 
 Run:  hvdrun -np 2 --min-np 1 --host-discovery-script ./discover.sh \\
           python examples/elastic_jax_train.py
 """
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 
 import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt
 from horovod_tpu import elastic
+
+CKPT_DIR = os.environ.get("ELASTIC_EXAMPLE_CKPT_DIR", "")
+CKPT_EVERY = 5
 
 
 def main():
@@ -18,6 +25,13 @@ def main():
     rng = np.random.RandomState(0)
     w_true = rng.randn(8, 1).astype(np.float32)
     state = elastic.ObjectState(epoch=0, w=jnp.zeros((8, 1)))
+    if CKPT_DIR:
+        # Resume from the newest INTACT checkpoint (a corrupt/partial
+        # newest step falls back to the previous one automatically).
+        step, saved = ckpt.restore_latest(CKPT_DIR)
+        if step is not None:
+            state.epoch, state.w = saved["epoch"], jnp.asarray(saved["w"])
+            state.save()
 
     @elastic.run
     def train(state):
@@ -34,6 +48,12 @@ def main():
                       f"loss={loss:.5f}", flush=True)
             state.epoch += 1
             state.commit()
+            if CKPT_DIR and state.epoch % CKPT_EVERY == 0:
+                # Unguarded on purpose: save_step() writes on rank 0
+                # only and barriers every rank internally — wrapping it
+                # in `if hvd.rank() == 0:` deadlocks (hvd-lint HVD204).
+                ckpt.save_step(CKPT_DIR, state.epoch,
+                               {"epoch": state.epoch, "w": state.w})
         return state.w
 
     w = train(state)

@@ -22,6 +22,11 @@ a static finding. Three rules:
   under rank-dependent control flow: auto-generated names are assigned
   in call order, so name streams diverge across ranks and the
   negotiation never matches them up.
+- **HVD204** (error) — a ``horovod_tpu.checkpoint`` save/restore call
+  inside a rank guard: those helpers already write on rank 0 only and
+  BARRIER (or broadcast to) every rank internally, so guarding them
+  with ``if hvd.rank() == 0:`` means the other ranks never reach the
+  barrier — the classic non-root-only checkpointing deadlock.
 
 Suppression: append ``# hvd-lint: disable=HVD201`` (comma-separate for
 several rules, or ``disable=all``) to the flagged line or the line
@@ -71,6 +76,12 @@ BROADCAST_STATE_CALLS = frozenset({
 })
 DIST_OPT_CALLS = frozenset({
     "DistributedOptimizer", "DistributedAdasumOptimizer",
+})
+# horovod_tpu.checkpoint helpers that coordinate internally (rank-0
+# write + barrier, or restore + broadcast): calling them under a rank
+# guard deadlocks the unguarded ranks (HVD204).
+CHECKPOINT_CALLS = frozenset({
+    "save", "save_step", "restore", "restore_latest",
 })
 # Presence of any of these identifiers means initial-state sync happens
 # through a channel HVD202 should not second-guess.
@@ -122,6 +133,8 @@ class _Analyzer(ast.NodeVisitor):
         self.diags = []
         self.hvd_aliases = set()    # names bound to horovod_tpu modules
         self.hvd_names = set()      # functions imported from horovod_tpu
+        self.ckpt_aliases = set()   # names bound to horovod_tpu.checkpoint
+        self.ckpt_names = set()     # functions imported from .checkpoint
         self.lax_aliases = {"lax"}  # `jax.lax` / `from jax import lax`
         self.has_init = False
         self.dist_opt_node = None
@@ -137,6 +150,10 @@ class _Analyzer(ast.NodeVisitor):
                 self.hvd_aliases.add(target)
                 if "elastic" in alias.name:
                     self.uses_elastic = True
+                if (alias.name.endswith(".checkpoint")
+                        and alias.asname is not None):
+                    # `import horovod_tpu.checkpoint as ckpt`
+                    self.ckpt_aliases.add(alias.asname)
             if alias.name in ("jax.lax",):
                 self.lax_aliases.add(target)
         self.generic_visit(node)
@@ -146,8 +163,15 @@ class _Analyzer(ast.NodeVisitor):
         if mod.split(".")[0] in ("horovod_tpu", "horovod"):
             if "elastic" in mod:
                 self.uses_elastic = True
+            if mod.endswith(".checkpoint"):
+                # `from horovod_tpu.checkpoint import save_step [as s]`
+                for alias in node.names:
+                    self.ckpt_names.add(alias.asname or alias.name)
             for alias in node.names:
                 name = alias.asname or alias.name
+                if alias.name == "checkpoint":
+                    # `from horovod_tpu import checkpoint [as ckpt]`
+                    self.ckpt_aliases.add(name)
                 if alias.name == "elastic" or name == "elastic":
                     self.uses_elastic = True
                     self.hvd_aliases.add(name)
@@ -192,6 +216,26 @@ class _Analyzer(ast.NodeVisitor):
             return root in self.lax_aliases or root == "jax"
         return self._is_hvd_call(call, RANK_CALLS)
 
+    def _is_checkpoint_call(self, call):
+        term = _terminal_name(call.func)
+        if term not in CHECKPOINT_CALLS:
+            return False
+        if isinstance(call.func, ast.Name):
+            return term in self.ckpt_names
+        root = _root_name(call.func)
+        if root in self.ckpt_aliases:
+            return True
+        # `hvd.checkpoint.save(...)` — a horovod alias with an explicit
+        # `.checkpoint.` hop in the attribute chain.
+        if root in self.hvd_aliases:
+            chain = []
+            node = call.func
+            while isinstance(node, ast.Attribute):
+                chain.append(node.attr)
+                node = node.value
+            return "checkpoint" in chain[1:]
+        return False
+
     def _is_rank_dependent(self, expr):
         return any(isinstance(n, ast.Call) and self._is_rank_call(n)
                    for n in ast.walk(expr))
@@ -204,6 +248,14 @@ class _Analyzer(ast.NodeVisitor):
                 has_name = any(kw.arg == "name" for kw in node.keywords)
                 out.append((node, has_name))
         out.sort(key=lambda item: (item[0].lineno, item[0].col_offset))
+        return out
+
+    def _checkpoint_calls_in(self, stmts):
+        out = [node for node in _scan_statements(stmts)
+               if (isinstance(node, ast.Call)
+                   and self._is_checkpoint_call(node)
+                   and id(node) not in self._flagged)]
+        out.sort(key=lambda n: (n.lineno, n.col_offset))
         return out
 
     # -- rules -------------------------------------------------------------
@@ -233,6 +285,20 @@ class _Analyzer(ast.NodeVisitor):
             hint="pass a stable name= shared by every rank; "
                  + _DOC_HINT))
 
+    def _report_204(self, call, kind):
+        self._flagged.add(id(call))
+        fn = _terminal_name(call.func)
+        self.diags.append(Diagnostic.make(
+            "HVD204",
+            f"checkpoint `{fn}` inside a rank-guarded `{kind}`: the "
+            "checkpoint helpers already write on rank 0 only and "
+            "barrier (or broadcast to) EVERY rank internally, so the "
+            "unguarded ranks never reach the barrier and the job "
+            "deadlocks (the non-root-only checkpointing hazard)",
+            file=self.filename, line=call.lineno,
+            hint="call it unguarded on every rank — rank selection is "
+                 "handled inside horovod_tpu.checkpoint; " + _DOC_HINT))
+
     def visit_If(self, node):
         if self._is_rank_dependent(node.test):
             body_c = self._collectives_in(node.body)
@@ -245,12 +311,22 @@ class _Analyzer(ast.NodeVisitor):
             elif body_c or else_c:
                 for call, _ in (body_c or else_c):
                     self._report_201(call, "if")
+            body_k = self._checkpoint_calls_in(node.body)
+            else_k = self._checkpoint_calls_in(node.orelse)
+            if bool(body_k) != bool(else_k):
+                # Symmetric branches (both checkpoint) still reach the
+                # internal barrier on every rank; only the one-sided
+                # guard strands the other ranks.
+                for call in (body_k or else_k):
+                    self._report_204(call, "if")
         self.generic_visit(node)
 
     def visit_While(self, node):
         if self._is_rank_dependent(node.test):
             for call, _ in self._collectives_in(node.body):
                 self._report_201(call, "while")
+            for call in self._checkpoint_calls_in(node.body):
+                self._report_204(call, "while")
         self.generic_visit(node)
 
     def visit_Call(self, node):

@@ -27,6 +27,8 @@ RULES = {
                         "broadcast_optimizer_state missing after init()"),
     "HVD203": (WARNING, "auto-named collective inside rank-dependent "
                         "control flow"),
+    "HVD204": (ERROR, "checkpoint save/restore call guarded by a rank "
+                      "condition (they barrier/broadcast internally)"),
 }
 
 _SEV_ORDER = {ERROR: 0, WARNING: 1}

@@ -58,6 +58,13 @@ class Backend(ABC):
     def remove_process_set(self, process_set):
         pass
 
+    def abort_inflight(self, exc):
+        """Fail every asynchronously in-flight entry with ``exc`` — the
+        stuck-collective watchdog's coordinated-abort hook
+        (coordinator._abort_inflight). Synchronous backends hold no
+        async state, so the default is a no-op; the native planes
+        (tcp/xla-global) fail their pending negotiations."""
+
     def close(self):
         pass
 

@@ -99,6 +99,10 @@ class TcpBackend(Backend):
             if len(host_ids) > 1:
                 self.core.set_topology(host_of, hier)
         self._pending = []
+        # Chaos 'backend_submit:stall' victims: never enqueued with the
+        # native core, but kept reachable so an abort / transport death
+        # / close still resolves their waiters instead of hanging them.
+        self._chaos_swallowed = []
         self._transport_dead = False
         # handle -> submitted np array (delegated execution needs the
         # local contribution by handle; only kept in delegated mode).
@@ -153,8 +157,22 @@ class TcpBackend(Backend):
                 # A matching fail rule raises HorovodInternalError here,
                 # which the except below routes to the entry's handle —
                 # exactly the path a native enqueue failure takes.
-                chaos.inject("backend_submit", name=entry.name,
-                             kind=entry.kind)
+                try:
+                    chaos.inject("backend_submit", name=entry.name,
+                                 kind=entry.kind)
+                except chaos.ChaosSignal as sig:
+                    if sig.action == "stall":
+                        # Swallow the submission below the coordinator:
+                        # the op stays in this rank's in-flight view but
+                        # never reaches negotiation — a data-plane hang
+                        # for the watchdog to time out (the watchdog's
+                        # abort reaches the entry via abort_inflight).
+                        self._log.warning(
+                            "chaos: backend submission %r swallowed "
+                            "(stall injection)", entry.name)
+                        self._chaos_swallowed.append(entry)
+                        return True
+                    raise
             pending = self._enqueue_entry(entry)
             if self._metrics_on:
                 pending.t0 = time.perf_counter()
@@ -311,57 +329,92 @@ class TcpBackend(Backend):
         """Hook for delegated-execution subclasses (xla_global.py)."""
 
     def _sweep_completions(self):
+        """Sweep pending entries for completion. Each entry is processed
+        in isolation: a poisoned entry (bad unpack, a native-layer error
+        while polling/releasing) fails only its OWN handles — the sweep
+        continues and every other in-flight entry still completes,
+        instead of one exception wedging the whole cycle loop forever."""
         done = 0
         still = []
         for p in self._pending:
-            states = [self.core.poll(h) for h in p.handles]
-            if any(s == 0 for s in states):
-                # Never release in-flight handles: a multi-handle entry with
-                # one early error waits until every handle is terminal so
-                # the native negotiation stays consistent.
+            try:
+                finished = self._sweep_one(p)
+            except Exception as exc:  # noqa: BLE001 — isolate the entry
+                self._log.error("completion sweep failed for %r: %s",
+                                p.entry.name, exc)
+                self._discard_pending(p, HorovodInternalError(
+                    f"completion processing failed for {p.entry.name!r}: "
+                    f"{exc}"))
+                done += 1
+                continue
+            if finished:
+                done += 1
+            else:
                 still.append(p)
-            elif any(s == 2 for s in states):
-                errs = [self.core.error(h) for h, s in zip(p.handles, states)
-                        if s == 2]
-                for h in p.handles:
-                    self.core.release(h)
-                    self._handle_arrays.pop(h, None)
-                if self.entry_done_cb:
-                    self.entry_done_cb(p.entry)
-                msg = "; ".join(errs)
-                # "STALLED:" is the native layer's stable marker; a mixed
-                # multi-handle failure (stall + transport) classifies as
-                # internal so elastic recovery still catches it.
-                exc = (StalledTensorError(msg)
-                       if errs and all(e.startswith("STALLED:")
-                                       for e in errs)
-                       else HorovodInternalError(msg))
-                p.entry.handle._fail(exc)
-                done += 1
-            else:  # all handles done
-                try:
-                    result = p.unpack(self.core, p.handles)
-                    if self._metrics_on and p.t0:
-                        kind = p.entry.kind
-                        self._m_time.labels(
-                            backend=self.name, kind=kind).observe(
-                                time.perf_counter() - p.t0)
-                        if p.nbytes:
-                            self._m_bytes.labels(
-                                backend=self.name,
-                                kind=kind).inc(p.nbytes)
-                    if self.entry_done_cb:
-                        self.entry_done_cb(p.entry)
-                    p.entry.handle._complete(result)
-                except Exception as exc:  # noqa: BLE001
-                    p.entry.handle._fail(HorovodInternalError(str(exc)))
-                finally:
-                    for h in p.handles:
-                        self.core.release(h)
-                        self._handle_arrays.pop(h, None)
-                done += 1
         self._pending = still
         return done
+
+    def _sweep_one(self, p):
+        """Advance one pending entry; True when it reached a terminal
+        state (completed or failed) and left the pending set."""
+        states = [self.core.poll(h) for h in p.handles]
+        if any(s == 0 for s in states):
+            # Never release in-flight handles: a multi-handle entry with
+            # one early error waits until every handle is terminal so
+            # the native negotiation stays consistent.
+            return False
+        if any(s == 2 for s in states):
+            errs = [self.core.error(h) for h, s in zip(p.handles, states)
+                    if s == 2]
+            for h in p.handles:
+                self.core.release(h)
+                self._handle_arrays.pop(h, None)
+            if self.entry_done_cb:
+                self.entry_done_cb(p.entry)
+            msg = "; ".join(errs)
+            # "STALLED:" is the native layer's stable marker; a mixed
+            # multi-handle failure (stall + transport) classifies as
+            # internal so elastic recovery still catches it.
+            exc = (StalledTensorError(msg)
+                   if errs and all(e.startswith("STALLED:")
+                                   for e in errs)
+                   else HorovodInternalError(msg))
+            p.entry.handle._fail(exc)
+            return True
+        # All handles done.
+        try:
+            result = p.unpack(self.core, p.handles)
+            if self._metrics_on and p.t0:
+                kind = p.entry.kind
+                self._m_time.labels(
+                    backend=self.name, kind=kind).observe(
+                        time.perf_counter() - p.t0)
+                if p.nbytes:
+                    self._m_bytes.labels(
+                        backend=self.name, kind=kind).inc(p.nbytes)
+            if self.entry_done_cb:
+                self.entry_done_cb(p.entry)
+            p.entry.handle._complete(result)
+        except Exception as exc:  # noqa: BLE001
+            p.entry.handle._fail(HorovodInternalError(str(exc)))
+        finally:
+            for h in p.handles:
+                self.core.release(h)
+                self._handle_arrays.pop(h, None)
+        return True
+
+    def _discard_pending(self, p, exc):
+        """Terminal cleanup for a poisoned entry: best-effort release of
+        its native handles, then fail its framework handle."""
+        for h in p.handles:
+            try:
+                self.core.release(h)
+            except Exception:  # noqa: BLE001 — already failing
+                pass
+            self._handle_arrays.pop(h, None)
+        if self.entry_done_cb:
+            self.entry_done_cb(p.entry)
+        p.entry.handle._fail(exc)
 
     def _fail_all(self, exc):
         for p in self._pending:
@@ -369,9 +422,27 @@ class TcpBackend(Backend):
                 self.entry_done_cb(p.entry)
             p.entry.handle._fail(exc)
         self._pending = []
+        for e in self._chaos_swallowed:
+            if self.entry_done_cb:
+                self.entry_done_cb(e)
+            e.handle._fail(exc)
+        self._chaos_swallowed = []
         # Every in-flight submission is dead; drop the recorded arrays so
         # a backend surviving into elastic recovery does not retain them.
         self._handle_arrays.clear()
+
+    def abort_inflight(self, exc):
+        """Watchdog coordinated abort: fail every pending negotiation
+        with the diagnostic-bearing exception. Native handles are
+        released so a subsequent consensus shutdown does not wait on
+        entries whose waiters have already been failed."""
+        for p in self._pending:
+            for h in p.handles:
+                try:
+                    self.core.release(h)
+                except Exception:  # noqa: BLE001 — aborting anyway
+                    pass
+        self._fail_all(exc)
 
     def pending_count(self):
         return len(self._pending)

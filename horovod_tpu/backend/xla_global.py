@@ -242,16 +242,29 @@ class XlaGlobalBackend(TcpBackend):
             token = self.core.next_delegated()
             if token == 0:
                 break
-            d = self.core.delegated(token)
+            # The whole per-token block is isolated: an exception from
+            # unmarshalling (`delegated`) or completion would otherwise
+            # propagate through run_cycle and kill the coordinator's
+            # cycle thread — wedging every future submission — instead
+            # of poisoning only this response's handles.
+            d = None
             try:
+                d = self.core.delegated(token)
                 self._execute_delegated(d)
             except Exception as exc:  # noqa: BLE001 — fail the handles
                 msg = f"XLA data-plane execution failed: {exc}"
-                for h in d["handles"]:
+                get_logger().error("%s", msg)
+                for h in (d["handles"] if d else ()):
                     if h >= 0:
-                        self.core.delegated_complete(h, error=msg)
+                        try:
+                            self.core.delegated_complete(h, error=msg)
+                        except Exception:  # noqa: BLE001
+                            pass
             finally:
-                self.core.delegated_finish(token)
+                try:
+                    self.core.delegated_finish(token)
+                except Exception:  # noqa: BLE001 — keep draining
+                    pass
 
     # -- delegated execution ----------------------------------------------
     def _execute_delegated(self, d):

@@ -40,8 +40,22 @@ from ..telemetry import core as telemetry
 from ..utils import envparse
 from ..utils.logging_util import get_logger
 from .spec import (  # noqa: F401  (re-exported API)
-    ACTIONS, POINTS, ChaosSpecError, Rule, parse_spec,
+    ACTIONS, POINTS, SIGNAL_ACTION_POINTS, ChaosSpecError, Rule,
+    parse_spec,
 )
+
+
+class ChaosSignal(Exception):
+    """A fired *signal* action (``mismatch``/``stall``/``corrupt``):
+    the effect is applied by the injection site itself, so ``inject``
+    raises this for the site to catch — never an error to surface.
+    The spec parser rejects signal actions at points whose sites do
+    not catch it (spec.SIGNAL_ACTION_POINTS)."""
+
+    def __init__(self, action, rule):
+        super().__init__(f"chaos signal {action} ({rule.source})")
+        self.action = action
+        self.rule = rule
 
 
 class _NullPlan:
@@ -193,6 +207,8 @@ def _execute(rule, point):
         os.kill(os.getpid(), signal.SIGTERM)
     elif action == "exit":
         os._exit(rule.code if rule.code is not None else 17)
+    elif action in SIGNAL_ACTION_POINTS:
+        raise ChaosSignal(action, rule)
 
 
 _PLAN = None  # tri-state: None = not yet resolved

@@ -41,6 +41,8 @@ POINTS = {
     "worker": "elastic.py State.commit() — commit boundaries "
               "(matchers: rank, wid, after_commits)",
     "heartbeat": "runner/heartbeat.py — each worker heartbeat beat",
+    "checkpoint": "checkpoint.py save() — after the checkpoint file "
+                  "lands (matchers: name = final file basename)",
 }
 
 # action -> what firing does.
@@ -54,6 +56,24 @@ ACTIONS = {
             "threads, heartbeats included)",
     "preempt": "SIGTERM self — a simulated cloud preemption notice",
     "exit": "os._exit(code=N, default 17) — an abrupt crash",
+    "mismatch": "corrupt the consistency digest this rank publishes for "
+                "the matched collective (guardian.py detects and names "
+                "this rank); needs HVDTPU_CONSISTENCY_CHECK",
+    "stall": "swallow the matched submission — this rank never submits "
+             "the op, peers stall on it (stuck-collective watchdog "
+             "territory)",
+    "corrupt": "flip bytes inside the just-written checkpoint payload "
+               "so its checksum fails on restore",
+}
+
+# Signal actions are consumed by the injection site itself (the site
+# catches chaos.ChaosSignal and applies the effect in its own terms),
+# so they are only legal at points whose call sites understand them —
+# anywhere else the signal would escape as a crash.
+SIGNAL_ACTION_POINTS = {
+    "mismatch": ("collective",),
+    "stall": ("collective", "backend_submit"),
+    "corrupt": ("checkpoint",),
 }
 
 _FLAGS = {"once"}
@@ -164,6 +184,12 @@ def _parse_rule(text):
         raise ChaosSpecError(
             f"chaos rule {text!r}: err must be one of "
             f"{', '.join(_ERR_KINDS)}")
+    allowed = SIGNAL_ACTION_POINTS.get(action)
+    if allowed is not None and point not in allowed:
+        raise ChaosSpecError(
+            f"chaos rule {text!r}: action {action!r} is only valid at "
+            f"point(s) {', '.join(allowed)} (its effect is applied by "
+            f"those call sites)")
     return Rule(point, action, params, text)
 
 

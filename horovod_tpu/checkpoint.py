@@ -1,28 +1,71 @@
-"""Disk checkpointing helpers (TPU value-add).
+"""Crash-safe disk checkpointing (TPU value-add).
 
 The reference has no checkpoint engine of its own — elastic State objects
 are in-memory and disk persistence is left to user code / Keras callbacks
-(SURVEY §5.4). On TPU the idiomatic store is orbax; these helpers add the
-distributed etiquette around it: rank-0-only writes, a barrier so no rank
-races ahead of an in-flight save, and restore-then-broadcast so every
-rank starts from identical bytes.
+(SURVEY §5.4). These helpers add the distributed etiquette (rank-0-only
+writes, a barrier so no rank races ahead of an in-flight save,
+restore-then-broadcast so every rank starts from identical bytes) AND the
+durability etiquette a preemptible fleet needs:
+
+- **Atomic writes**: every save lands as tmp-file → flush → fsync →
+  rename (+ directory fsync), so a crash mid-save leaves either the old
+  checkpoint or the new one — never a half-written file at the final
+  name.
+- **Integrity footer**: each file carries a SHA-256 checksum of its
+  payload plus framing magic; ``restore`` verifies before unpickling and
+  raises ``CheckpointCorruptError`` on damage instead of handing back
+  garbage.
+- **Fallback restore**: ``restore_latest`` walks steps newest-first and
+  restores the newest *intact* one, warning about (and counting,
+  ``hvd_checkpoint_corrupt_total``) every corrupt file it skips.
+- **Retention**: ``HVDTPU_CHECKPOINT_KEEP=N`` prunes all but the newest
+  N steps after each ``save_step``.
+
+Legacy orbax checkpoints (directories) remain restorable; new saves use
+the single-file format. ``checkpoint`` is a chaos injection point
+(``checkpoint:corrupt`` flips payload bytes after the file lands) so the
+fallback path is rehearsable on demand (docs/fault_tolerance.md).
 
     import horovod_tpu as hvd
     from horovod_tpu import checkpoint as ckpt
 
     ckpt.save(path, {"params": params, "opt": opt_state, "epoch": 3})
     state = ckpt.restore(path)               # broadcast from rank 0
-    state = ckpt.restore_latest(directory)   # newest step under directory
+    state = ckpt.restore_latest(directory)   # newest INTACT step
 """
 
+import hashlib
 import os
+import pickle
+import struct
 
 from . import basics
+from . import chaos
+from .exceptions import CheckpointCorruptError
 from .functions import broadcast_object
 from .ops.collectives import barrier
+from .telemetry import core as telemetry
+from .utils import envparse
+from .utils.logging_util import get_logger
+
+MAGIC = b"HVDTPUCKPT1\n"
+_FOOTER = struct.Struct("<32sQ")  # sha256(payload), payload length
+_MIN_SIZE = len(MAGIC) * 2 + _FOOTER.size
+
+
+def _m_corrupt():
+    # Resolved at call time (corruption is a rare event): NULL no-op
+    # when HOROVOD_TPU_METRICS is off.
+    return telemetry.counter(
+        "hvd_checkpoint_corrupt_total",
+        "Checkpoint files that failed their integrity check")
 
 
 def _spmd():
+    if not basics.is_initialized():
+        # Checkpoint helpers stay usable before init() (inspection
+        # tools, tests): no runtime means no peers to coordinate with.
+        return False
     rt = basics.runtime()
     return rt.mode == basics.MODE_SPMD and rt.topology.size > 1
 
@@ -31,39 +74,224 @@ def _rank():
     return basics.runtime().topology.rank
 
 
-def save(path, state):
-    """Write ``state`` (a pytree) at ``path``; rank 0 writes, everyone
-    waits at a barrier so no rank resumes training against a half-written
-    checkpoint."""
-    import orbax.checkpoint as ocp
+def _to_host(state):
+    """Device arrays → host numpy so the pickled payload is stable and
+    device-independent (restore hands back numpy leaves)."""
+    import jax
+    import numpy as np
 
+    def conv(x):
+        return np.asarray(x) if isinstance(x, jax.Array) else x
+
+    return jax.tree_util.tree_map(conv, state)
+
+
+def _write_file(path, state):
+    """Atomic single-file write: MAGIC | payload | sha256 | len | MAGIC,
+    via tmp + fsync + rename so a crash never leaves a torn file at the
+    final name."""
+    payload = pickle.dumps(_to_host(state),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    footer = _FOOTER.pack(hashlib.sha256(payload).digest(), len(payload))
+    directory = os.path.dirname(path) or "."
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(MAGIC)
+            f.write(payload)
+            f.write(footer)
+            f.write(MAGIC)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        # Persist the rename itself (directory entry durability).
+        dir_fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:
+        pass  # e.g. directories that reject O_RDONLY fsync (some FSes)
+    try:
+        chaos.inject("checkpoint", name=os.path.basename(path))
+    except chaos.ChaosSignal as sig:
+        if sig.action == "corrupt":
+            _chaos_corrupt(path, len(payload))
+
+
+def _chaos_corrupt(path, payload_len):
+    """Chaos ``checkpoint:corrupt``: flip bytes in the middle of the
+    just-written payload (length preserved) so the checksum fails."""
+    with open(path, "r+b") as f:
+        f.seek(len(MAGIC) + max(0, payload_len // 2 - 8))
+        chunk = f.read(16)
+        f.seek(-len(chunk), os.SEEK_CUR)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    get_logger().warning("chaos: corrupted checkpoint payload in %s",
+                         path)
+
+
+def verify_checkpoint(path):
+    """Integrity check without unpickling. Returns ``(ok, reason)``;
+    legacy orbax directories report ok (orbax owns their layout)."""
+    if os.path.isdir(path):
+        return True, "legacy orbax directory"
+    try:
+        with open(path, "rb") as f:
+            # One fd for stat + reads: immune to a concurrent atomic
+            # save replacing the path mid-check.
+            size = os.fstat(f.fileno()).st_size
+            if size < _MIN_SIZE:
+                return False, f"truncated ({size} bytes)"
+            head = f.read(len(MAGIC))
+            if head != MAGIC:
+                return False, "bad header magic (foreign or torn file)"
+            f.seek(size - len(MAGIC))
+            if f.read(len(MAGIC)) != MAGIC:
+                return False, "bad trailer magic (truncated write)"
+            f.seek(size - len(MAGIC) - _FOOTER.size)
+            digest, payload_len = _FOOTER.unpack(f.read(_FOOTER.size))
+            if len(MAGIC) + payload_len + _FOOTER.size + len(MAGIC) \
+                    != size:
+                return False, (f"length mismatch (footer says "
+                               f"{payload_len} payload bytes)")
+            f.seek(len(MAGIC))
+            h = hashlib.sha256()
+            left = payload_len
+            while left > 0:
+                chunk = f.read(min(left, 1 << 20))
+                if not chunk:
+                    return False, "payload shorter than footer claims"
+                h.update(chunk)
+                left -= len(chunk)
+            if h.digest() != digest:
+                return False, "checksum mismatch (payload corrupted)"
+    except OSError as exc:
+        return False, f"unreadable: {exc}"
+    return True, ""
+
+
+def _read_file(path):
+    ok, reason = verify_checkpoint(path)
+    if not ok:
+        _m_corrupt().inc()
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed its integrity check: {reason}")
+    with open(path, "rb") as f:
+        # fstat on the OPEN fd: a concurrent atomic save may os.replace
+        # the path between open and a path-based stat, and the old fd's
+        # bytes must pair with the old fd's size.
+        size = os.fstat(f.fileno()).st_size
+        payload_len = size - _MIN_SIZE
+        f.seek(len(MAGIC))
+        return pickle.loads(f.read(payload_len))
+
+
+def _read_any(path, target):
+    if os.path.isdir(path):
+        # Legacy orbax layout from before the single-file format.
+        import orbax.checkpoint as ocp
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(path, item=target)
+    return _read_file(path)
+
+
+def save(path, state):
+    """Write ``state`` (a pytree) at ``path``; rank 0 writes atomically
+    (tmp + fsync + rename + checksum footer), everyone waits at a
+    barrier so no rank resumes training against an in-flight save."""
     path = os.path.abspath(str(path))
     if not _spmd() or _rank() == 0:
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(path, state, force=True)
+        _write_file(path, state)
     if _spmd():
         barrier()
 
 
 def restore(path, target=None):
-    """Load a checkpoint. In SPMD mode rank 0 reads the bytes and
-    broadcasts — one storage read per job, identical state everywhere
-    (the elastic sync-from-survivor pattern applied to disk)."""
-    import orbax.checkpoint as ocp
-
+    """Load and verify a checkpoint. In SPMD mode rank 0 reads the bytes
+    and broadcasts — one storage read per job, identical state
+    everywhere. Raises ``CheckpointCorruptError`` when the file fails
+    its integrity check (use ``restore_latest`` for automatic fallback
+    to an older intact step)."""
     path = os.path.abspath(str(path))
     state = None
+    err = None
     if not _spmd() or _rank() == 0:
-        with ocp.PyTreeCheckpointer() as ckptr:
-            state = ckptr.restore(path, item=target)
+        try:
+            state = _read_any(path, target)
+        except (CheckpointCorruptError, OSError) as exc:
+            if not _spmd():
+                raise
+            # Rank 0 raising BEFORE the broadcast would strand every
+            # other rank inside broadcast_object forever: ship the
+            # failure through the broadcast and raise on all ranks.
+            err = f"{type(exc).__name__}: {exc}"
     if _spmd():
-        state = broadcast_object(state, root_rank=0, name="ckpt.restore")
+        err, state = broadcast_object((err, state), root_rank=0,
+                                      name="ckpt.restore")
+        if err is not None:
+            raise CheckpointCorruptError(
+                f"rank 0 could not restore {path}: {err}")
     return state
 
 
 def save_step(directory, step, state):
-    """Save under ``directory/step_<N>`` (monotonic step layout)."""
-    save(os.path.join(str(directory), f"step_{step}"), state)
+    """Save under ``directory/step_<N>`` (monotonic step layout), then
+    prune to the newest ``HVDTPU_CHECKPOINT_KEEP`` steps (0 = keep
+    everything)."""
+    directory = str(directory)
+    if not _spmd() or _rank() == 0:
+        os.makedirs(directory, exist_ok=True)
+    save(os.path.join(directory, f"step_{step}"), state)
+    if not _spmd() or _rank() == 0:
+        _apply_retention(directory)
+
+
+def _apply_retention(directory):
+    keep = envparse.get_int(envparse.CHECKPOINT_KEEP, 0)
+    if keep <= 0:
+        return
+    import shutil
+    for step in sorted(_list_steps(directory), reverse=True)[keep:]:
+        path = os.path.join(directory, f"step_{step}")
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+        except OSError as exc:
+            get_logger().warning("checkpoint retention: could not "
+                                 "remove %s: %s", path, exc)
+
+
+def _list_steps(directory):
+    """Step numbers under ``directory``. Non-checkpoint entries a real
+    directory accumulates — editor temp files, ``.tmp.<pid>`` partials
+    from a crashed writer — are skipped with a warning instead of
+    crashing the listing."""
+    steps, skipped = [], []
+    for name in os.listdir(directory):
+        if not name.startswith("step_"):
+            continue
+        try:
+            steps.append(int(name[5:]))
+        except ValueError:
+            skipped.append(name)
+    if skipped:
+        shown = ", ".join(sorted(skipped)[:5])
+        more = "" if len(skipped) <= 5 else f" (+{len(skipped) - 5} more)"
+        get_logger().warning(
+            "checkpoint: ignoring %d non-checkpoint entr%s in %s: %s%s",
+            len(skipped), "y" if len(skipped) == 1 else "ies",
+            directory, shown, more)
+    return steps
 
 
 def latest_step(directory):
@@ -71,25 +299,67 @@ def latest_step(directory):
     directory = str(directory)
     if not os.path.isdir(directory):
         return None
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name[5:]))
-            except ValueError:
-                continue
+    steps = _list_steps(directory)
     return max(steps) if steps else None
 
 
+def _latest_intact_step(directory):
+    """Newest step whose file passes verification; corrupt files are
+    skipped (warned + counted) in favor of older intact ones. Raises
+    ``CheckpointCorruptError`` when steps exist but NONE are intact —
+    silently training from scratch over a damaged store would be worse
+    than stopping."""
+    if not os.path.isdir(directory):
+        return None
+    steps = sorted(_list_steps(directory), reverse=True)
+    if not steps:
+        return None
+    log = get_logger()
+    for step in steps:
+        path = os.path.join(directory, f"step_{step}")
+        ok, reason = verify_checkpoint(path)
+        if ok:
+            if step != steps[0]:
+                log.warning(
+                    "checkpoint: falling back to step %d (newest intact "
+                    "checkpoint under %s)", step, directory)
+            return step
+        _m_corrupt().inc()
+        log.warning("checkpoint: step %d is corrupt (%s); trying the "
+                    "previous step", step, reason)
+    raise CheckpointCorruptError(
+        f"all {len(steps)} checkpoint(s) under {directory} failed their "
+        f"integrity checks (steps {steps}); refusing to silently train "
+        "from scratch")
+
+
 def restore_latest(directory, target=None):
-    """Restore the newest ``step_<N>`` checkpoint; returns (step, state)
-    or (None, None) when the directory holds none."""
-    step = latest_step(directory)
+    """Restore the newest *intact* ``step_<N>`` checkpoint; returns
+    ``(step, state)`` or ``(None, None)`` when the directory holds none.
+    Corrupt newer steps are skipped with a warning (and counted in
+    ``hvd_checkpoint_corrupt_total``) in favor of older intact ones."""
+    directory = str(directory)
+    step = None
+    err = None
+    if not _spmd() or _rank() == 0:
+        try:
+            step = _latest_intact_step(directory)
+        except (CheckpointCorruptError, OSError) as exc:
+            if not _spmd():
+                raise
+            # Same stranding hazard as restore(): the error must travel
+            # through the broadcast, not pre-empt it on rank 0 only.
+            err = f"{type(exc).__name__}: {exc}"
     if _spmd():
         # All ranks must agree on which step to load (a rank may race a
         # concurrent save when listing).
-        step = broadcast_object(step, root_rank=0, name="ckpt.latest")
+        err, step = broadcast_object((err, step), root_rank=0,
+                                     name="ckpt.latest")
+        if err is not None:
+            raise CheckpointCorruptError(
+                f"rank 0 could not pick a checkpoint under {directory}: "
+                f"{err}")
     if step is None:
         return None, None
-    return step, restore(os.path.join(str(directory), f"step_{step}"),
+    return step, restore(os.path.join(directory, f"step_{step}"),
                          target=target)

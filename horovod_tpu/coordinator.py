@@ -50,13 +50,16 @@ class Handle:
     """Async completion handle (analog of the reference's int handle +
     handle_manager, reference: horovod/torch/mpi_ops_v2.cc:604-624)."""
 
-    __slots__ = ("_event", "_result", "_exception", "name")
+    __slots__ = ("_event", "_result", "_exception", "name",
+                 "enqueue_time", "_coord")
 
     def __init__(self, name):
         self._event = threading.Event()
         self._result = None
         self._exception = None
         self.name = name
+        self.enqueue_time = None   # stamped by TensorEntry
+        self._coord = None         # stamped by Coordinator.submit
 
     def _complete(self, result):
         self._result = result
@@ -73,8 +76,14 @@ class Handle:
 
     def wait(self, timeout=None):
         if not self._event.wait(timeout):
-            raise TimeoutError(f"Operation {self.name} did not complete "
-                               f"within {timeout}s")
+            age = ("" if self.enqueue_time is None else
+                   f"; in flight {time.monotonic() - self.enqueue_time:.1f}s"
+                   " since submit")
+            hint = ("" if self._coord is None
+                    else self._coord._describe_missing(self.name))
+            raise TimeoutError(
+                f"Operation {self.name!r} did not complete within "
+                f"{timeout}s{age}{hint}")
         if self._exception is not None:
             raise self._exception
         return self._result
@@ -83,7 +92,8 @@ class Handle:
 class TensorEntry:
     __slots__ = ("name", "kind", "op", "root_rank", "arrays", "splits",
                  "prescale", "postscale", "process_set", "handle",
-                 "enqueue_time", "shapes", "uneven")
+                 "enqueue_time", "shapes", "uneven", "guard_token",
+                 "chaos_mismatch")
 
     def __init__(self, name, kind, arrays, process_set, op=None,
                  root_rank=None, splits=None, prescale=None, postscale=None,
@@ -100,6 +110,12 @@ class TensorEntry:
         self.uneven = uneven
         self.handle = Handle(name)
         self.enqueue_time = time.monotonic()
+        self.handle.enqueue_time = self.enqueue_time
+        # Armed by guardian.ConsistencyGuard.on_submit when this entry's
+        # submission slot is sampled for a pre-dispatch digest check.
+        self.guard_token = None
+        # Chaos 'collective:mismatch': publish a corrupted digest.
+        self.chaos_mismatch = False
 
 
 def _nbytes(a):
@@ -118,6 +134,12 @@ class Coordinator:
         # for every in-flight named op: duplicate detection + the stall
         # warning scan (reference: tensor_queue + stall_inspector).
         self._pending_names = {}
+        # Chaos 'collective:stall' black hole: entries swallowed at
+        # submit time (this rank "never submitted" them). Invisible to
+        # the data plane AND the published in-flight view, but aged by
+        # the watchdog so their handles fail at the abort instead of
+        # blocking a waiter forever.
+        self._chaos_stalled = []
         self._lock = threading.Lock()
         self._wakeup = threading.Event()
         self._running = False
@@ -136,11 +158,34 @@ class Coordinator:
                 envparse.STALL_CHECK_TIME, envparse.get_float(
                     envparse.STALL_CHECK_TIME_SECONDS,
                     DEFAULT_STALL_WARN_S))
-        self._stall_scan_period = max(1.0, min(self.stall_warn_s / 2.0,
-                                               10.0))
+        # Data-plane guardian (guardian.py; docs/fault_tolerance.md).
+        # Both None when their knobs are unset: the hot paths pay one
+        # attribute check and nothing else.
+        from . import guardian
+        self._guardian = guardian.make_guard(runtime)
+        self._watchdog = guardian.make_watchdog(runtime)
+        self._stall_scan_period = (max(1.0, min(self.stall_warn_s / 2.0,
+                                                10.0))
+                                   if self.stall_warn_s > 0 else 10.0)
+        if self._watchdog is not None:
+            # Scans must be frequent enough to notice the abort timeout.
+            self._stall_scan_period = max(0.25, min(
+                self._stall_scan_period, self._watchdog.timeout_s / 4.0))
+        # Age past which an op counts as stalled for the scan: the warn
+        # threshold, tightened to half the abort timeout when the
+        # watchdog's deadline is shorter than the warning's.
+        self._stall_observe_s = (self.stall_warn_s
+                                 if self.stall_warn_s > 0
+                                 else float("inf"))
+        if self._watchdog is not None:
+            self._stall_observe_s = min(self._stall_observe_s,
+                                        self._watchdog.timeout_s / 2.0)
         self._last_stall_scan = time.monotonic()
         self._stall_logged = set()
         self._stall_last_log = -float("inf")
+        self._m_aborts = telemetry.counter(
+            "hvd_collective_abort_total",
+            "Coordinated watchdog aborts of in-flight collectives")
         # Metrics plane (telemetry/): with HOROVOD_TPU_METRICS off every
         # factory returns the shared NULL no-op, so the hot paths below
         # stay unconditional; arithmetic-only sites additionally gate on
@@ -237,8 +282,9 @@ class Coordinator:
                 self._log.warning("could not write ORDER_CHECK record: %s",
                                   exc)
         with self._lock:
-            stranded = self._queue
+            stranded = self._queue + self._chaos_stalled
             self._queue = []
+            self._chaos_stalled = []
             self._pending_names.clear()
         for e in stranded:
             e.handle._fail(HorovodInternalError(
@@ -249,8 +295,22 @@ class Coordinator:
         if self._chaos_on:
             # Raises HorovodInternalError on a matching fail rule — the
             # same exception a real collective failure surfaces, so the
-            # elastic restore path is exercised end to end.
-            chaos.inject("collective", name=entry.name, kind=entry.kind)
+            # elastic restore path is exercised end to end. Signal
+            # actions (stall/mismatch) are applied here instead.
+            try:
+                chaos.inject("collective", name=entry.name,
+                             kind=entry.kind)
+            except chaos.ChaosSignal as sig:
+                if sig.action == "stall":
+                    return self._chaos_swallow(entry)
+                if sig.action == "mismatch":
+                    entry.chaos_mismatch = True
+        if self._guardian is not None:
+            # Publish the digest BEFORE the entry can reach a dispatch
+            # cycle, so a peer's verify never races an unpublished
+            # digest from this rank. May touch the KV board: outside
+            # the queue lock by design.
+            self._guardian.on_submit(entry)
         key = (entry.process_set.process_set_id, entry.name)
         guard = self._order_guard
         # Call-site capture only in ORDER_CHECK mode: the default hot
@@ -275,7 +335,24 @@ class Coordinator:
                 # checker submits on a timer, so they would land at
                 # rank-dependent stream positions and poison the digest.
                 guard.record(entry.name, entry.kind, callsite=site)
+        entry.handle._coord = self
         self._wakeup.set()
+        return entry.handle
+
+    def _chaos_swallow(self, entry):
+        """Chaos 'collective:stall': this rank never submits the op —
+        peers stall on it and the watchdog gets to prove it can name
+        this rank and abort. The entry parks in the black hole so the
+        abort (or shutdown) still resolves its waiter."""
+        with self._lock:
+            if not self._running:
+                raise HorovodInternalError(
+                    "Coordinator is shut down; cannot submit operations")
+            self._chaos_stalled.append(entry)
+        entry.handle._coord = self
+        self._log.warning(
+            "chaos: collective %r swallowed (stall injection) — this "
+            "rank will never submit it", entry.name)
         return entry.handle
 
     def _duplicate_error(self, entry, key):
@@ -312,7 +389,7 @@ class Coordinator:
                 break
             time.sleep(self.cycle_time_s)
             self._run_cycle()
-            if self.stall_warn_s > 0:
+            if self.stall_warn_s > 0 or self._watchdog is not None:
                 self._check_stalls()
 
     def _loop_native(self, backend):
@@ -328,6 +405,8 @@ class Coordinator:
             with self._lock:
                 batch = self._queue
                 self._queue = []
+            if self._guardian is not None and batch:
+                batch = self._verify_consistency(batch)
             for e in batch:
                 backend.submit_entry(e)
             self.cycles += 1
@@ -351,18 +430,27 @@ class Coordinator:
                 # Candidate switches are cycle-count driven so every rank
                 # applies the same knob at the same negotiation round.
                 self.runtime.autotuner.record_cycle()
-            if self.stall_warn_s > 0:
+            if self.stall_warn_s > 0 or self._watchdog is not None:
                 self._check_stalls()
 
     def _check_stalls(self, now=None):
         """Scan for submissions in flight longer than the stall threshold
         — the python-plane analog of the reference's stall inspector
-        (horovod/common/stall_inspector.cc). Feeds the stalled-op gauges
-        and emits ONE summary warning (count + oldest op + age) per
-        change of the stalled set — refreshed every ``stall_warn_s``
-        while the stall persists — instead of a log line per op. Scans
-        at most every ``_stall_scan_period`` seconds; a cycle with
-        nothing stalled costs one clock read and a compare."""
+        (horovod/common/stall_inspector.cc), upgraded from a log line
+        into a cluster diagnostic-and-abort machine (guardian.Watchdog):
+
+        - Feeds the stalled-op gauges and emits ONE summary warning
+          (count + oldest op + age + the ranks that never submitted it)
+          per change of the stalled set.
+        - With ``HVDTPU_COLLECTIVE_TIMEOUT`` armed, publishes this
+          rank's in-flight set, fetches the peers', and past the
+          timeout runs a coordinated abort: every in-flight handle
+          fails with ``CollectiveAbortError`` carrying the diagnostic
+          (under elastic that converts into restore-and-reset instead
+          of an eternal hang).
+
+        Scans at most every ``_stall_scan_period`` seconds; a cycle
+        with nothing stalled costs one clock read and a compare."""
         if now is None:
             now = time.monotonic()
         if now - self._last_stall_scan < self._stall_scan_period:
@@ -370,10 +458,27 @@ class Coordinator:
         self._last_stall_scan = now
         stalled = []
         with self._lock:
+            inflight = [key[1] for key in self._pending_names if key[1]]
             for key, info in self._pending_names.items():
                 age = now - info[0]
-                if age > self.stall_warn_s:
+                if age > self._stall_observe_s:
                     stalled.append((key[1], age, info[1]))
+            for e in self._chaos_stalled:
+                age = now - e.enqueue_time
+                if age > self._stall_observe_s:
+                    stalled.append((e.name, age, None))
+        wd = self._watchdog
+        peer_abort = None
+        if wd is not None:
+            # Runs on EVERY scan (stalled or not) so this rank's
+            # published in-flight view never goes stale under a peer's
+            # missing-rank diagnosis; the peer fetch inside only
+            # happens when something is stalled here.
+            try:
+                _, peer_abort = wd.observe(
+                    inflight, [(n, a) for n, a, _ in stalled], now)
+            except Exception as exc:  # noqa: BLE001 — advisory plane
+                self._log.warning("watchdog observation failed: %s", exc)
         if not stalled:
             self._m_stalled.set(0)
             self._m_stalled_oldest.set(0.0)
@@ -383,20 +488,117 @@ class Coordinator:
         oldest_name, oldest_age, oldest_site = stalled[0]
         self._m_stalled.set(len(stalled))
         self._m_stalled_oldest.set(oldest_age)
+        if wd is not None:
+            if peer_abort is not None or wd.should_abort(oldest_age):
+                self._abort_inflight(
+                    self._abort_diagnostic(stalled, peer_abort))
+                return
+        if self.stall_warn_s <= 0:
+            return
+        # The watchdog may tighten the observation threshold below the
+        # warning threshold; warn only about genuinely warn-old ops.
+        stalled = [s for s in stalled if s[1] > self.stall_warn_s]
+        if not stalled:
+            return
+        oldest_name, oldest_age, oldest_site = stalled[0]
         current = {name for name, _, _ in stalled}
         if (current == self._stall_logged
                 and now - self._stall_last_log < self.stall_warn_s):
             return
         self._stall_logged = current
         self._stall_last_log = now
+        missing_note = (wd.describe_missing(oldest_name)
+                        if wd is not None else "")
         self._log.warning(
             "%d tensor(s) submitted over %.0f s ago have not completed "
             "— ranks may have diverged (some rank never submitted the "
-            "matching op). Oldest: %s (%.0f s%s). Run `hvd-lint` on the "
-            "training script to check for rank-dependent collectives "
-            "(docs/lint.md); tune via HOROVOD_TPU_STALL_CHECK_TIME.",
+            "matching op). Oldest: %s (%.0f s%s)%s. Run `hvd-lint` on "
+            "the training script to check for rank-dependent "
+            "collectives (docs/lint.md); tune via "
+            "HOROVOD_TPU_STALL_CHECK_TIME.",
             len(stalled), self.stall_warn_s, oldest_name, oldest_age,
-            f", submitted at {oldest_site}" if oldest_site else "")
+            f", submitted at {oldest_site}" if oldest_site else "",
+            missing_note)
+
+    def _abort_diagnostic(self, stalled, peer_abort):
+        wd = self._watchdog
+        if peer_abort is not None:
+            return (f"coordinated abort joined (initiated by a peer): "
+                    f"{peer_abort}")
+        lines = []
+        for name, age, site in stalled:
+            note = wd.describe_missing(name) if wd is not None else ""
+            at = f", submitted at {site}" if site else ""
+            lines.append(f"  {name}: in flight {age:.0f}s{at}{note}")
+        return (f"stuck-collective watchdog: {len(stalled)} operation(s) "
+                f"exceeded HVDTPU_COLLECTIVE_TIMEOUT="
+                f"{wd.timeout_s:.0f}s; aborting all in-flight "
+                "collectives:\n" + "\n".join(lines))
+
+    def _abort_inflight(self, diagnostic):
+        """Coordinated abort: fail EVERY in-flight handle — queued,
+        chaos-swallowed, and anything the backend holds in negotiation
+        — with the diagnostic attached, and post the abort notice so
+        peers stop waiting too. Under elastic the resulting
+        ``CollectiveAbortError`` (a ``HorovodInternalError``) converts
+        into a restore-and-reset instead of a job death."""
+        from .exceptions import CollectiveAbortError
+        exc = CollectiveAbortError(diagnostic)
+        self._log.error("%s", diagnostic)
+        self._m_aborts.inc()
+        if self._watchdog is not None:
+            try:
+                self._watchdog.post_abort(diagnostic)
+            except Exception as post_exc:  # noqa: BLE001
+                self._log.warning("could not post abort notice: %s",
+                                  post_exc)
+        with self._lock:
+            victims = self._queue + self._chaos_stalled
+            self._queue = []
+            self._chaos_stalled = []
+            self._pending_names.clear()
+        try:
+            self.runtime.backend.abort_inflight(exc)
+        except Exception as backend_exc:  # noqa: BLE001
+            self._log.warning("backend abort failed: %s", backend_exc)
+        for e in victims:
+            e.handle._fail(exc)
+        self._m_stalled.set(0)
+        self._m_stalled_oldest.set(0.0)
+        self._stall_logged = set()
+
+    def _describe_missing(self, name):
+        """Watchdog's last known missing-rank note for ``name`` (empty
+        without a watchdog) — feeds Handle.wait timeout messages."""
+        if self._watchdog is None:
+            return ""
+        return self._watchdog.describe_missing(name)
+
+    def _verify_consistency(self, batch):
+        """Pre-dispatch digest verification (guardian.ConsistencyGuard):
+        entries whose submission slot was sampled compare every rank's
+        published metadata; a divergence fails ONLY that entry's handle
+        with ``CollectiveMismatchError`` — the rest of the batch
+        dispatches normally. Board trouble degrades to a warning."""
+        from .exceptions import CollectiveMismatchError
+        ok = []
+        for e in batch:
+            if e.guard_token is None:
+                ok.append(e)
+                continue
+            try:
+                self._guardian.verify(e)
+            except CollectiveMismatchError as exc:
+                self._log.error("%s", exc)
+                self._release_name(e)
+                e.handle._fail(exc)
+                continue
+            except Exception as exc:  # noqa: BLE001 — advisory check
+                self._log.warning(
+                    "guardian: consistency check skipped for %s: %s",
+                    e.name, exc)
+            ok.append(e)
+        return ok
 
     def _order_check_loop(self):
         """SPMD cross-check of the submission-order digests: allgather
@@ -440,6 +642,10 @@ class Coordinator:
             self._queue = []
         if not batch:
             return
+        if self._guardian is not None:
+            batch = self._verify_consistency(batch)
+            if not batch:
+                return
         cycle_t0 = time.perf_counter() if self._metrics_on else 0.0
         self._m_queue_depth.set(len(batch))
         self.cycles += 1

@@ -27,7 +27,8 @@ import time
 from . import basics
 from .chaos import inject as _chaos_inject
 from .exceptions import (PREEMPT_EXIT_CODE, RESTART_EXIT_CODE,
-                         HorovodInternalError, HostsUpdatedInterrupt)
+                         CollectiveAbortError, HorovodInternalError,
+                         HostsUpdatedInterrupt)
 from .telemetry import core as telemetry
 from .utils import envparse
 from .utils.logging_util import get_logger
@@ -398,15 +399,28 @@ def run_fn(func, reset=_reset):
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
-                log.info("elastic: collective failure (%s); restoring "
-                         "last commit", e)
+                if isinstance(e, CollectiveAbortError):
+                    # The stuck-collective watchdog aborted in-flight
+                    # ops (guardian.py): the diagnostic names which
+                    # ranks never submitted what. The reset below IS
+                    # the HostsUpdatedInterrupt-style recovery — the
+                    # abort becomes a restore-and-reset, not a job
+                    # death.
+                    log.warning("elastic: watchdog abort — restoring "
+                                "last commit and resetting. %s", e)
+                else:
+                    log.info("elastic: collective failure (%s); "
+                             "restoring last commit", e)
                 state.restore()
                 skip_sync = False
                 if preempt_requested():
                     # Counted once, as cause="preempted", inside the
                     # hand-off — the failure causes are disjoint.
                     _graceful_preempt_exit(state, log)
-                _m_failures().labels(cause="internal").inc()
+                _m_failures().labels(
+                    cause="collective_abort"
+                    if isinstance(e, CollectiveAbortError)
+                    else "internal").inc()
                 if _restart_mode():
                     _persist_and_exit(state, log, rereq=True)
             except HostsUpdatedInterrupt as e:

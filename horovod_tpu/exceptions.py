@@ -67,6 +67,41 @@ class StalledTensorError(RuntimeError):
     timeout (reference: horovod/common/stall_inspector.cc:26)."""
 
 
+class CollectiveAbortError(HorovodInternalError):
+    """The stuck-collective watchdog aborted every in-flight operation
+    after ``HVDTPU_COLLECTIVE_TIMEOUT`` (guardian.py; the enforcement
+    analog of the reference's stall inspector + STALL_SHUTDOWN_TIME,
+    horovod/common/stall_inspector.cc). The message carries the
+    watchdog's diagnostic — which ops stalled and which ranks never
+    submitted them. A ``HorovodInternalError`` on purpose: under
+    elastic the abort converts into a restore-and-reset instead of an
+    eternal hang or a job death."""
+
+
+class CollectiveMismatchError(RuntimeError):
+    """Ranks submitted the same named collective with divergent metadata
+    (kind, op, dtype, shapes, process set, or scale factors), detected
+    by the pre-dispatch consistency check (``HVDTPU_CONSISTENCY_CHECK``;
+    guardian.py — the analog of the reference controller's message-table
+    mismatch errors, horovod/common/controller.cc).
+
+    Deliberately NOT a ``HorovodInternalError``: like
+    ``SubmissionOrderError``, the divergence is a deterministic program
+    bug — the elastic restore/retry loop must surface it instead of
+    retrying into the same mismatch forever. ``self.divergences`` holds
+    ``(rank, field, theirs, ours)`` tuples."""
+
+    def __init__(self, message, divergences=()):
+        super().__init__(message)
+        self.divergences = list(divergences)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed its integrity check (truncated payload,
+    checksum mismatch, or foreign format) and no intact fallback was
+    available (checkpoint.py; docs/fault_tolerance.md)."""
+
+
 class SubmissionOrderError(RuntimeError):
     """Ranks submitted collectives in divergent orders (or with divergent
     auto-generated names), detected by the opt-in runtime order guard

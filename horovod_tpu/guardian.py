@@ -1,0 +1,471 @@
+"""Data-plane guardian: cross-rank consistency checks + stall forensics.
+
+The reference framework refuses to compute garbage or hang silently on
+rank divergence: the controller's message table rejects cross-rank
+shape/op mismatches at negotiation time (reference:
+horovod/common/controller.cc ComputeResponseList error responses) and
+the stall inspector names the ranks that never submitted a stuck tensor
+(reference: horovod/common/stall_inspector.cc). Our coordinator used to
+dispatch whatever the local rank submitted and log a purely local stall
+line. This module closes both gaps:
+
+- **ConsistencyGuard** (``HVDTPU_CONSISTENCY_CHECK``): at submit time
+  each rank publishes a compact metadata digest — kind, reduce op,
+  dtype, flattened shapes, process set, pre/postscale — for every named
+  collective to a shared *board*; before dispatch the digests are
+  compared and a divergence fails the handle with
+  ``CollectiveMismatchError`` naming the divergent ranks and fields,
+  instead of hanging in negotiation or silently reducing mismatched
+  bytes. ``1`` checks every named collective, ``N>1`` samples every Nth
+  submission (same slot on every rank — sequence numbers advance with
+  the name stream, which must agree for the program to be correct at
+  all).
+- **Watchdog** (``HVDTPU_COLLECTIVE_TIMEOUT``): the coordinator's stall
+  scan feeds it the in-flight set; it publishes this rank's view,
+  fetches the peers', and reports which ranks never submitted each
+  stalled op. Past the timeout it drives a coordinated abort — every
+  in-flight handle fails with ``CollectiveAbortError`` carrying the
+  diagnostic, and an abort notice on the board makes peers abort too.
+  Under elastic the abort is a ``HorovodInternalError``, so training
+  restores the last commit and resets instead of dying or hanging
+  forever.
+
+The board is the launcher's KV store in multi-process runs and a
+process-global in-memory table otherwise (threaded multi-rank tests,
+the local native transport). Both knobs unset costs nothing: the
+coordinator holds ``None`` and the submit path pays one attribute
+check (the telemetry/chaos disabled-guard contract).
+"""
+
+import json
+import threading
+import time
+
+from .exceptions import CollectiveMismatchError
+from .ops import reduce_ops
+from .telemetry import core as telemetry
+from .utils import envparse
+from .utils.logging_util import get_logger
+
+DEFAULT_CONSISTENCY_TIMEOUT_S = 10.0
+# Board key prefixes: digests are one key per (name, rank) — overwritten
+# each occurrence, so storage stays bounded by the program's name set.
+_DIGEST_PREFIX = "dg"
+_INFLIGHT_PREFIX = "if"
+_ABORT_KEY = "abort"
+
+
+def _m_mismatches():
+    # Resolved at call time (mismatches are terminal events): NULL no-op
+    # when HOROVOD_TPU_METRICS is off.
+    return telemetry.counter(
+        "hvd_collective_mismatch_total",
+        "Cross-rank collective metadata mismatches detected")
+
+
+# ---------------------------------------------------------------------------
+# Boards: where digests / in-flight sets / abort notices live
+# ---------------------------------------------------------------------------
+
+_INPROC_TABLE = {}
+_INPROC_LOCK = threading.Lock()
+
+
+def _reset_inproc():
+    """Test hook: drop the process-global table."""
+    with _INPROC_LOCK:
+        _INPROC_TABLE.clear()
+
+
+class InProcBoard:
+    """Process-global coordination table for runs where every rank lives
+    in this process (threaded tests, the native local transport)."""
+
+    def __init__(self, scope):
+        self._scope = scope
+
+    def put(self, key, value):
+        with _INPROC_LOCK:
+            _INPROC_TABLE[(self._scope, key)] = value
+
+    def get(self, key):
+        with _INPROC_LOCK:
+            return _INPROC_TABLE.get((self._scope, key))
+
+
+class KVBoard:
+    """Launcher KV store board. Every verb uses a SHORT retry budget:
+    the guard is advisory infrastructure — a flaky store must degrade it
+    to a warning, never block a dispatch for the full KV deadline or
+    kill the job with a transport error."""
+
+    RETRIES = 2
+    DEADLINE_S = 3.0
+
+    def __init__(self, addr, port, token, scope):
+        self._addr = addr
+        self._port = port
+        self._token = token
+        self._scope = scope
+        self._log = get_logger()
+
+    def put(self, key, value):
+        from .runner import http_client
+        try:
+            http_client.put_kv(self._addr, self._port, self._scope, key,
+                               value, token=self._token,
+                               retries=self.RETRIES,
+                               deadline=self.DEADLINE_S)
+        except Exception as exc:  # noqa: BLE001 — advisory plane
+            self._log.warning("guardian: board put %s failed: %s", key,
+                              exc)
+
+    def get(self, key):
+        from .runner import http_client
+        try:
+            raw = http_client.get_kv(self._addr, self._port, self._scope,
+                                     key, token=self._token,
+                                     retries=self.RETRIES,
+                                     deadline=self.DEADLINE_S)
+        except Exception as exc:  # noqa: BLE001 — advisory plane
+            self._log.warning("guardian: board get %s failed: %s", key,
+                              exc)
+            return None
+        return raw.decode() if isinstance(raw, bytes) else raw
+
+
+def _board_scope():
+    """One board scope per elastic membership version, so a fresh cohort
+    never reads the previous cohort's digests or abort notice."""
+    import os
+    ver = os.environ.get("HVDTPU_ELASTIC_VERSION", "0")
+    return f"guardian.{ver}"
+
+
+def make_board():
+    """KV board when the launcher's rendezvous is configured, the
+    in-process table otherwise. Callers coordinating across real
+    processes must use ``make_cross_process_board`` — the in-process
+    table only reaches ranks living in THIS process (threaded tests,
+    the local native transport)."""
+    from .runner import rendezvous as rdv
+    cfg = rdv.rendezvous_config()
+    scope = _board_scope()
+    if cfg is None:
+        return InProcBoard(scope)
+    addr, port, token = cfg
+    return KVBoard(addr, port, token, scope)
+
+
+def make_cross_process_board():
+    """KV board, or None when no launcher rendezvous exists (a digest
+    published to the in-process table would never reach a peer
+    process — worse than no check: every verify would wait out its
+    deadline)."""
+    from .runner import rendezvous as rdv
+    cfg = rdv.rendezvous_config()
+    if cfg is None:
+        return None
+    addr, port, token = cfg
+    return KVBoard(addr, port, token, _board_scope())
+
+
+# ---------------------------------------------------------------------------
+# Digests
+# ---------------------------------------------------------------------------
+
+# Fields compared across ranks, in reporting order.
+_DIGEST_FIELDS = ("kind", "op", "dtype", "shapes", "process_set",
+                  "prescale", "postscale", "root_rank")
+
+
+def entry_digest(entry):
+    """Compact metadata digest of a TensorEntry — everything that must
+    agree across ranks for the collective to be well-formed (the analog
+    of the reference message table's per-rank request record)."""
+    dtype = None
+    shapes = []
+    for a in entry.arrays:
+        if dtype is None and hasattr(a, "dtype"):
+            dtype = str(a.dtype)
+        shapes.append([int(s) for s in getattr(a, "shape", ())])
+    return {
+        "kind": entry.kind,
+        "op": reduce_ops.op_name(entry.op) if entry.op is not None
+        else None,
+        "dtype": dtype,
+        "shapes": shapes,
+        "process_set": entry.process_set.process_set_id,
+        "prescale": None if entry.prescale is None
+        else float(entry.prescale),
+        "postscale": None if entry.postscale is None
+        else float(entry.postscale),
+        "root_rank": entry.root_rank,
+    }
+
+
+def render_digest(digest):
+    return json.dumps(digest, sort_keys=True, separators=(",", ":"))
+
+
+def compare_digests(mine, theirs_by_rank):
+    """Diff the local digest against each rank's published one. Returns
+    ``[(rank, field, theirs, mine), ...]`` — empty when consistent."""
+    divergences = []
+    for rank in sorted(theirs_by_rank):
+        theirs = theirs_by_rank[rank]
+        for field in _DIGEST_FIELDS:
+            if theirs.get(field) != mine.get(field):
+                divergences.append((rank, field, theirs.get(field),
+                                    mine.get(field)))
+    return divergences
+
+
+class ConsistencyGuard:
+    """Publishes digests at submit time, verifies them before dispatch.
+
+    ``every``: 1 checks each named collective; N>1 checks every Nth
+    named submission (the sequence counter advances identically on every
+    rank of a correct program, so the sampled slots line up)."""
+
+    def __init__(self, rank, size, board, every=1, timeout_s=None,
+                 poll_s=0.01):
+        self.rank = rank
+        self.size = size
+        self.board = board
+        self.every = max(1, int(every))
+        self.timeout_s = (envparse.get_float(
+            envparse.CONSISTENCY_TIMEOUT, DEFAULT_CONSISTENCY_TIMEOUT_S)
+            if timeout_s is None else timeout_s)
+        self._poll_s = poll_s
+        self._seq = 0
+        self._occ = {}
+        self._lock = threading.Lock()
+        self._log = get_logger()
+
+    # -- submit side (framework threads) -----------------------------------
+    def on_submit(self, entry):
+        """Publish this entry's digest; arm ``entry.guard_token`` when
+        this submission slot is one the pre-dispatch verify samples."""
+        if not entry.name:
+            return
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            occ = self._occ.get(entry.name, 0) + 1
+            self._occ[entry.name] = occ
+        digest = entry_digest(entry)
+        published = (self._perturb(digest) if entry.chaos_mismatch
+                     else digest)
+        self.board.put(f"{_DIGEST_PREFIX}.{entry.name}.{self.rank}",
+                       f"{occ}|{render_digest(published)}")
+        if seq % self.every == 0:
+            # `digest` is the pre-perturb truth (_perturb copies), so a
+            # chaos-corrupted rank still flags ITSELF at verify time.
+            entry.guard_token = (entry.name, occ, digest)
+
+    @staticmethod
+    def _perturb(digest):
+        """Chaos ``collective:mismatch``: publish a digest whose shapes
+        (or dtype, for shapeless ops) disagree with what this rank
+        actually submitted — peers AND this rank's own verify flag it."""
+        digest = dict(digest)
+        if digest["shapes"]:
+            digest["shapes"] = [[s + 1 for s in shape] or [1]
+                                for shape in digest["shapes"]]
+        else:
+            digest["dtype"] = "chaos-corrupted"
+        return digest
+
+    # -- dispatch side (coordinator cycle thread) --------------------------
+    def verify(self, entry):
+        """Compare every rank's published digest for this entry against
+        the local truth. Raises ``CollectiveMismatchError`` on
+        divergence; unreported peers within the deadline degrade to a
+        warning (the stall watchdog owns missing-submission detection)."""
+        name, occ, mine = entry.guard_token
+        deadline = time.monotonic() + self.timeout_s
+        waiting = set(range(self.size))
+        theirs_by_rank = {}
+        ahead = set()
+        while waiting:
+            for rank in sorted(waiting):
+                raw = self.board.get(f"{_DIGEST_PREFIX}.{name}.{rank}")
+                if raw is None:
+                    continue
+                peer_occ, _, blob = raw.partition("|")
+                try:
+                    peer_occ = int(peer_occ)
+                except ValueError:
+                    theirs_by_rank[rank] = {"malformed": blob}
+                    waiting.discard(rank)
+                    continue
+                if peer_occ < occ:
+                    continue  # peer still on an earlier occurrence
+                if peer_occ > occ:
+                    # The per-(name, rank) key was already overwritten
+                    # by a later occurrence; comparing would flag a
+                    # healthy program whose shapes legitimately vary
+                    # per step. Occurrence k is gone — skip this peer.
+                    ahead.add(rank)
+                    waiting.discard(rank)
+                    continue
+                try:
+                    theirs_by_rank[rank] = json.loads(blob)
+                except ValueError:
+                    theirs_by_rank[rank] = {"malformed": blob}
+                waiting.discard(rank)
+            if not waiting or time.monotonic() > deadline:
+                break
+            time.sleep(self._poll_s)
+        if waiting or ahead:
+            reasons = []
+            if waiting:
+                reasons.append(f"rank(s) {sorted(waiting)} published no "
+                               f"digest within {self.timeout_s:.1f}s")
+            if ahead:
+                reasons.append(f"rank(s) {sorted(ahead)} already "
+                               "overwrote this occurrence")
+            self._log.warning(
+                "guardian: consistency check for %r (occurrence %d) "
+                "skipped some peers: %s (if a rank never submits, the "
+                "stall watchdog will name it)",
+                name, occ, "; ".join(reasons))
+        divergences = compare_digests(mine, theirs_by_rank)
+        if not divergences:
+            return
+        _m_mismatches().inc()
+        lines = [
+            f"  rank {rank}: {field} = {theirs!r} (rank {self.rank} "
+            f"submitted {ours!r})"
+            for rank, field, theirs, ours in divergences]
+        ranks = sorted({d[0] for d in divergences})
+        fields = sorted({d[1] for d in divergences})
+        raise CollectiveMismatchError(
+            f"collective {name!r} (occurrence {occ}) was submitted with "
+            f"divergent metadata by rank(s) {ranks} "
+            f"(fields: {', '.join(fields)}):\n" + "\n".join(lines) +
+            "\nEvery rank must submit the same op/dtype/shapes for a "
+            "named collective (reference: message-table mismatch, "
+            "horovod/common/controller.cc). Run `hvd-lint` on the "
+            "training script (docs/lint.md).", divergences=divergences)
+
+
+# ---------------------------------------------------------------------------
+# Stuck-collective watchdog
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Cluster view + abort policy for stalled collectives. The
+    coordinator's stall scan calls ``observe`` with its in-flight set;
+    this publishes the local view, reads the peers', and answers (a)
+    which ranks never submitted each stalled op and (b) whether the
+    abort threshold is crossed (locally, or because a peer already
+    aborted)."""
+
+    def __init__(self, rank, size, timeout_s, board=None):
+        self.rank = rank
+        self.size = size
+        self.timeout_s = timeout_s
+        self.board = board
+        self.last_missing = {}
+        self._log = get_logger()
+
+    def observe(self, inflight_names, stalled, now):
+        """``stalled``: [(name, age_s)]. Returns (missing, peer_abort):
+        ``missing`` maps each stalled name to the ranks whose published
+        in-flight view lacks it; ``peer_abort`` is a peer's abort
+        diagnostic when one already fired.
+
+        The local view is published on EVERY call — including scans
+        with nothing stalled — so peers never diagnose against a stale
+        snapshot from before this rank's latest submissions; the peer
+        fetch only happens when something is actually stalled here."""
+        if self.board is None:
+            return {}, None
+        self.board.put(f"{_INFLIGHT_PREFIX}.{self.rank}",
+                       ";".join(sorted(inflight_names)))
+        if not stalled:
+            return {}, None
+        peer_view = {}
+        unreported = []
+        for rank in range(self.size):
+            if rank == self.rank:
+                peer_view[rank] = set(inflight_names)
+                continue
+            raw = self.board.get(f"{_INFLIGHT_PREFIX}.{rank}")
+            if raw is None:
+                unreported.append(rank)
+                peer_view[rank] = None
+            else:
+                peer_view[rank] = {n for n in raw.split(";") if n}
+        missing = {}
+        for name, _age in stalled:
+            absent = [r for r, names in peer_view.items()
+                      if names is not None and name not in names]
+            if absent or unreported:
+                missing[name] = sorted(absent) + [f"{r}?" for r in
+                                                  unreported]
+        self.last_missing = missing
+        return missing, self.board.get(_ABORT_KEY)
+
+    def should_abort(self, oldest_age):
+        return self.timeout_s > 0 and oldest_age > self.timeout_s
+
+    def post_abort(self, diagnostic):
+        if self.board is not None:
+            self.board.put(_ABORT_KEY, diagnostic)
+
+    def describe_missing(self, name):
+        """Human-readable missing-rank note for ``name`` from the last
+        observation (feeds stall logs and Handle.wait timeouts)."""
+        ranks = self.last_missing.get(name)
+        if not ranks:
+            return ""
+        note = " — never submitted by rank(s) " + ", ".join(
+            str(r) for r in ranks)
+        if any(str(r).endswith("?") for r in ranks):
+            note += " ('?' = no report yet)"
+        return note
+
+
+# ---------------------------------------------------------------------------
+# Factories (called by the coordinator; None = feature off, zero cost)
+# ---------------------------------------------------------------------------
+
+def make_guard(runtime):
+    """ConsistencyGuard when HVDTPU_CONSISTENCY_CHECK is set and there
+    is more than one process-rank to compare; otherwise None."""
+    every = envparse.get_int(envparse.CONSISTENCY_CHECK, 0)
+    if every <= 0:
+        return None
+    if (getattr(runtime, "mode", None) != "spmd"
+            or runtime.topology.size < 2):
+        # Single-controller mode: one submitter owns every virtual rank,
+        # so there is no cross-rank metadata to disagree about.
+        return None
+    board = make_cross_process_board()
+    if board is None:
+        get_logger().warning(
+            "HVDTPU_CONSISTENCY_CHECK is set but no launcher rendezvous "
+            "is configured (HVDTPU_RENDEZVOUS_ADDR/PORT) — the digests "
+            "have nowhere to meet; the consistency check stays off")
+        return None
+    return ConsistencyGuard(runtime.topology.rank, runtime.topology.size,
+                            board, every=every)
+
+
+def make_watchdog(runtime):
+    """Watchdog when HVDTPU_COLLECTIVE_TIMEOUT > 0; the cluster board
+    rides along only in multi-process mode."""
+    timeout_s = envparse.get_float(envparse.COLLECTIVE_TIMEOUT, 0.0)
+    if timeout_s <= 0:
+        return None
+    board = None
+    if (getattr(runtime, "mode", None) == "spmd"
+            and runtime.topology.size > 1):
+        # None without a rendezvous: the watchdog still aborts locally,
+        # it just cannot gather the peers' in-flight views.
+        board = make_cross_process_board()
+    return Watchdog(runtime.topology.rank, runtime.topology.size,
+                    timeout_s, board=board)

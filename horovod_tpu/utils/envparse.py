@@ -115,6 +115,17 @@ KV_DEADLINE = "KV_DEADLINE"
 HEARTBEAT_INTERVAL = "HEARTBEAT_INTERVAL"
 HEARTBEAT_TIMEOUT = "HEARTBEAT_TIMEOUT"
 SIGKILL_DEADLINE = "SIGKILL_DEADLINE"
+# Data-plane guardian (guardian.py; docs/fault_tolerance.md):
+# cross-rank metadata digests before dispatch (0 off, 1 every named
+# collective, N>1 sampled every Nth submission), peer-digest wait
+# deadline, and the stuck-collective watchdog's abort timeout
+# (0 disables the abort; the stall warning alone remains).
+CONSISTENCY_CHECK = "CONSISTENCY_CHECK"
+CONSISTENCY_TIMEOUT = "CONSISTENCY_TIMEOUT"
+COLLECTIVE_TIMEOUT = "COLLECTIVE_TIMEOUT"
+# Crash-safe checkpoints (checkpoint.py): keep only the newest N
+# step_<N> checkpoints after each save_step (0 = keep everything).
+CHECKPOINT_KEEP = "CHECKPOINT_KEEP"
 
 # Launcher-set topology env (analog of HOROVOD_RANK/SIZE/...; reference:
 # horovod/runner/gloo_run.py:65-77)

@@ -93,6 +93,34 @@ class TestSpecParsing:
         with pytest.raises(ChaosSpecError):
             chaos.inject("kv_get", scope="s", key="k")
 
+    def test_signal_actions_parse_at_their_points(self):
+        rules = parse_spec(
+            "collective:mismatch:rank=1:name=step2;"
+            "collective:stall:name=grad_*;"
+            "backend_submit:stall:kind=allreduce;"
+            "checkpoint:corrupt:name=step_4")
+        assert [r.action for r in rules] == [
+            "mismatch", "stall", "stall", "corrupt"]
+
+    @pytest.mark.parametrize("bad", [
+        "kv_get:mismatch",        # digest corruption has no KV meaning
+        "worker:stall",           # commit boundaries can't swallow ops
+        "collective:corrupt",     # corruption is a checkpoint effect
+        "checkpoint:stall",       # saves aren't negotiated submissions
+    ])
+    def test_signal_actions_rejected_at_foreign_points(self, bad):
+        with pytest.raises(ChaosSpecError, match="only valid at"):
+            parse_spec(bad)
+
+    def test_signal_actions_raise_chaos_signal_at_inject(self,
+                                                         monkeypatch):
+        _arm(monkeypatch, "collective:stall:name=ghost")
+        with pytest.raises(chaos.ChaosSignal) as ei:
+            chaos.inject("collective", name="ghost", kind="allreduce")
+        assert ei.value.action == "stall"
+        # Non-matching context: no signal.
+        chaos.inject("collective", name="fine", kind="allreduce")
+
 
 # ==========================================================================
 # Disabled mode: the no-op guard (acceptance criterion)

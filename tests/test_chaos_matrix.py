@@ -21,6 +21,7 @@ assertions can read fail_counts / resets / blacklist afterwards.
 
 import os
 import sys
+import time
 
 import pytest
 
@@ -31,11 +32,14 @@ from test_elastic import WORKER, _parse_log, _worker_env, _write_discovery
 
 
 def _run_chaos_job(tmp_path, chaos_spec, min_np=1, heartbeat_timeout=None,
-                   sigkill_deadline=None, **worker_extra):
+                   sigkill_deadline=None, capture_output=False,
+                   **worker_extra):
     """One elastic job: 2 workers on a static localhost:2 discovery,
     chaos injected into the WORKERS only (the driver stays healthy —
     driver-side faults are a different experiment). Returns
-    (rc, driver, log_path, chaos_log)."""
+    (rc, driver, log_path, chaos_log). With ``capture_output`` the
+    workers' stderr lands under ``tmp_path/out/rank.*/stderr`` so tests
+    can assert on guardian diagnostics."""
     phase_file = tmp_path / "phase"
     phase_file.write_text("0")
     log_path = tmp_path / "log"
@@ -44,15 +48,31 @@ def _run_chaos_job(tmp_path, chaos_spec, min_np=1, heartbeat_timeout=None,
     env = _worker_env(log_path, **worker_extra)
     env["HVDTPU_CHAOS"] = chaos_spec
     env["HVDTPU_CHAOS_LOG"] = str(chaos_log)
+    output_dir = None
+    if capture_output:
+        output_dir = str(tmp_path / "out")
+        os.makedirs(output_dir, exist_ok=True)
     es = ElasticSettings(
-        Settings(num_proc=2, start_timeout=60, env=env),
+        Settings(num_proc=2, start_timeout=60, env=env,
+                 output_filename=output_dir),
         discovery_script=discovery, min_np=min_np, max_np=8,
         discovery_interval=0.2, heartbeat_timeout=heartbeat_timeout,
         sigkill_deadline=sigkill_deadline)
-    spawn.reset_capture_dir(None)
+    spawn.reset_capture_dir(output_dir)
     driver = ElasticDriver(es, [sys.executable, WORKER])
     rc = driver.run()
     return rc, driver, log_path, chaos_log
+
+
+def _captured_stderr(tmp_path):
+    out = tmp_path / "out"
+    chunks = []
+    if out.is_dir():
+        for rank_dir in sorted(out.iterdir()):
+            path = rank_dir / "stderr"
+            if path.exists():
+                chunks.append(path.read_text(errors="replace"))
+    return "\n".join(chunks)
 
 
 def _log_content(log_path):
@@ -142,6 +162,121 @@ def test_preemption_sigterm_hands_off_gracefully(tmp_path):
     assert max(e[1] for e in entries) == 5
     survivor = [e[1] for e in entries if e[0] == "localhost:0"]
     assert survivor == sorted(survivor), entries
+
+
+def test_mismatch_injection_fails_fast_naming_bad_rank(tmp_path):
+    """Data-plane guardian row (a): rank 1 publishes a corrupted
+    metadata digest for its epoch-2 allreduce (chaos
+    `collective:mismatch`). With HVDTPU_CONSISTENCY_CHECK=1 the
+    pre-dispatch check must fail the op with a CollectiveMismatchError
+    NAMING rank 1 and the divergent field — on every rank, with zero
+    hangs — instead of hanging negotiation or reducing garbage. The
+    error is deterministic (not elastic-recoverable), so both workers
+    die loudly; the driver replaces them (the marker keeps the respawn
+    clean) and the job still completes."""
+    marker = tmp_path / "mismatch.marker"
+    t0 = time.monotonic()
+    rc, driver, log_path, chaos_log = _run_chaos_job(
+        tmp_path,
+        f"collective:mismatch:rank=1:name=step2:marker={marker}",
+        capture_output=True,
+        HVDTPU_CONSISTENCY_CHECK="1",
+        ELASTIC_TEST_EPOCHS=4, ELASTIC_TEST_EPOCH_SLEEP=0.2)
+    content = _log_content(log_path)
+    assert rc == 0, content
+    assert marker.exists()  # the corruption fired
+    assert time.monotonic() - t0 < 150  # no hang anywhere
+    stderr = _captured_stderr(tmp_path)
+    assert "CollectiveMismatchError" in stderr, stderr[-3000:]
+    assert "rank(s) [1]" in stderr
+    assert "step2" in stderr
+    # Both workers of the first cohort died ON the mismatch (fail-fast,
+    # not hang) and the replacement cohort finished all epochs.
+    assert driver.fail_counts.get("localhost") == 2, driver.fail_counts
+    assert driver.blacklist == set()
+    done = [line for line in content.splitlines() if "DONE" in line]
+    assert len(done) == 2, content
+    entries = _parse_log(log_path)
+    assert max(e[1] for e in entries) == 3
+
+
+def test_stall_injection_watchdog_aborts_and_elastic_recovers(tmp_path):
+    """Data-plane guardian row (b): rank 1 NEVER submits its epoch-3
+    allreduce (chaos `collective:stall` swallows it). The stall
+    inspector must name the missing rank, and past
+    HVDTPU_COLLECTIVE_TIMEOUT the watchdog must run a coordinated abort
+    — CollectiveAbortError on every in-flight handle — which elastic
+    converts into restore-and-reset: the job finishes all epochs with
+    NO process death and NO infinite hang."""
+    marker = tmp_path / "stall.marker"
+    t0 = time.monotonic()
+    rc, driver, log_path, chaos_log = _run_chaos_job(
+        tmp_path,
+        f"collective:stall:rank=1:name=step3:marker={marker}",
+        capture_output=True,
+        HVDTPU_COLLECTIVE_TIMEOUT="4",
+        HOROVOD_TPU_STALL_CHECK_TIME="1",
+        ELASTIC_TEST_EPOCHS=6, ELASTIC_TEST_EPOCH_SLEEP=0.2)
+    content = _log_content(log_path)
+    assert rc == 0, content
+    assert marker.exists()  # the stall fired
+    # Terminated well within bounds — the acceptance bar: diagnostic +
+    # abort inside the timeout, never an eternal hang.
+    assert time.monotonic() - t0 < 150
+    stderr = _captured_stderr(tmp_path)
+    assert "stuck-collective watchdog" in stderr, stderr[-3000:]
+    assert "step3" in stderr
+    # The diagnostic names the rank that never submitted the op.
+    assert "never submitted by rank(s) 1" in stderr
+    assert "watchdog abort" in stderr  # elastic took the reset path
+    assert driver.blacklist == set()
+    done = [line for line in content.splitlines() if "DONE" in line]
+    assert len(done) == 2, content
+    entries = _parse_log(log_path)
+    assert max(e[1] for e in entries) == 5
+    # Recovery restored committed progress: epochs never regress below
+    # the restore point on either worker after the abort.
+    for wid in ("localhost:0", "localhost:1"):
+        epochs = [e[1] for e in entries if e[0] == wid]
+        assert max(epochs) == 5, entries
+
+
+def test_corrupted_latest_checkpoint_falls_back_and_resumes(tmp_path,
+                                                            monkeypatch):
+    """Data-plane guardian row (c): a training run whose NEWEST
+    checkpoint is corrupted on disk (chaos `checkpoint:corrupt` at save
+    time — the crash-during-write stand-in) must restore from the
+    previous intact step on restart and finish training, instead of
+    crashing on unpickling garbage or silently starting over."""
+    from horovod_tpu import chaos
+    from horovod_tpu import checkpoint as ckpt
+    ckpt_dir = tmp_path / "ckpts"
+    monkeypatch.setenv("HVDTPU_CHAOS",
+                       f"checkpoint:corrupt:name=step_4:"
+                       f"marker={tmp_path / 'ckpt.marker'}")
+    chaos.reset()
+    try:
+        # "First job": trains epochs 0..4, checkpointing every epoch;
+        # the epoch-4 save lands corrupted.
+        w = 0.0
+        for epoch in range(5):
+            w += 1.0
+            ckpt.save_step(ckpt_dir, epoch, {"epoch": epoch, "w": w})
+        ok, _ = ckpt.verify_checkpoint(ckpt_dir / "step_4")
+        assert not ok  # the newest checkpoint really is damaged
+        # "Restarted job": must fall back to step 3 and resume.
+        step, state = ckpt.restore_latest(ckpt_dir)
+        assert step == 3, step
+        assert state["epoch"] == 3 and state["w"] == 4.0
+        w, start = state["w"], state["epoch"] + 1
+        for epoch in range(start, 6):
+            w += 1.0
+            ckpt.save_step(ckpt_dir, epoch, {"epoch": epoch, "w": w})
+        step, state = ckpt.restore_latest(ckpt_dir)
+        assert step == 5 and state["w"] == 6.0
+    finally:
+        monkeypatch.delenv("HVDTPU_CHAOS")
+        chaos.reset()
 
 
 def test_collective_failure_injection_recovers(tmp_path):

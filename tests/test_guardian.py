@@ -1,0 +1,615 @@
+"""Data-plane guardian unit tests (fast lane, tier-1).
+
+Covers the consistency guard (digests, boards, mismatch detection, the
+chaos `collective:mismatch` perturbation, sampling, the unreported-peer
+degrade), the stuck-collective watchdog (missing-rank forensics, abort
+notices, the coordinated abort through the coordinator — driven with
+manual clocks, no sleeps), the enriched Handle.wait timeout message,
+the disabled-mode zero-overhead guard (the telemetry/chaos acceptance
+contract), the TcpBackend completion-sweep isolation regression, and
+the crash-safe checkpoint format (atomicity, checksum verification,
+fallback restore, retention, junk-file tolerance). Whole-job scenarios
+live in tests/test_chaos_matrix.py (slow lane).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import chaos, guardian
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu.coordinator import Coordinator, Handle, TensorEntry
+from horovod_tpu.exceptions import (CheckpointCorruptError,
+                                    CollectiveAbortError,
+                                    CollectiveMismatchError,
+                                    HorovodInternalError)
+from horovod_tpu.ops import reduce_ops
+
+
+@pytest.fixture(autouse=True)
+def _fresh_boards():
+    guardian._reset_inproc()
+    chaos.reset()
+    yield
+    guardian._reset_inproc()
+    chaos.reset()
+
+
+class _PS:
+    process_set_id = 0
+    ranks = [0, 1]
+
+
+def _entry(name, shape=(2, 3), dtype=np.float32, kind="allreduce",
+           op=reduce_ops.Sum, **kw):
+    arrays = [np.ones(shape, dtype)] if shape is not None else []
+    return TensorEntry(name, kind, arrays, _PS(), op=op, **kw)
+
+
+def _guard(rank, size=2, every=1, timeout_s=0.5):
+    return guardian.ConsistencyGuard(
+        rank, size, guardian.InProcBoard("t"), every=every,
+        timeout_s=timeout_s, poll_s=0.005)
+
+
+# ==========================================================================
+# Digests + ConsistencyGuard
+# ==========================================================================
+
+def test_entry_digest_captures_collective_metadata():
+    d = guardian.entry_digest(_entry("x", prescale=0.5))
+    assert d["kind"] == "allreduce"
+    assert d["op"] == "Sum"
+    assert d["dtype"] == "float32"
+    assert d["shapes"] == [[2, 3]]
+    assert d["process_set"] == 0
+    assert d["prescale"] == 0.5
+
+
+def test_compare_digests_names_rank_and_field():
+    mine = guardian.entry_digest(_entry("x"))
+    theirs = guardian.entry_digest(_entry("x", dtype=np.float64))
+    divs = guardian.compare_digests(mine, {1: theirs, 0: mine})
+    assert divs == [(1, "dtype", "float64", "float32")]
+
+
+def test_consistent_submissions_verify_clean():
+    g0, g1 = _guard(0), _guard(1)
+    e0, e1 = _entry("x"), _entry("x")
+    g0.on_submit(e0)
+    g1.on_submit(e1)
+    assert e0.guard_token is not None
+    g0.verify(e0)
+    g1.verify(e1)  # no raise
+
+
+def test_mismatch_fails_naming_divergent_rank_and_fields():
+    g0, g1 = _guard(0), _guard(1)
+    e0, e1 = _entry("y", shape=(2, 3)), _entry("y", shape=(4, 3))
+    g0.on_submit(e0)
+    g1.on_submit(e1)
+    with pytest.raises(CollectiveMismatchError) as ei:
+        g0.verify(e0)
+    msg = str(ei.value)
+    assert "rank(s) [1]" in msg and "shapes" in msg
+    assert ei.value.divergences == [(1, "shapes", [[4, 3]], [[2, 3]])]
+
+
+def test_chaos_mismatch_perturbation_is_caught_by_own_rank():
+    """`collective:mismatch` corrupts the digest rank 1 publishes; BOTH
+    sides — peers and rank 1 itself — must flag rank 1."""
+    g0, g1 = _guard(0), _guard(1)
+    e0, e1 = _entry("z"), _entry("z")
+    e1.chaos_mismatch = True
+    g0.on_submit(e0)
+    g1.on_submit(e1)
+    for g, e in ((g0, e0), (g1, e1)):
+        with pytest.raises(CollectiveMismatchError) as ei:
+            g.verify(e)
+        assert {d[0] for d in ei.value.divergences} == {1}
+
+
+def test_unreported_peer_degrades_to_warning_not_a_hang():
+    """A peer that never publishes (it may never submit at all) must not
+    fail or block the check past its deadline — naming missing ranks is
+    the watchdog's job."""
+    g0 = _guard(0, timeout_s=0.05)
+    e0 = _entry("solo")
+    g0.on_submit(e0)
+    t0 = time.monotonic()
+    g0.verify(e0)  # rank 1 silent: returns after the deadline, no raise
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_sampling_arms_every_nth_submission():
+    g0 = _guard(0, every=3)
+    tokens = []
+    for i in range(6):
+        e = _entry(f"s{i}")
+        g0.on_submit(e)
+        tokens.append(e.guard_token is not None)
+    assert tokens == [False, False, True, False, False, True]
+
+
+def test_occurrence_counter_disambiguates_reused_names():
+    g0, g1 = _guard(0), _guard(1)
+    for _ in range(2):
+        e0, e1 = _entry("step"), _entry("step")
+        g0.on_submit(e0)
+        g1.on_submit(e1)
+        g0.verify(e0)
+    assert e0.guard_token[1] == 2
+
+
+# ==========================================================================
+# Watchdog
+# ==========================================================================
+
+def test_watchdog_names_ranks_that_never_submitted():
+    w0 = guardian.Watchdog(0, 2, 5.0, board=guardian.InProcBoard("t"))
+    w1 = guardian.Watchdog(1, 2, 5.0, board=guardian.InProcBoard("t"))
+    w1.observe(["a"], [], 0.0)  # rank 1 has a in flight, never saw b
+    missing, abort = w0.observe(["a", "b"], [("b", 9.0)], 0.0)
+    assert missing == {"b": [1]}
+    assert abort is None
+    assert "rank(s) 1" in w0.describe_missing("b")
+    assert w0.describe_missing("a") == ""
+
+
+def test_watchdog_flags_unreported_peers_distinctly():
+    w0 = guardian.Watchdog(0, 2, 5.0, board=guardian.InProcBoard("t"))
+    missing, _ = w0.observe(["a"], [("a", 9.0)], 0.0)
+    assert missing == {"a": ["1?"]}
+
+
+def test_watchdog_abort_notice_reaches_peers():
+    w0 = guardian.Watchdog(0, 2, 5.0, board=guardian.InProcBoard("t"))
+    w1 = guardian.Watchdog(1, 2, 5.0, board=guardian.InProcBoard("t"))
+    w1.post_abort("the diagnostic")
+    _, abort = w0.observe([], [("a", 1.0)], 0.0)
+    assert abort == "the diagnostic"
+
+
+def test_watchdog_without_board_is_local_only():
+    w = guardian.Watchdog(0, 1, 2.0, board=None)
+    assert w.observe(["a"], [("a", 9.0)], 0.0) == ({}, None)
+    assert w.should_abort(3.0)
+    assert not w.should_abort(1.0)
+
+
+# ==========================================================================
+# Coordinator integration (manual clocks, no background thread)
+# ==========================================================================
+
+def _manual_coordinator(hvd):
+    from horovod_tpu import basics
+    coord = Coordinator(basics.runtime())
+    coord._running = True  # unit-driven: no cycle thread
+    return coord
+
+
+def _global_ps():
+    from horovod_tpu.process_sets import global_process_set
+    return global_process_set
+
+
+def test_chaos_stall_black_hole_then_watchdog_abort(hvd, monkeypatch):
+    monkeypatch.setenv("HVDTPU_COLLECTIVE_TIMEOUT", "3")
+    monkeypatch.setenv("HVDTPU_CHAOS", "collective:stall:name=ghost*")
+    chaos.reset()
+    coord = _manual_coordinator(hvd)
+    e = TensorEntry("ghost1", "allreduce", [np.ones(4, np.float32)],
+                    _global_ps())
+    h = coord.submit(e)
+    assert coord._chaos_stalled == [e]
+    now = time.monotonic()
+    coord._check_stalls(now=now + 2.0)   # stalled, under the timeout
+    assert not h.poll()
+    coord._last_stall_scan = 0
+    coord._check_stalls(now=now + 4.0)   # past the timeout -> abort
+    with pytest.raises(CollectiveAbortError) as ei:
+        h.wait(0)
+    msg = str(ei.value)
+    assert "HVDTPU_COLLECTIVE_TIMEOUT" in msg and "ghost1" in msg
+    assert coord._chaos_stalled == [] and coord._pending_names == {}
+
+
+def test_abort_clears_queued_entries_too(hvd, monkeypatch):
+    monkeypatch.setenv("HVDTPU_COLLECTIVE_TIMEOUT", "3")
+    coord = _manual_coordinator(hvd)
+    e = TensorEntry("queued", "allreduce", [np.ones(4, np.float32)],
+                    _global_ps())
+    h = coord.submit(e)
+    now = time.monotonic()
+    coord._check_stalls(now=now + 4.0)
+    with pytest.raises(CollectiveAbortError):
+        h.wait(0)
+    assert coord._queue == [] and coord._pending_names == {}
+
+
+def test_abort_counts_metric(hvd, monkeypatch):
+    from horovod_tpu.telemetry import core as telemetry
+    monkeypatch.setenv("HOROVOD_TPU_METRICS", "1")
+    telemetry.reset()
+    monkeypatch.setenv("HVDTPU_COLLECTIVE_TIMEOUT", "3")
+    try:
+        coord = _manual_coordinator(hvd)
+        e = TensorEntry("m", "allreduce", [np.ones(2, np.float32)],
+                        _global_ps())
+        coord.submit(e)
+        coord._check_stalls(now=time.monotonic() + 4.0)
+        assert telemetry.registry().counter(
+            "hvd_collective_abort_total").value == 1
+    finally:
+        monkeypatch.delenv("HOROVOD_TPU_METRICS")
+        telemetry.reset()
+
+
+def test_handle_wait_timeout_message_names_op_age_and_missing(hvd,
+                                                              monkeypatch):
+    monkeypatch.setenv("HVDTPU_COLLECTIVE_TIMEOUT", "60")
+    coord = _manual_coordinator(hvd)
+    coord._watchdog.last_missing = {"slow_op": [1, 3]}
+    e = TensorEntry("slow_op", "allreduce", [np.ones(2, np.float32)],
+                    _global_ps())
+    h = coord.submit(e)
+    with pytest.raises(TimeoutError) as ei:
+        h.wait(0.01)
+    msg = str(ei.value)
+    assert "slow_op" in msg
+    assert "in flight" in msg and "since submit" in msg
+    assert "never submitted by rank(s) 1, 3" in msg
+
+
+def test_bare_handle_wait_message_still_works():
+    h = Handle("plain")
+    with pytest.raises(TimeoutError) as ei:
+        h.wait(0.01)
+    assert "plain" in str(ei.value)
+
+
+# ==========================================================================
+# Disabled-mode guard (the telemetry/chaos acceptance contract)
+# ==========================================================================
+
+def test_disabled_guardian_no_kv_traffic_no_per_submit_state(hvd,
+                                                             monkeypatch):
+    """With HVDTPU_CONSISTENCY_CHECK and HVDTPU_COLLECTIVE_TIMEOUT
+    unset, the coordinator holds no guard objects, arms no tokens, and
+    produces ZERO KV traffic per submission."""
+    from horovod_tpu.runner import http_client
+    calls = []
+    for verb in ("put_kv", "get_kv", "delete_kv"):
+        real = getattr(http_client, verb)
+        monkeypatch.setattr(
+            http_client, verb,
+            lambda *a, _v=verb, **k: calls.append(_v))
+    assert os.environ.get("HVDTPU_CONSISTENCY_CHECK") is None
+    from horovod_tpu import basics
+    coord = basics.runtime().coordinator
+    assert coord._guardian is None
+    assert coord._watchdog is None
+    import jax.numpy as jnp
+    out = hvd.allreduce(jnp.ones((hvd.size(), 4)), op=hvd.Sum,
+                        name="guard_off_probe")
+    np.testing.assert_allclose(np.asarray(out), float(hvd.size()))
+    assert calls == []
+    e = TensorEntry("tok", "allreduce", [np.ones(2, np.float32)],
+                    _global_ps())
+    coord.submit(e)
+    assert e.guard_token is None and e.chaos_mismatch is False
+
+
+def test_guard_factories_respect_knobs(hvd, monkeypatch):
+    from horovod_tpu import basics
+    rt = basics.runtime()
+    assert guardian.make_guard(rt) is None
+    assert guardian.make_watchdog(rt) is None
+    monkeypatch.setenv("HVDTPU_COLLECTIVE_TIMEOUT", "5")
+    wd = guardian.make_watchdog(rt)
+    assert wd is not None and wd.timeout_s == 5.0
+    # Single-controller mode: one submitter, nothing cross-rank to
+    # compare — the consistency guard stays off even when asked for.
+    monkeypatch.setenv("HVDTPU_CONSISTENCY_CHECK", "1")
+    assert guardian.make_guard(rt) is None
+
+
+# ==========================================================================
+# TcpBackend completion-sweep isolation (regression)
+# ==========================================================================
+
+class _StubCore:
+    """Just enough of NativeCore for _sweep_completions."""
+
+    def __init__(self):
+        self.states = {}
+        self.outputs = {}
+        self.errors = {}
+        self.released = []
+
+    def poll(self, h):
+        state = self.states[h]
+        if isinstance(state, Exception):
+            raise state
+        return state
+
+    def error(self, h):
+        return self.errors.get(h, "boom")
+
+    def release(self, h):
+        self.released.append(h)
+
+    def output(self, h, dtype):
+        return self.outputs[h]
+
+
+def _stub_tcp_backend():
+    from horovod_tpu.backend.tcp_backend import TcpBackend
+    from horovod_tpu.utils.logging_util import get_logger
+    b = TcpBackend.__new__(TcpBackend)
+    b.core = _StubCore()
+    b._pending = []
+    b._chaos_swallowed = []
+    b._handle_arrays = {}
+    b._metrics_on = False
+    b._chaos_on = False
+    b._transport_dead = False
+    b.entry_done_cb = None
+    b._log = get_logger()
+    return b
+
+
+def _stub_pending(backend, name, handle_id, unpack):
+    from horovod_tpu.backend.tcp_backend import _Pending
+    e = TensorEntry(name, "allreduce", [np.ones(2, np.float32)], _PS(),
+                    op=reduce_ops.Sum)
+    p = _Pending(e, [handle_id], unpack)
+    backend._pending.append(p)
+    return e
+
+
+def test_poisoned_entry_fails_alone_sweep_continues():
+    """One entry whose unpack raises and one whose native poll raises
+    must each fail ONLY their own handles; the healthy entry still
+    completes in the same sweep (regression: one poisoned entry used to
+    wedge the whole sweep loop)."""
+    b = _stub_tcp_backend()
+    ok = _stub_pending(b, "ok", 1,
+                       lambda core, hs: core.output(hs[0], np.float32))
+    bad_unpack = _stub_pending(
+        b, "bad_unpack", 2,
+        lambda core, hs: (_ for _ in ()).throw(ValueError("poison")))
+    bad_poll = _stub_pending(b, "bad_poll", 3, lambda core, hs: None)
+    b.core.states = {1: 1, 2: 1, 3: RuntimeError("native layer blew up")}
+    b.core.outputs = {1: np.full(2, 7.0, np.float32)}
+    assert b._sweep_completions() == 3
+    assert b._pending == []
+    np.testing.assert_allclose(np.asarray(ok.handle.wait(0)), 7.0)
+    with pytest.raises(HorovodInternalError, match="poison"):
+        bad_unpack.handle.wait(0)
+    with pytest.raises(HorovodInternalError, match="bad_poll"):
+        bad_poll.handle.wait(0)
+    # Terminal entries released their native handles (even poisoned).
+    assert {1, 2, 3} <= set(b.core.released)
+
+
+def test_failed_native_state_still_isolated():
+    b = _stub_tcp_backend()
+    failed = _stub_pending(b, "failed", 4, lambda core, hs: None)
+    ok = _stub_pending(b, "ok2", 5,
+                       lambda core, hs: core.output(hs[0], np.float32))
+    b.core.states = {4: 2, 5: 1}
+    b.core.errors = {4: "STALLED: peer never joined"}
+    b.core.outputs = {5: np.zeros(2, np.float32)}
+    from horovod_tpu.exceptions import StalledTensorError
+    assert b._sweep_completions() == 2
+    with pytest.raises(StalledTensorError):
+        failed.handle.wait(0)
+    ok.handle.wait(0)
+
+
+def test_backend_stall_swallowed_entry_still_resolved_by_abort(
+        monkeypatch):
+    """A `backend_submit:stall` victim never reaches the native core,
+    but its waiter must still resolve when the watchdog aborts (or the
+    transport dies) — a swallowed handle may not hang forever."""
+    b = _stub_tcp_backend()
+    monkeypatch.setenv("HVDTPU_CHAOS", "backend_submit:stall:name=swal")
+    chaos.reset()
+    b._chaos_on = True
+    e = TensorEntry("swal", "allreduce", [np.ones(2, np.float32)],
+                    _PS(), op=reduce_ops.Sum)
+    assert b.submit_entry(e) is True
+    assert b._pending == [] and b._chaos_swallowed == [e]
+    b.abort_inflight(CollectiveAbortError("watchdog abort"))
+    assert b._chaos_swallowed == []
+    with pytest.raises(CollectiveAbortError):
+        e.handle.wait(0)
+
+
+def test_abort_inflight_fails_all_pending_with_diagnostic():
+    b = _stub_tcp_backend()
+    e1 = _stub_pending(b, "a", 6, lambda core, hs: None)
+    e2 = _stub_pending(b, "b", 7, lambda core, hs: None)
+    exc = CollectiveAbortError("watchdog says no")
+    b.abort_inflight(exc)
+    assert b._pending == []
+    for e in (e1, e2):
+        with pytest.raises(CollectiveAbortError, match="watchdog"):
+            e.handle.wait(0)
+    assert {6, 7} <= set(b.core.released)
+
+
+# ==========================================================================
+# Crash-safe checkpoints
+# ==========================================================================
+
+def test_checkpoint_roundtrip_and_atomicity(tmp_path):
+    ckpt.save_step(tmp_path, 3, {"w": np.arange(4.0), "epoch": 3})
+    # No tmp partials left behind and the file passes verification.
+    assert sorted(os.listdir(tmp_path)) == ["step_3"]
+    ok, reason = ckpt.verify_checkpoint(tmp_path / "step_3")
+    assert ok, reason
+    step, state = ckpt.restore_latest(tmp_path)
+    assert step == 3
+    np.testing.assert_allclose(state["w"], np.arange(4.0))
+
+
+def test_checkpoint_jax_leaves_round_trip(tmp_path):
+    import jax.numpy as jnp
+    ckpt.save(tmp_path / "c", {"p": {"w": jnp.ones((2, 2)) * 5}})
+    state = ckpt.restore(tmp_path / "c")
+    np.testing.assert_allclose(np.asarray(state["p"]["w"]), 5.0)
+
+
+def test_corrupt_latest_falls_back_to_previous_intact_step(tmp_path):
+    ckpt.save_step(tmp_path, 1, {"w": np.ones(3)})
+    ckpt.save_step(tmp_path, 2, {"w": np.ones(3) * 2})
+    with open(tmp_path / "step_2", "r+b") as f:
+        f.seek(len(ckpt.MAGIC) + 4)
+        f.write(b"\xde\xad\xbe\xef")
+    step, state = ckpt.restore_latest(tmp_path)
+    assert step == 1
+    np.testing.assert_allclose(state["w"], 1.0)
+
+
+def test_truncated_checkpoint_detected(tmp_path):
+    ckpt.save_step(tmp_path, 1, {"w": np.ones(3)})
+    ckpt.save_step(tmp_path, 2, {"w": np.ones(3) * 2})
+    data = (tmp_path / "step_2").read_bytes()
+    (tmp_path / "step_2").write_bytes(data[:len(data) // 2])
+    step, _ = ckpt.restore_latest(tmp_path)
+    assert step == 1
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore(tmp_path / "step_2")
+
+
+def test_all_corrupt_raises_instead_of_training_fresh(tmp_path):
+    ckpt.save_step(tmp_path, 1, {"w": np.ones(3)})
+    (tmp_path / "step_1").write_bytes(b"not a checkpoint at all")
+    with pytest.raises(CheckpointCorruptError, match="all 1 checkpoint"):
+        ckpt.restore_latest(tmp_path)
+
+
+def test_empty_directory_restores_none(tmp_path):
+    assert ckpt.restore_latest(tmp_path) == (None, None)
+    assert ckpt.latest_step(tmp_path / "missing") is None
+
+
+def test_latest_step_skips_junk_filenames_with_warning(tmp_path):
+    ckpt.save_step(tmp_path, 7, {"w": np.ones(2)})
+    (tmp_path / "step_9.tmp.1234").write_bytes(b"partial")
+    (tmp_path / "step_backup~").write_bytes(b"editor droppings")
+    import logging
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("horovod_tpu")
+    handler = _Capture()
+    logger.addHandler(handler)
+    try:
+        assert ckpt.latest_step(tmp_path) == 7
+    finally:
+        logger.removeHandler(handler)
+    joined = "\n".join(records)
+    assert "non-checkpoint" in joined
+    assert "step_9.tmp.1234" in joined
+
+
+def test_retention_keeps_newest_n(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVDTPU_CHECKPOINT_KEEP", "2")
+    for i in (1, 2, 3, 4):
+        ckpt.save_step(tmp_path, i, {"w": np.ones(2) * i})
+    assert sorted(os.listdir(tmp_path)) == ["step_3", "step_4"]
+
+
+def test_chaos_corrupt_point_exercises_fallback(tmp_path, monkeypatch):
+    ckpt.save_step(tmp_path, 1, {"w": np.ones(2)})
+    monkeypatch.setenv("HVDTPU_CHAOS", "checkpoint:corrupt:name=step_2")
+    chaos.reset()
+    ckpt.save_step(tmp_path, 2, {"w": np.ones(2) * 2})
+    ok, reason = ckpt.verify_checkpoint(tmp_path / "step_2")
+    assert not ok and "checksum" in reason
+    step, state = ckpt.restore_latest(tmp_path)
+    assert step == 1
+
+
+def test_checkpoint_corrupt_metric_counts(tmp_path, monkeypatch):
+    from horovod_tpu.telemetry import core as telemetry
+    monkeypatch.setenv("HOROVOD_TPU_METRICS", "1")
+    telemetry.reset()
+    try:
+        ckpt.save_step(tmp_path, 1, {"w": np.ones(2)})
+        ckpt.save_step(tmp_path, 2, {"w": np.ones(2)})
+        (tmp_path / "step_2").write_bytes(b"garbage garbage garbage" * 10)
+        ckpt.restore_latest(tmp_path)
+        assert telemetry.registry().counter(
+            "hvd_checkpoint_corrupt_total").value >= 1
+    finally:
+        monkeypatch.delenv("HOROVOD_TPU_METRICS")
+        telemetry.reset()
+
+
+# ==========================================================================
+# Elastic conversion: watchdog abort -> restore + reset, mismatch -> fatal
+# ==========================================================================
+
+def test_run_fn_converts_abort_into_restore_and_reset():
+    from horovod_tpu.elastic import State, run_fn
+    events = []
+
+    class FakeState(State):
+        def save(self):
+            events.append("save")
+
+        def restore(self):
+            events.append("restore")
+
+        def sync(self):
+            events.append("sync")
+
+        def check_host_updates(self):
+            pass
+
+    attempts = []
+
+    def func(state):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise CollectiveAbortError("watchdog abort: rank 1 missing")
+        return "recovered"
+
+    wrapped = run_fn(func, reset=lambda: events.append("reset"))
+    assert wrapped(FakeState()) == "recovered"
+    assert events == ["sync", "restore", "reset", "sync"]
+
+
+def test_run_fn_does_not_retry_mismatch():
+    """A metadata mismatch is a deterministic program bug: elastic must
+    surface it, not restore-and-retry into the same divergence."""
+    from horovod_tpu.elastic import State, run_fn
+
+    class FakeState(State):
+        def save(self):
+            pass
+
+        def restore(self):
+            raise AssertionError("must not restore on a mismatch")
+
+        def sync(self):
+            pass
+
+        def check_host_updates(self):
+            pass
+
+    def func(state):
+        raise CollectiveMismatchError("rank 1 diverged")
+
+    wrapped = run_fn(func, reset=lambda: None)
+    with pytest.raises(CollectiveMismatchError):
+        wrapped(FakeState())
