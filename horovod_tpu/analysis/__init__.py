@@ -13,6 +13,10 @@ Three layers, one finding type (:class:`Diagnostic`):
    ``hvd-lint`` CLI (analysis/cli.py) fronts this layer.
 3. **runtime order guard** (:class:`SubmissionOrderGuard`) — the opt-in
    ``HOROVOD_TPU_ORDER_CHECK=1`` dynamic backstop in the coordinator.
+4. **runtime concurrency sanitizer** (``sanitizer``) — the opt-in
+   ``HVDTPU_SANITIZE=1`` lock-order/liveness instrumentation behind the
+   HVD3xx thread-safety rules (``hvd-lint --self`` runs the static
+   side over this package itself).
 
 Rule catalog and suppression syntax: docs/lint.md.
 """
@@ -25,6 +29,7 @@ from .ast_lint import (  # noqa: F401
     lint_source, lint_file, lint_paths, iter_python_files,
 )
 from .order_guard import SubmissionOrderGuard  # noqa: F401
+from . import sanitizer  # noqa: F401
 
 
 def runtime_axis_sizes():

@@ -28,6 +28,36 @@ a static finding. Three rules:
   with ``if hvd.rank() == 0:`` means the other ranks never reach the
   barrier — the classic non-root-only checkpointing deadlock.
 
+The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
+analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
+of background-thread control plane this framework is built from:
+
+- **HVD301** (warning) — a mutable ``self`` attribute written both by a
+  ``threading.Thread`` target (or a method it calls) and by other
+  methods, with at least one write outside any ``with <lock>`` block:
+  a data race unless some ownership protocol exists (suppress with an
+  ownership comment where one does).
+- **HVD302** (error) — ``.acquire()`` with no ``.release()`` of the
+  same lock in an enclosing/adjacent ``try``/``finally`` in the same
+  scope: an exception between them leaks the lock and wedges every
+  later acquirer. Use ``with``.
+- **HVD303** (warning) — an *unbounded* blocking call (``urlopen``,
+  ``subprocess.*``, or ``.wait()``/``.join()``/``.get()`` with no
+  timeout) lexically inside a cycle/watchdog/heartbeat loop body (a
+  thread target whose thread or method name says coordinator/cycle/
+  watchdog/heartbeat/stall, plus the methods it calls): these threads
+  pace the data plane, so one unbounded call starves every in-flight
+  collective.
+- **HVD305** (warning) — a thread constructed with neither
+  ``daemon=True`` nor any visible ``join()``/``.daemon = True`` path:
+  it will keep the interpreter alive after ``shutdown()``.
+
+**HVD304** (warning, module-wide) — ``os.environ`` read of an
+``HVDTPU_*``/``HOROVOD_*`` name outside utils/envparse.py: it bypasses
+the prefix fallback AND the knob registry, so the knob drifts out of
+docs/knobs.md (the registry<->docs cross-check is rule HVD306,
+:func:`check_knob_docs`).
+
 Suppression: append ``# hvd-lint: disable=HVD201`` (comma-separate for
 several rules, or ``disable=all``) to the flagged line or the line
 above it; ``# hvd-lint: disable-file=HVD202`` anywhere disables a rule
@@ -370,6 +400,426 @@ class _Analyzer(ast.NodeVisitor):
         return self.diags
 
 
+# ==========================================================================
+# HVD3xx: concurrency & liveness (the static half of hvd-sanitize)
+# ==========================================================================
+
+# Thread names / target-method names that mark a collective-pacing loop
+# (the coordinator cycle driver also runs the watchdog scans).
+_LOOP_ROLE_RE = re.compile(r"coordinator|cycle|watchdog|heartbeat|stall",
+                           re.IGNORECASE)
+_ENV_PREFIXES = ("HVDTPU_", "HOROVOD_TPU_", "HOROVOD_")
+# Attribute calls that block forever without a bound.
+_WAITY_METHODS = frozenset({"wait", "join", "get"})
+_BOUND_KWARGS = frozenset({"timeout", "deadline"})
+
+
+def _unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<expr>"
+
+
+def _is_os_environ(node):
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os")
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_thread_ctor(node):
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Name):
+        return node.func.id == "Thread"
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "Thread"
+            and _root_name(node.func) == "threading")
+
+
+def _thread_kwargs(call):
+    """(target_attr_on_self or None, name constant or '', daemon bool)."""
+    target, tname, daemon = None, "", False
+    for kw in call.keywords:
+        if kw.arg == "target":
+            v = kw.value
+            if (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"):
+                target = v.attr
+        elif kw.arg == "name":
+            tname = _const_str(kw.value) or ""
+        elif kw.arg == "daemon":
+            daemon = (isinstance(kw.value, ast.Constant)
+                      and bool(kw.value.value))
+    return target, tname, daemon
+
+
+class _ConcurrencyAnalyzer:
+    """HVD301/302/303/304/305 over one module."""
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+
+    def run(self, tree):
+        self._scan_env_reads(tree)
+        for scope in self._scopes(tree):
+            self._scan_acquires(scope)
+        self._scan_thread_lifetimes(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+        return self.diags
+
+    # -- HVD304: raw env reads ---------------------------------------------
+    def _scan_env_reads(self, tree):
+        for node in ast.walk(tree):
+            name, line = None, 0
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == "get"
+                        and _is_os_environ(f.value) and node.args):
+                    name, line = _const_str(node.args[0]), node.lineno
+                elif (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "os" and node.args):
+                    name, line = _const_str(node.args[0]), node.lineno
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _is_os_environ(node.value)):
+                name, line = _const_str(node.slice), node.lineno
+            if name and name.startswith(_ENV_PREFIXES):
+                self.diags.append(Diagnostic.make(
+                    "HVD304",
+                    f"raw os.environ read of {name!r} bypasses "
+                    "utils/envparse.py — no HVDTPU_/HOROVOD_TPU_/"
+                    "HOROVOD_ prefix fallback, and the knob never "
+                    "reaches the registry that keeps docs/knobs.md "
+                    "honest (rule HVD306)",
+                    file=self.filename, line=line,
+                    hint="read it via envparse.get_*(envparse.<NAME>) "
+                         "and register() user-facing knobs; "
+                         + _DOC_HINT))
+
+    # -- HVD302: bare acquire ----------------------------------------------
+    def _scopes(self, tree):
+        """Module + every function, each scanned as one scope (a
+        release in a different function cannot protect this one)."""
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _scope_walk(self, scope):
+        """Walk a scope without descending into nested functions."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_acquires(self, scope):
+        acquires = []
+        released = set()
+        for node in self._scope_walk(scope):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                if node.func.attr == "acquire":
+                    acquires.append((node, _unparse(node.func.value)))
+            if isinstance(node, ast.Try):
+                for st in node.finalbody:
+                    for sub in ast.walk(st):
+                        if (isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Attribute)
+                                and sub.func.attr == "release"):
+                            released.add(_unparse(sub.func.value))
+        for call, base in acquires:
+            if base in released:
+                continue
+            self.diags.append(Diagnostic.make(
+                "HVD302",
+                f"`{base}.acquire()` with no `{base}.release()` in a "
+                "try/finally in this scope: an exception between "
+                "acquire and release leaks the lock and every later "
+                "acquirer blocks forever",
+                file=self.filename, line=call.lineno,
+                hint=f"use `with {base}:` (or release in a finally); "
+                     + _DOC_HINT))
+
+    # -- HVD305: thread lifetime -------------------------------------------
+    def _scan_thread_lifetimes(self, tree):
+        join_bases, daemon_bases = set(), set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                join_bases.add(_unparse(node.func.value))
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr == "daemon"
+                            and isinstance(node.value, ast.Constant)
+                            and node.value.value):
+                        daemon_bases.add(_unparse(t.value))
+        assigned = {}  # id(thread_call) -> target text
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+                assigned[id(node.value)] = _unparse(node.targets[0])
+        for node in ast.walk(tree):
+            if not _is_thread_ctor(node):
+                continue
+            _target, _tname, daemon = _thread_kwargs(node)
+            if daemon:
+                continue
+            tgt = assigned.get(id(node))
+            if tgt and (tgt in join_bases or tgt in daemon_bases):
+                continue
+            self.diags.append(Diagnostic.make(
+                "HVD305",
+                "thread started with neither daemon=True nor a "
+                "visible join()/.daemon = True path"
+                + (f" (assigned to {tgt})" if tgt else "")
+                + ": it outlives shutdown() and keeps the interpreter "
+                "from exiting",
+                file=self.filename, line=node.lineno,
+                hint="pass daemon=True, or keep a handle and join it "
+                     "on the shutdown path; " + _DOC_HINT))
+
+    # -- HVD301 + HVD303: per-class thread analysis ------------------------
+    def _scan_class(self, cls):
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        thread_calls = []
+        for node in ast.walk(cls):
+            if _is_thread_ctor(node):
+                target, tname, _daemon = _thread_kwargs(node)
+                if target in methods:
+                    thread_calls.append((target, tname))
+        if not thread_calls:
+            return
+        closures = {t: self._closure(t, methods)
+                    for t, _ in thread_calls}
+        thread_side = set().union(*closures.values())
+        self._rule_301(cls, methods, thread_side,
+                       [t for t, _ in thread_calls])
+        for target, tname in thread_calls:
+            if _LOOP_ROLE_RE.search(tname or "") \
+                    or _LOOP_ROLE_RE.search(target):
+                role = tname or target
+                for mname in sorted(closures[target]):
+                    self._rule_303(methods[mname], role)
+
+    def _closure(self, start, methods):
+        """Methods reachable from ``start`` through ``self.X()`` calls
+        — the code that runs on the thread, statically."""
+        seen, stack = set(), [start]
+        while stack:
+            cur = stack.pop()
+            if cur in seen or cur not in methods:
+                continue
+            seen.add(cur)
+            for node in ast.walk(methods[cur]):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in methods):
+                    stack.append(node.func.attr)
+        return seen
+
+    def _attr_writes(self, fn):
+        """[(attr, lineno, locked)] for self.<attr> assignments in
+        ``fn`` (plain, augmented, subscript, tuple targets); ``locked``
+        = lexically inside a ``with`` whose context mentions a lock."""
+        out = []
+
+        def targets_of(node):
+            if isinstance(node, ast.Assign):
+                return node.targets
+            return [node.target]
+
+        def self_attr(t):
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                return t.attr
+            return None
+
+        def rec(node, locked):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, ast.With):
+                holds = locked or any(
+                    "lock" in _unparse(item.context_expr).lower()
+                    for item in node.items)
+                for st in node.body:
+                    rec(st, holds)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                for t in targets_of(node):
+                    elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+                    for e in elts:
+                        attr = self_attr(e)
+                        if attr:
+                            out.append((attr, node.lineno, locked))
+            for child in ast.iter_child_nodes(node):
+                rec(child, locked)
+
+        for st in fn.body:
+            rec(st, False)
+        return out
+
+    def _rule_301(self, cls, methods, thread_side, targets):
+        thread_writes, other_writes = {}, {}
+        for mname, fn in methods.items():
+            if mname in thread_side:
+                side = thread_writes
+            elif mname == "__init__":
+                # Pre-start initialization: the universal ownership
+                # handoff — the thread does not exist yet.
+                continue
+            else:
+                side = other_writes
+            for attr, lineno, locked in self._attr_writes(fn):
+                side.setdefault(attr, []).append((mname, lineno, locked))
+        for attr in sorted(set(thread_writes) & set(other_writes)):
+            entries = thread_writes[attr] + other_writes[attr]
+            unlocked = [e for e in entries if not e[2]]
+            if not unlocked:
+                continue
+            mname, lineno, _ = min(unlocked, key=lambda e: e[1])
+            t_methods = sorted({m for m, _, _ in thread_writes[attr]})
+            o_methods = sorted({m for m, _, _ in other_writes[attr]})
+            self.diags.append(Diagnostic.make(
+                "HVD301",
+                f"attribute `self.{attr}` of class {cls.name} is "
+                f"written both on the thread side "
+                f"({', '.join(t_methods)}; thread target(s) "
+                f"{', '.join(sorted(targets))}) and from "
+                f"{', '.join(o_methods)}, with at least one write "
+                "outside any lock: concurrent writes race",
+                file=self.filename, line=lineno,
+                hint="guard every write with one lock, or document "
+                     "the ownership protocol and suppress with "
+                     "`# hvd-lint: disable=HVD301 — <why>`; "
+                     + _DOC_HINT))
+
+    def _rule_303(self, fn, role):
+        for node in self._scope_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            term = _terminal_name(f)
+            what = None
+            if term == "urlopen":
+                what = "urlopen"
+            elif (_root_name(f) == "subprocess"
+                    or (isinstance(f, ast.Name) and f.id == "Popen")):
+                what = _unparse(f)
+            elif (isinstance(f, ast.Attribute) and term in _WAITY_METHODS
+                    and not node.args
+                    and not any(kw.arg in _BOUND_KWARGS
+                                for kw in node.keywords)):
+                what = f"{_unparse(f)}() with no timeout"
+            if what is None:
+                continue
+            self.diags.append(Diagnostic.make(
+                "HVD303",
+                f"unbounded blocking call `{what}` inside the "
+                f"{role!r} loop body (method {fn.name}): this thread "
+                "paces the data plane, so the call starves every "
+                "in-flight collective for its duration",
+                file=self.filename, line=node.lineno,
+                hint="bound it (timeout=/deadline=) or move it to a "
+                     "non-critical thread; " + _DOC_HINT))
+
+
+# ==========================================================================
+# HVD306: knob registry <-> docs/knobs.md cross-check
+# ==========================================================================
+
+_DOC_KNOB_RE = re.compile(
+    r"^\|\s*`(?:HVDTPU_|HOROVOD_TPU_|HOROVOD_)([A-Z0-9_]+)`"
+    r"\s*\|\s*([^|]*)\|")
+
+
+def _norm_default(text):
+    """Comparable form of a default: parentheticals dropped
+    ("0 (off)" == "0"), em-dash/empty equivalent, case-folded."""
+    text = re.sub(r"\(.*?\)", "", text).strip()
+    if text in ("—", "-", "–"):
+        text = ""
+    return text.lower()
+
+
+def check_knob_docs(doc_path):
+    """Cross-check ``envparse.KNOBS`` against the knob table rows of
+    ``docs/knobs.md``: every registered knob needs a documented row,
+    every documented row needs a registration, and the documented
+    default must match the registered one (rule HVD306 — the registry
+    is the docs' source of truth, so the default field is checked
+    data, not decoration). Returns a list of :class:`Diagnostic`."""
+    from ..utils import envparse
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError as exc:
+        return [Diagnostic.make(
+            "HVD306", f"cannot read knob docs: {exc}", file=doc_path)]
+    documented = {}
+    for lineno, line in enumerate(lines, start=1):
+        m = _DOC_KNOB_RE.match(line.strip())
+        if m:
+            documented.setdefault(m.group(1), (lineno, m.group(2)))
+    diags = []
+    for name, (lineno, doc_default) in sorted(documented.items()):
+        reg = envparse.KNOBS.get(name)
+        if reg is None:
+            continue  # reported below as undocumented-registration
+        if _norm_default(doc_default) != _norm_default(reg["default"]):
+            diags.append(Diagnostic.make(
+                "HVD306",
+                f"knob {name}: documented default "
+                f"{doc_default.strip()!r} disagrees with the "
+                f"registered default {reg['default']!r}",
+                file=doc_path, line=lineno,
+                hint="align the docs row and the register() call in "
+                     "utils/envparse.py; " + _DOC_HINT))
+    for name in sorted(set(envparse.KNOBS) - set(documented)):
+        diags.append(Diagnostic.make(
+            "HVD306",
+            f"knob {name} is registered in utils/envparse.py but has "
+            f"no table row in {os.path.basename(doc_path)}",
+            file=doc_path, line=0,
+            hint=f"add a `HVDTPU_{name}` row (or drop the "
+                 "registration); " + _DOC_HINT))
+    for name in sorted(set(documented) - set(envparse.KNOBS)):
+        diags.append(Diagnostic.make(
+            "HVD306",
+            f"knob {name} is documented but not registered in "
+            "utils/envparse.py — nothing reads it through the "
+            "registry, so it will silently drift",
+            file=doc_path, line=documented[name][0],
+            hint="register() it in utils/envparse.py (or drop the "
+                 "row); " + _DOC_HINT))
+    return diags
+
+
 def _apply_suppressions(diags, src):
     lines = src.splitlines()
     file_off = set()
@@ -409,6 +859,7 @@ def lint_source(src, filename="<string>"):
     analyzer = _Analyzer(filename)
     analyzer.visit(tree)
     diags = analyzer.finish()
+    diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)
     return dedupe(sorted(diags, key=Diagnostic.sort_key))
 

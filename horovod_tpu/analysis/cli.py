@@ -1,11 +1,19 @@
-"""``hvd-lint``: static collective-correctness linter CLI.
+"""``hvd-lint``: static collective-correctness + concurrency linter CLI.
 
 Runs the AST layer over scripts/directories and prints structured
 findings with ``file:line`` + fix hints:
 
     hvd-lint train.py examples/
     hvd-lint --format json --fail-on warning src/
+    hvd-lint --self                 # sweep horovod_tpu/ itself (CI)
+    hvd-lint --check-knobs          # knob registry vs docs/knobs.md
     hvd-lint --list-rules
+
+``--self`` is the hvd-sanitize self-analysis: every rule (collective
+HVD2xx + concurrency HVD3xx) over the installed ``horovod_tpu``
+package, plus the knob-docs cross-check (HVD306) when the repo's
+docs/knobs.md is present, failing on warnings — the framework must
+hold itself to the rules it enforces on user scripts.
 
 Exit codes: 0 no findings at/above ``--fail-on``; 1 findings; 2 usage
 or internal error. The jaxpr layer needs traced inputs, so it is an API
@@ -15,19 +23,34 @@ rather than a CLI mode — see docs/lint.md.
 
 import argparse
 import json
+import os
 import sys
 
 from . import ast_lint
 from .diagnostics import ERROR, RULES
 
 
+def _package_dir():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _default_knob_docs():
+    """docs/knobs.md next to the package (repo checkouts); None when
+    absent (pip installs ship no docs — nothing to cross-check)."""
+    path = os.path.join(os.path.dirname(_package_dir()), "docs",
+                        "knobs.md")
+    return path if os.path.isfile(path) else None
+
+
 def _build_parser():
     parser = argparse.ArgumentParser(
         prog="hvd-lint",
-        description="Static collective-correctness linter for "
-                    "horovod_tpu training scripts.")
-    parser.add_argument("paths", nargs="*", default=["."],
-                        help="python files or directories (default: .)")
+        description="Static collective-correctness and concurrency "
+                    "linter for horovod_tpu training scripts (and, "
+                    "via --self, for horovod_tpu itself).")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="python files or directories (default: . "
+                             "unless only --check-knobs is requested)")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
     parser.add_argument("--rules", default="",
@@ -36,7 +59,19 @@ def _build_parser():
     parser.add_argument("--fail-on", choices=("error", "warning", "never"),
                         default="error",
                         help="lowest severity that fails the run "
-                             "(default: error)")
+                             "(default: error; --self implies warning)")
+    parser.add_argument("--self", dest="self_sweep", action="store_true",
+                        help="sweep the horovod_tpu package itself with "
+                             "every rule + the knob-docs cross-check, "
+                             "failing on warnings (the hvd-sanitize "
+                             "self-analysis)")
+    parser.add_argument("--check-knobs", action="store_true",
+                        help="cross-check the envparse knob registry "
+                             "against docs/knobs.md (HVD306); with no "
+                             "paths given, runs only the cross-check")
+    parser.add_argument("--knobs-md", default="", metavar="PATH",
+                        help="knob docs to cross-check against "
+                             "(default: the repo's docs/knobs.md)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     return parser
@@ -51,12 +86,44 @@ def main(argv=None):
             print(f"{rule}  {severity:7s}  {title}")
         return 0
 
-    only = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+    fail_on = args.fail_on
+    # An explicit --knobs-md implies the cross-check: a user who named
+    # the file expects it to be read.
+    check_knobs = (args.check_knobs or args.self_sweep
+                   or bool(args.knobs_md))
+    paths = list(args.paths)
+    if args.self_sweep:
+        paths = [_package_dir()]
+        if fail_on == "error":
+            fail_on = "warning"
+    elif not paths and not check_knobs:
+        paths = ["."]
+    # `hvd-lint --check-knobs` with no paths runs ONLY the cross-check.
+
+    diags = []
     try:
-        diags = ast_lint.lint_paths(args.paths)
+        if paths:
+            diags = ast_lint.lint_paths(paths)
     except OSError as exc:
         print(f"hvd-lint: {exc}", file=sys.stderr)
         return 2
+
+    if check_knobs:
+        # An explicit --knobs-md that cannot be read surfaces as an
+        # HVD306 diagnostic from check_knob_docs. A missing DEFAULT
+        # docs file is only tolerated for the implicit --self case
+        # (pip installs ship no docs); an explicit --check-knobs that
+        # finds nothing to check must not report green.
+        doc_path = args.knobs_md or _default_knob_docs()
+        if doc_path:
+            diags.extend(ast_lint.check_knob_docs(doc_path))
+        elif args.check_knobs or args.knobs_md:
+            print("hvd-lint: no knob docs found (no docs/knobs.md "
+                  "next to the package); pass --knobs-md PATH",
+                  file=sys.stderr)
+            return 2
+
+    only = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
     if only:
         diags = [d for d in diags if d.rule in only]
     diags.sort(key=lambda d: d.sort_key())
@@ -70,9 +137,9 @@ def main(argv=None):
         print(f"hvd-lint: {len(diags)} finding(s) "
               f"({errors} error(s), {len(diags) - errors} warning(s))")
 
-    if args.fail_on == "never":
+    if fail_on == "never":
         return 0
-    if args.fail_on == "warning":
+    if fail_on == "warning":
         return 1 if diags else 0
     return 1 if any(d.severity == ERROR for d in diags) else 0
 

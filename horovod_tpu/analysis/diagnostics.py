@@ -29,6 +29,21 @@ RULES = {
                         "control flow"),
     "HVD204": (ERROR, "checkpoint save/restore call guarded by a rank "
                       "condition (they barrier/broadcast internally)"),
+    # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
+    "HVD301": (WARNING, "mutable attribute shared between a thread "
+                        "target and other methods written without a "
+                        "lock"),
+    "HVD302": (ERROR, "lock acquired outside `with` / try-finally "
+                      "(an exception leaks the lock and wedges every "
+                      "later acquirer)"),
+    "HVD303": (WARNING, "unbounded blocking call inside a "
+                        "cycle/watchdog/heartbeat loop body"),
+    "HVD304": (WARNING, "HVDTPU_*/HOROVOD_* env read bypassing "
+                        "utils/envparse.py (prefix fallback + knob "
+                        "registry)"),
+    "HVD305": (WARNING, "thread started with neither daemon=True nor "
+                        "a join path"),
+    "HVD306": (ERROR, "knob registry and docs/knobs.md disagree"),
 }
 
 _SEV_ORDER = {ERROR: 0, WARNING: 1}

@@ -62,21 +62,21 @@ class ParameterManager:
         self.enabled = True
         self._log = get_logger()
         self._log_path = envparse.get_str(envparse.AUTOTUNE_LOG, "")
-        fusion = _env_list("AUTOTUNE_FUSION_CANDIDATES_MIB",
+        fusion = _env_list(envparse.AUTOTUNE_FUSION_CANDIDATES_MIB,
                            FUSION_CANDIDATES_MIB, float)
-        cycle = _env_list("AUTOTUNE_CYCLE_CANDIDATES_MS",
+        cycle = _env_list(envparse.AUTOTUNE_CYCLE_CANDIDATES_MS,
                           CYCLE_CANDIDATES_MS, float)
         # The bucket knob only exists on delegated (XLA data plane)
         # backends; tuning it elsewhere would burn windows on a no-op.
         if hasattr(runtime.backend, "set_min_bucket"):
-            bucket = _env_list("AUTOTUNE_BUCKET_CANDIDATES",
+            bucket = _env_list(envparse.AUTOTUNE_BUCKET_CANDIDATES,
                                BUCKET_CANDIDATES, int)
         else:
             bucket = [None]
-        self._warmup = envparse.get_int("AUTOTUNE_WARMUP_CYCLES",
+        self._warmup = envparse.get_int(envparse.AUTOTUNE_WARMUP_CYCLES,
                                         WARMUP_CYCLES)
         self._final_budget = envparse.get_int(
-            "AUTOTUNE_CYCLES_PER_CANDIDATE", CYCLES_PER_CANDIDATE)
+            envparse.AUTOTUNE_CYCLES_PER_CANDIDATE, CYCLES_PER_CANDIDATE)
         self._grid = [(int(f * 1024 * 1024), c, b)
                       for f in fusion for c in cycle for b in bucket]
         self._active = list(range(len(self._grid)))

@@ -98,6 +98,16 @@ class TcpBackend(Backend):
                        for h in host_names]
             if len(host_ids) > 1:
                 self.core.set_topology(host_of, hier)
+        # Thread-ownership contract (hvd-sanitize audit): _pending,
+        # _chaos_swallowed, _handle_arrays and _transport_dead are
+        # owned by the COORDINATOR CYCLE THREAD once it starts — every
+        # mutator (submit_entry, run_cycle/_sweep_completions,
+        # abort_inflight via _check_stalls, _fail_all) runs there.
+        # close() is the one main-thread mutator, and basics.shutdown
+        # only calls it AFTER coordinator.stop() joined the cycle
+        # thread; the synchronous Backend methods below (_sync et al.)
+        # are documented coordinator-less entry points (unit tests) and
+        # must not be mixed with a running coordinator.
         self._pending = []
         # Chaos 'backend_submit:stall' victims: never enqueued with the
         # native core, but kept reachable so an abort / transport death

@@ -89,7 +89,7 @@ def init_jax_distributed(topology):
     except AttributeError:  # older jax
         pass
     log = get_logger()
-    coord = envparse.get_str("XLA_COORD", "")
+    coord = envparse.get_str(envparse.XLA_COORD, "")
     if coord:
         log.info("xla-global: jax.distributed coordinator=%s process "
                  "%d/%d", coord, topology.rank, topology.size)
@@ -111,8 +111,7 @@ def init_jax_distributed(topology):
     # jax.distributed world, so the coordinator key must be scoped to
     # the version this cohort joined — a respawned worker reading the
     # previous cohort's coordinator would dial a dead listener.
-    import os
-    ver = os.environ.get("HVDTPU_ELASTIC_VERSION")
+    ver = envparse.get_env(envparse.ELASTIC_VERSION)
     coord_key = f"coord.{ver}" if ver is not None else "coord"
     if topology.rank == 0:
         # initialize() blocks until every process connects, so the address
@@ -158,8 +157,8 @@ def init_jax_distributed(topology):
     else:
         coord = http_client.wait_for_kv(
             addr, port, JAXDIST_SCOPE, coord_key, token=token,
-            deadline_s=float(
-                envparse.get_str("START_TIMEOUT", "120"))).decode()
+            deadline_s=envparse.get_float(
+                envparse.START_TIMEOUT, 120.0)).decode()
         log.info("xla-global: jax.distributed coordinator=%s process "
                  "%d/%d", coord, topology.rank, topology.size)
         jax.distributed.initialize(coordinator_address=coord,
@@ -198,7 +197,7 @@ class XlaGlobalBackend(TcpBackend):
         self._ps_ranks = {0: list(range(topology.size))}
         self._mesh_cache = {}
         # Delegated-plane bucket floor (autotunable; see autotune.py).
-        self.min_bucket = envparse.get_int("MIN_BUCKET", 256)
+        self.min_bucket = envparse.get_int(envparse.MIN_BUCKET, 256)
         self._fn_cache = {}
 
     def set_min_bucket(self, n):

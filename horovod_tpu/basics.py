@@ -256,6 +256,11 @@ def shutdown():
         ps_mod._teardown(_runtime)
         _runtime._shutdown = True
         _runtime = None
+        # hvd-sanitize thread-leak audit (no-op when HVDTPU_SANITIZE is
+        # off): name every non-daemon thread that survived teardown —
+        # each one keeps the interpreter from exiting.
+        from .analysis import sanitizer
+        sanitizer.audit_shutdown()
 
 
 def _maybe_dump_metrics():

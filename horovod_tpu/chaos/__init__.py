@@ -100,7 +100,7 @@ class _RuleState:
             if int(rank) != r.rank:
                 return False
         if r.wid is not None:
-            wid = ctx.get("wid") or os.environ.get("HVDTPU_WORKER_ID", "")
+            wid = ctx.get("wid") or envparse.get_str(envparse.WORKER_ID)
             if wid != r.wid:
                 return False
         if r.after_commits is not None:
@@ -198,7 +198,12 @@ def _failure_for(rule, point):
 def _execute(rule, point):
     action = rule.action
     if action == "delay":
-        time.sleep((rule.ms if rule.ms is not None else 100) / 1000.0)
+        # Injected on purpose — exempt from the hvd-sanitize blocking
+        # tripwire so a chaos run with HVDTPU_SANITIZE=1 stays quiet.
+        from ..analysis import sanitizer
+        with sanitizer.allowed("chaos delay injection"):
+            time.sleep((rule.ms if rule.ms is not None else 100)
+                       / 1000.0)
     elif action == "fail":
         raise _failure_for(rule, point)
     elif action == "hang":

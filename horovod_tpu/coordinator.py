@@ -25,6 +25,7 @@ import time
 import numpy as np
 
 from . import chaos
+from .analysis import sanitizer
 from .exceptions import DuplicateNameError, HorovodInternalError
 from .ops import reduce_ops
 from .telemetry import span as tele_span
@@ -75,6 +76,10 @@ class Handle:
         return self._event.is_set()
 
     def wait(self, timeout=None):
+        # hvd-sanitize tripwire: a wait on the cycle/watchdog thread
+        # would starve every other in-flight collective (no-op + one
+        # global read when HVDTPU_SANITIZE is off).
+        sanitizer.check_blocking("Handle.wait", self.name or "")
         if not self._event.wait(timeout):
             age = ("" if self.enqueue_time is None else
                    f"; in flight {time.monotonic() - self.enqueue_time:.1f}s"
@@ -140,7 +145,9 @@ class Coordinator:
         # the watchdog so their handles fail at the abort instead of
         # blocking a waiter forever.
         self._chaos_stalled = []
-        self._lock = threading.Lock()
+        # Instrumented under HVDTPU_SANITIZE (lock-order graph +
+        # blocking tripwire); the plain primitive otherwise.
+        self._lock = sanitizer.make_lock("coordinator.queue")
         self._wakeup = threading.Event()
         self._running = False
         self._thread = None
@@ -247,12 +254,33 @@ class Coordinator:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
-        if self._running:
-            return
-        self._running = True
-        self._thread = threading.Thread(
-            target=self._loop, name="hvd-tpu-coordinator", daemon=True)
-        self._thread.start()
+        # start() and stop() may run on different threads (elastic
+        # reset vs. user shutdown). Two hazards closed here: (a) an
+        # unguarded read-then-set of _running reviving a coordinator
+        # mid-teardown, so the flag flips under the lock like stop()'s
+        # does; (b) a start() racing a just-issued stop() re-raising
+        # _running before the OLD cycle thread observed False — it
+        # would then never exit and TWO cycle threads would dispatch
+        # concurrently. So the previous thread is drained first. The
+        # new thread is created AND started inside the critical
+        # section so a concurrent stop() never joins a stale or
+        # not-yet-started thread object; the cycle thread only touches
+        # self._lock from its loop body, so holding the lock across
+        # start() cannot deadlock.
+        with self._lock:
+            if self._running:
+                return
+            prev = self._thread
+        if prev is not None:
+            prev.join(timeout=10)
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, name="hvd-tpu-coordinator",
+                daemon=True)
+            self._thread.start()
         if (self._order_guard is not None
                 and getattr(self.runtime, "mode", None) == "spmd"
                 and self.runtime.topology.size > 1):
@@ -378,19 +406,29 @@ class Coordinator:
 
     # -- background cycle --------------------------------------------------
     def _loop(self):
-        backend = self.runtime.backend
-        if getattr(backend, "drives_own_cycle", False):
-            self._loop_native(backend)
-            return
-        while self._running:
-            self._wakeup.wait(timeout=0.25)
-            self._wakeup.clear()
-            if not self._running:
-                break
-            time.sleep(self.cycle_time_s)
-            self._run_cycle()
-            if self.stall_warn_s > 0 or self._watchdog is not None:
-                self._check_stalls()
+        # The cycle thread paces the whole data plane (and runs the
+        # watchdog scans from _check_stalls), so any blocking call on
+        # it is a finding for the sanitize tripwire. Unmarked on exit:
+        # thread idents are recycled, and a stale entry would smear
+        # "collective-critical" onto an unrelated later thread across
+        # elastic stop/start cycles.
+        sanitizer.mark_critical("coordinator-cycle")
+        try:
+            backend = self.runtime.backend
+            if getattr(backend, "drives_own_cycle", False):
+                self._loop_native(backend)
+                return
+            while self._running:
+                self._wakeup.wait(timeout=0.25)
+                self._wakeup.clear()
+                if not self._running:
+                    break
+                time.sleep(self.cycle_time_s)
+                self._run_cycle()
+                if self.stall_warn_s > 0 or self._watchdog is not None:
+                    self._check_stalls()
+        finally:
+            sanitizer.unmark_critical()
 
     def _loop_native(self, backend):
         """SPMD mode: the native core owns negotiation and fusion — local

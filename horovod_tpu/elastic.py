@@ -62,8 +62,8 @@ class State:
         self._reset_callbacks = []
         self._last_check = 0.0
         self._commits = 0
-        self._check_interval = float(
-            os.environ.get("HVDTPU_ELASTIC_CHECK_INTERVAL", "0.2"))
+        self._check_interval = envparse.get_float(
+            envparse.ELASTIC_CHECK_INTERVAL, 0.2)
 
     def register_reset_callbacks(self, callbacks):
         """Callbacks run after a reset (new world size), e.g. to rescale
@@ -158,7 +158,7 @@ TpuState = ObjectState
 
 
 def _joined_version():
-    return int(os.environ.get("HVDTPU_ELASTIC_VERSION", "-1"))
+    return envparse.get_int(envparse.ELASTIC_VERSION, -1)
 
 
 def _reset():
@@ -298,7 +298,7 @@ def _persist_state(state):
     from .runner import http_client
     from .runner import rendezvous as rdv
     cfg = rdv.rendezvous_config()
-    wid = os.environ.get("HVDTPU_WORKER_ID", "")
+    wid = envparse.get_str(envparse.WORKER_ID)
     if cfg is None or not wid:
         raise HorovodInternalError(
             "persisting elastic state requires the hvdrun launcher's "
@@ -351,7 +351,7 @@ def _maybe_restore_persisted(state, log):
     from .runner import http_client
     from .runner import rendezvous as rdv
     cfg = rdv.rendezvous_config()
-    wid = os.environ.get("HVDTPU_WORKER_ID", "")
+    wid = envparse.get_str(envparse.WORKER_ID)
     if cfg is None or not wid:
         return
     addr, port, token = cfg

@@ -114,6 +114,19 @@ class SubmissionOrderError(RuntimeError):
     retrying into the same divergence forever."""
 
 
+class LockOrderError(RuntimeError):
+    """hvd-sanitize detected a lock-acquisition-order cycle: acquiring
+    this lock while holding another reverses an order recorded earlier
+    in the process, so two threads interleaving the two paths can
+    deadlock (ABBA). The message carries BOTH acquisition stacks — the
+    current one and the first recorded reverse-order one
+    (``HVDTPU_SANITIZE``; analysis/sanitizer.py, docs/lint.md).
+
+    Deliberately NOT a ``HorovodInternalError``: like
+    ``SubmissionOrderError``, a lock-order inversion is a deterministic
+    program bug — elastic retry would deadlock (or trip) again."""
+
+
 class ChaosInjectedError(RuntimeError):
     """A chaos ``fail`` injection fired at a point with no more specific
     error type (``HVDTPU_CHAOS``; docs/fault_tolerance.md). KV points

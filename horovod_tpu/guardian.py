@@ -38,9 +38,9 @@ check (the telemetry/chaos disabled-guard contract).
 """
 
 import json
-import threading
 import time
 
+from .analysis import sanitizer
 from .exceptions import CollectiveMismatchError
 from .ops import reduce_ops
 from .telemetry import core as telemetry
@@ -68,7 +68,7 @@ def _m_mismatches():
 # ---------------------------------------------------------------------------
 
 _INPROC_TABLE = {}
-_INPROC_LOCK = threading.Lock()
+_INPROC_LOCK = sanitizer.make_lock("guardian.inproc")
 
 
 def _reset_inproc():
@@ -112,10 +112,13 @@ class KVBoard:
     def put(self, key, value):
         from .runner import http_client
         try:
-            http_client.put_kv(self._addr, self._port, self._scope, key,
-                               value, token=self._token,
-                               retries=self.RETRIES,
-                               deadline=self.DEADLINE_S)
+            # Deliberately bounded I/O on the cycle thread (short retry
+            # budget above): exempt from the sanitize tripwire.
+            with sanitizer.allowed("guardian board put (bounded)"):
+                http_client.put_kv(self._addr, self._port, self._scope,
+                                   key, value, token=self._token,
+                                   retries=self.RETRIES,
+                                   deadline=self.DEADLINE_S)
         except Exception as exc:  # noqa: BLE001 — advisory plane
             self._log.warning("guardian: board put %s failed: %s", key,
                               exc)
@@ -123,10 +126,12 @@ class KVBoard:
     def get(self, key):
         from .runner import http_client
         try:
-            raw = http_client.get_kv(self._addr, self._port, self._scope,
-                                     key, token=self._token,
-                                     retries=self.RETRIES,
-                                     deadline=self.DEADLINE_S)
+            with sanitizer.allowed("guardian board get (bounded)"):
+                raw = http_client.get_kv(self._addr, self._port,
+                                         self._scope, key,
+                                         token=self._token,
+                                         retries=self.RETRIES,
+                                         deadline=self.DEADLINE_S)
         except Exception as exc:  # noqa: BLE001 — advisory plane
             self._log.warning("guardian: board get %s failed: %s", key,
                               exc)
@@ -137,8 +142,7 @@ class KVBoard:
 def _board_scope():
     """One board scope per elastic membership version, so a fresh cohort
     never reads the previous cohort's digests or abort notice."""
-    import os
-    ver = os.environ.get("HVDTPU_ELASTIC_VERSION", "0")
+    ver = envparse.get_str(envparse.ELASTIC_VERSION, "0")
     return f"guardian.{ver}"
 
 
@@ -240,7 +244,7 @@ class ConsistencyGuard:
         self._poll_s = poll_s
         self._seq = 0
         self._occ = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("guardian.consistency")
         self._log = get_logger()
 
     # -- submit side (framework threads) -----------------------------------

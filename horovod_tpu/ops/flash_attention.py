@@ -25,7 +25,6 @@ test suite exercises the exact kernel logic on the CPU mesh.
 """
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +33,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils import envparse
 
 _bridge_fallback_noted = set()
 
@@ -59,7 +59,7 @@ def bridge_flash_enabled():
     the kernel is a python-level grid loop — correct but slow, so the
     CPU test suite keeps the einsum lowerings unless it opts in via
     HVDTPU_BRIDGE_FLASH=always)."""
-    mode = os.environ.get("HVDTPU_BRIDGE_FLASH", "auto").lower()
+    mode = envparse.get_str(envparse.BRIDGE_FLASH, "auto").lower()
     if mode == "always":
         return True
     if mode == "never":

@@ -48,10 +48,12 @@ class RayExecutor:
         class _Worker:
             def bootstrap(self, rank, task_args, extra_env):
                 import os
+
+                from horovod_tpu.utils import envparse
                 os.environ.update(extra_env)
                 n, addr, port, token, timeout = task_args
                 cluster_task_bootstrap(rank, n, addr, port, token, timeout)
-                return os.environ["HVDTPU_RANK"]
+                return envparse.get_str(envparse.RANK)
 
             def execute(self, fn, args, kwargs):
                 return fn(*args, **kwargs)

@@ -148,7 +148,7 @@ def start_worker_heartbeat():
         return _worker_thread
     from . import rendezvous as rdv
     cfg = rdv.rendezvous_config()
-    worker_id = os.environ.get("HVDTPU_WORKER_ID", "")
+    worker_id = envparse.get_str(envparse.WORKER_ID)
     if cfg is None or not worker_id:
         return None
     addr, port, token = cfg

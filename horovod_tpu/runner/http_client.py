@@ -29,6 +29,7 @@ import time
 import urllib.error
 import urllib.request
 
+from ..analysis import sanitizer
 from ..chaos import inject as _chaos_inject
 from ..telemetry import core as telemetry
 from ..utils import envparse
@@ -74,6 +75,11 @@ def _url(addr, port, scope, key):
 
 
 def _request(method, url, data=None, token="", timeout=10):
+    # hvd-sanitize tripwire: every KV verb funnels through this one
+    # urlopen, so a collective-critical thread doing store I/O (outside
+    # an explicitly bounded sanitizer.allowed() scope, e.g. the
+    # guardian board's short-budget calls) is flagged here.
+    sanitizer.check_blocking("urlopen", url)
     req = urllib.request.Request(url, data=data, method=method)
     if token:
         req.add_header(AUTH_HEADER, token)

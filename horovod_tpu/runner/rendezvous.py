@@ -44,7 +44,7 @@ def rendezvous_config():
     port = envparse.get_int(envparse.RENDEZVOUS_PORT, 0)
     if not addr or not port:
         return None
-    token = os.environ.get("HVDTPU_JOB_TOKEN", "")
+    token = envparse.get_str(envparse.JOB_TOKEN)
     return addr, port, token
 
 
@@ -61,11 +61,11 @@ def bootstrap_peers(topology, deadline_s=None, scope=None, my_addr=None):
             "(the hvdrun launcher does this) or provide HVDTPU_PEERS")
     addr, port, token = cfg
     if deadline_s is None:
-        deadline_s = float(os.environ.get("HVDTPU_START_TIMEOUT", "120"))
+        deadline_s = envparse.get_float(envparse.START_TIMEOUT, 120.0)
     if scope is None:
         # Elastic re-rendezvous uses one peer scope per membership version
         # so stale addresses from a previous epoch can never mix in.
-        version = os.environ.get("HVDTPU_ELASTIC_VERSION")
+        version = envparse.get_env(envparse.ELASTIC_VERSION)
         scope = f"{PEER_SCOPE}.{version}" if version else PEER_SCOPE
 
     if my_addr is None:
@@ -116,16 +116,16 @@ def elastic_bootstrap(deadline_s=None):
             "elastic mode requires the hvdrun launcher's rendezvous "
             "(HVDTPU_RENDEZVOUS_ADDR/PORT)")
     addr, port, token = cfg
-    worker_id = os.environ.get("HVDTPU_WORKER_ID")
+    worker_id = envparse.get_env(envparse.WORKER_ID)
     if not worker_id:
         raise RuntimeError("elastic worker is missing HVDTPU_WORKER_ID")
     if deadline_s is None:
-        deadline_s = float(os.environ.get("HVDTPU_START_TIMEOUT", "120"))
+        deadline_s = envparse.get_float(envparse.START_TIMEOUT, 120.0)
     deadline = time.monotonic() + deadline_s
     # A re-init always follows a membership event, so the driver will have
     # bumped (or is about to bump) the version — joining the version we
     # were already part of would dial a dead cohort's listeners.
-    prev = os.environ.get("HVDTPU_ELASTIC_VERSION")
+    prev = envparse.get_env(envparse.ELASTIC_VERSION)
     min_version = int(prev) + 1 if prev is not None else 0
     if min_version > current_elastic_version(addr, port, token):
         # Ask the driver to re-rendezvous: a transport failure with no

@@ -6,10 +6,12 @@ import os
 import pickle
 import sys
 
+from ..utils import envparse
+
 
 def main():
     payload_path, out_dir = sys.argv[1], sys.argv[2]
-    rank = int(os.environ.get("HVDTPU_RANK", "0"))
+    rank = envparse.get_int(envparse.RANK, 0)
     with open(payload_path, "rb") as f:
         func, args, kwargs = pickle.load(f)
     result = func(*args, **kwargs)

@@ -131,7 +131,15 @@ def store_snapshots(server):
 
 class MetricsPusher:
     """Daemon thread pushing snapshots on an interval; one final push on
-    stop so shutdown-time counters (elastic restarts) reach the driver."""
+    stop so shutdown-time counters (elastic restarts) reach the driver.
+
+    Thread-ownership contract (hvd-sanitize audit): every attribute is
+    set in __init__ before start() and never reassigned — the roll-up
+    thread only READS them, so no lock is needed. The one deliberate
+    overlap: stop() joins with a timeout, so a push wedged in the KV
+    client can still be mid-flight while stop() issues the final push;
+    both write the same per-rank key, so last-writer-wins is correct
+    (and _push swallows transport errors either way)."""
 
     def __init__(self, addr, port, token, rank,
                  interval_s=DEFAULT_PUSH_INTERVAL_S):

@@ -25,6 +25,7 @@ import math
 import threading
 import time
 
+from ..analysis import sanitizer
 from ..utils import envparse
 
 
@@ -81,6 +82,10 @@ class _Child:
     __slots__ = ("_lock", "_value", "_bounds", "_counts", "_sum")
 
     def __init__(self, bounds=None):
+        # Leaf lock, deliberately uninstrumented: one per labeled
+        # series on the hottest paths, held for a scalar update, and
+        # nothing is ever acquired under it — it cannot participate in
+        # an ordering cycle.
         self._lock = threading.Lock()
         self._value = 0.0
         self._bounds = bounds
@@ -143,7 +148,7 @@ class MetricFamily:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._children = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("telemetry.family")
 
     def _new_child(self):
         return _Child()
@@ -219,7 +224,7 @@ class Registry:
 
     def __init__(self):
         self._families = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("telemetry.registry")
 
     def _get_or_create(self, cls, name, help, labelnames, **kwargs):
         with self._lock:

@@ -24,7 +24,6 @@ class Timeline:
         self._thread = None
         self._running = False
         self._file = None
-        self._pids = {}
         # Optional device-side story: a jax.profiler trace alongside the
         # host timeline (the SURVEY-stated TPU equivalent of NVTX ranges,
         # reference: nvtx_op_range.cc — on TPU the profiler's TraceMe/xplane
@@ -97,18 +96,21 @@ class Timeline:
 
     # -- writer thread -----------------------------------------------------
     # ``first`` is a writer-local [bool] (is the next event the file's
-    # first?), not instance state: a straggler writer from a previous
-    # session must not corrupt this session's JSON comma placement.
+    # first?) and ``pids`` a writer-local name->tid map — NOT instance
+    # state: a straggler writer from a previous session draining its
+    # own queue must not corrupt this session's JSON comma placement,
+    # and two writers sharing one tid dict would race its inserts
+    # (the HVD301-shaped handoff bug this file used to have).
     def _emit(self, file, event, first):
         if not first[0]:
             file.write(",\n")
         first[0] = False
         file.write(json.dumps(event))
 
-    def _emit_item(self, file, item, first):
+    def _emit_item(self, file, item, first, pids):
         phase, names, activity, ts_us = item
         for name in names:
-            tid = self._pids.setdefault(name, len(self._pids) + 1)
+            tid = pids.setdefault(name, len(pids) + 1)
             if phase == "I":
                 self._emit(file, {"name": activity, "ph": "i",
                                   "ts": ts_us, "pid": 0, "tid": tid,
@@ -123,15 +125,19 @@ class Timeline:
         """Drain-then-flush loop: one blocking get, then everything the
         producers queued meanwhile, then ONE flush for the whole drain —
         a busy cycle emitting hundreds of events pays one syscall, not
-        one per event. Ends (and closes the file) at the stop sentinel."""
+        one per event. Ends (and closes the file) at the stop sentinel.
+        Everything mutable here (file, queue, first, pids) is owned by
+        THIS writer: start() hands the new writer its own file+queue,
+        so a timed-out predecessor can finish without sharing state."""
         first = [True]
+        pids = {}
         try:
             stop = False
             while not stop:
                 item = q.get()
                 if item is None:
                     break
-                self._emit_item(file, item, first)
+                self._emit_item(file, item, first, pids)
                 while True:
                     try:
                         item = q.get_nowait()
@@ -140,7 +146,7 @@ class Timeline:
                     if item is None:
                         stop = True
                         break
-                    self._emit_item(file, item, first)
+                    self._emit_item(file, item, first, pids)
                 file.flush()
         finally:
             try:

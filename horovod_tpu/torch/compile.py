@@ -32,9 +32,10 @@ itself has).
 
 import math
 import operator
-import os
 
 import numpy as np
+
+from ..utils import envparse
 
 _op_setitem = operator.setitem
 
@@ -167,11 +168,11 @@ def _sdpa(rng_key, train, q=None, k=None, v=None, attn_mask=None,
                 rate = float(dropout_p)
                 mask_bytes = 2 * q.shape[0] * q.shape[1] \
                     * q.shape[2] * k.shape[2]
-                limit = int(os.environ.get(
-                    "HVDTPU_FLASH_DROPOUT_MASK_LIMIT",
-                    str(128 * 1024 * 1024)))
-                mode = os.environ.get("HVDTPU_FLASH_DROPOUT",
-                                      "auto").lower()
+                limit = envparse.get_int(
+                    envparse.FLASH_DROPOUT_MASK_LIMIT,
+                    128 * 1024 * 1024)
+                mode = envparse.get_str(envparse.FLASH_DROPOUT,
+                                        "auto").lower()
                 use_mask = (mode == "mask"
                             or _interpret()
                             or (mode == "auto" and mask_bytes <= limit))

@@ -66,69 +66,181 @@ def get_str(name, default=""):
     return default if val is None else val
 
 
-# Canonical knob names (subset of reference common.h:107-141, plus TPU-native ones)
-FUSION_THRESHOLD = "FUSION_THRESHOLD"          # bytes, default 128 MiB
-CYCLE_TIME = "CYCLE_TIME"                      # ms, default 1.0
-CACHE_CAPACITY = "CACHE_CAPACITY"              # default 1024
-TIMELINE = "TIMELINE"                          # path to chrome-trace json
-TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"  # instant event per cycle
-LOG_LEVEL = "LOG_LEVEL"
-STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
-STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
-STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
+# --------------------------------------------------------------------------
+# Knob registry
+#
+# Every *user-facing* configuration knob is declared through register()
+# so the registry and docs/knobs.md can be cross-checked mechanically
+# (hvd-lint --check-knobs / --self, rule HVD306): a knob added here
+# without a docs row — or a docs row naming a knob nobody registered —
+# is a finding. Raw `os.environ` reads of HVDTPU_*/HOROVOD_* names
+# elsewhere in the package are a finding too (rule HVD304): they bypass
+# both the prefix fallback above and this registry.
+# --------------------------------------------------------------------------
+
+#: name (without prefix) -> {"default": str, "doc": str}
+KNOBS = {}
+
+
+def register(name, default, doc):
+    """Declare a user-facing knob; returns ``name`` so declarations
+    double as the module-level constants call sites import."""
+    KNOBS[name] = {"default": default, "doc": doc}
+    return name
+
+
+# -- runtime / coordination (subset of reference common.h:107-141) ---------
+FUSION_THRESHOLD = register(
+    "FUSION_THRESHOLD", "128 MiB",
+    "Max bytes fused into one collective bucket (tensor fusion)")
+CYCLE_TIME = register(
+    "CYCLE_TIME", "1.0 ms", "Coordinator cycle period")
+CACHE_CAPACITY = register(
+    "CACHE_CAPACITY", "1024", "Native response-cache entries")
+HIERARCHICAL_THRESHOLD = register(
+    "HIERARCHICAL_THRESHOLD", "1 MiB",
+    "Min buffer bytes before multi-host collectives take the two-level "
+    "intra-host/cross-host path; 0 disables")
+MIN_BUCKET = register(
+    "MIN_BUCKET", "256",
+    "Delegated (XLA) plane: floor for collective bucket sizes, elements")
+CPU_OPERATIONS = register(
+    "CPU_OPERATIONS", "tcp", "SPMD data plane: 'tcp' | 'xla'")
+LOG_LEVEL = register(
+    "LOG_LEVEL", "warning", "trace/debug/info/warning/error")
+TIMELINE = register(
+    "TIMELINE", "", "Write a chrome-trace JSON to this path")
+TIMELINE_MARK_CYCLES = register(
+    "TIMELINE_MARK_CYCLES", "off",
+    "Instant event per negotiation cycle")
+
+# -- stall / failure detection ---------------------------------------------
+STALL_CHECK_DISABLE = register(
+    "STALL_CHECK_DISABLE", "0", "Disable the stall inspector")
+STALL_CHECK_TIME_SECONDS = register(
+    "STALL_CHECK_TIME_SECONDS", "60",
+    "Native-plane stall warning threshold (SPMD negotiation stalls)")
+STALL_SHUTDOWN_TIME_SECONDS = register(
+    "STALL_SHUTDOWN_TIME_SECONDS", "0",
+    "Escalate a native-plane stall to job shutdown")
 # Short spelling for the coordinator's stall warning (documented as
 # HOROVOD_TPU_STALL_CHECK_TIME); falls back to STALL_CHECK_TIME_SECONDS.
-STALL_CHECK_TIME = "STALL_CHECK_TIME"
-# Submission-order guard (documented as HOROVOD_TPU_ORDER_CHECK): hash
-# the per-cycle tensor-name submission sequence, cross-check across
-# ranks in SPMD mode, record it otherwise (analysis/order_guard.py).
-ORDER_CHECK = "ORDER_CHECK"
-ORDER_CHECK_RECORD = "ORDER_CHECK_RECORD"      # JSON dump path for sequences
-ORDER_CHECK_INTERVAL = "ORDER_CHECK_INTERVAL"  # seconds between cross-checks
-# Restore the pre-lint process-global auto-name counter
-# ("<kind>.noname.<n>"), which can diverge across ranks when submission
-# interleaving differs (see ops/collectives.py _auto_name).
-LEGACY_AUTO_NAMES = "LEGACY_AUTO_NAMES"
-AUTOTUNE = "AUTOTUNE"
-AUTOTUNE_LOG = "AUTOTUNE_LOG"
-# Metrics plane (documented as HOROVOD_TPU_METRICS*): enable the
-# telemetry registry + hot-path instrumentation; push per-rank snapshots
-# to the driver KV store every PUSH_INTERVAL seconds; write a final JSON
-# snapshot to DUMP on shutdown (see docs/metrics.md).
-METRICS = "METRICS"
-METRICS_PUSH_INTERVAL = "METRICS_PUSH_INTERVAL"
-METRICS_DUMP = "METRICS_DUMP"
-# Min buffer bytes before allreduce takes the two-level intra-host/
-# cross-host path on multi-host jobs; 0 disables (reference knob analog:
-# HOROVOD_HIERARCHICAL_ALLREDUCE).
-HIERARCHICAL_THRESHOLD = "HIERARCHICAL_THRESHOLD"
-ELASTIC = "ELASTIC"
-# Fault injection + control-plane hardening (docs/fault_tolerance.md):
-# chaos spec grammar in chaos/spec.py; KV client retry/backoff knobs;
-# worker heartbeat lease + driver liveness timeout; SIGTERM->SIGKILL
-# escalation deadline for workers that ignore a stop request.
-CHAOS = "CHAOS"
-CHAOS_LOG = "CHAOS_LOG"
-KV_RETRIES = "KV_RETRIES"
-KV_BACKOFF = "KV_BACKOFF"
-KV_DEADLINE = "KV_DEADLINE"
-HEARTBEAT_INTERVAL = "HEARTBEAT_INTERVAL"
-HEARTBEAT_TIMEOUT = "HEARTBEAT_TIMEOUT"
-SIGKILL_DEADLINE = "SIGKILL_DEADLINE"
-# Data-plane guardian (guardian.py; docs/fault_tolerance.md):
-# cross-rank metadata digests before dispatch (0 off, 1 every named
-# collective, N>1 sampled every Nth submission), peer-digest wait
-# deadline, and the stuck-collective watchdog's abort timeout
-# (0 disables the abort; the stall warning alone remains).
-CONSISTENCY_CHECK = "CONSISTENCY_CHECK"
-CONSISTENCY_TIMEOUT = "CONSISTENCY_TIMEOUT"
-COLLECTIVE_TIMEOUT = "COLLECTIVE_TIMEOUT"
-# Crash-safe checkpoints (checkpoint.py): keep only the newest N
-# step_<N> checkpoints after each save_step (0 = keep everything).
-CHECKPOINT_KEEP = "CHECKPOINT_KEEP"
+STALL_CHECK_TIME = register(
+    "STALL_CHECK_TIME", "60",
+    "Coordinator stall warning: one periodic summary when submitted "
+    "collectives stay in flight this long")
 
-# Launcher-set topology env (analog of HOROVOD_RANK/SIZE/...; reference:
-# horovod/runner/gloo_run.py:65-77)
+# -- correctness checking (hvd-lint; docs/lint.md) -------------------------
+ORDER_CHECK = register(
+    "ORDER_CHECK", "0",
+    "Submission-order guard: hash the tensor-name submission stream, "
+    "cross-check across ranks in SPMD mode (analysis/order_guard.py)")
+ORDER_CHECK_RECORD = register(
+    "ORDER_CHECK_RECORD", "",
+    "Dump the recorded submission sequence as JSON on shutdown")
+ORDER_CHECK_INTERVAL = register(
+    "ORDER_CHECK_INTERVAL", "5", "Seconds between SPMD digest checks")
+LEGACY_AUTO_NAMES = register(
+    "LEGACY_AUTO_NAMES", "0",
+    "Restore the process-global auto-name counter (<kind>.noname.<n>)")
+SANITIZE = register(
+    "SANITIZE", "0",
+    "hvd-sanitize runtime layer: lock-order deadlock detection, "
+    "blocking-call tripwire on collective-critical threads, shutdown "
+    "thread-leak audit (analysis/sanitizer.py)")
+
+# -- autotune ---------------------------------------------------------------
+AUTOTUNE = register(
+    "AUTOTUNE", "0", "Enable the successive-halving parameter sweep")
+AUTOTUNE_LOG = register(
+    "AUTOTUNE_LOG", "", "CSV of per-round candidate scores")
+AUTOTUNE_FUSION_CANDIDATES_MIB = register(
+    "AUTOTUNE_FUSION_CANDIDATES_MIB", "0..128", "Fusion-threshold grid")
+AUTOTUNE_CYCLE_CANDIDATES_MS = register(
+    "AUTOTUNE_CYCLE_CANDIDATES_MS", "0.1..10", "Cycle-time grid")
+AUTOTUNE_BUCKET_CANDIDATES = register(
+    "AUTOTUNE_BUCKET_CANDIDATES", "256,4096,65536",
+    "Delegated-plane bucket floors")
+AUTOTUNE_WARMUP_CYCLES = register(
+    "AUTOTUNE_WARMUP_CYCLES", "10", "Active cycles before scoring")
+AUTOTUNE_CYCLES_PER_CANDIDATE = register(
+    "AUTOTUNE_CYCLES_PER_CANDIDATE", "20",
+    "Scoring budget of the final halving round")
+
+# -- metrics plane (docs/metrics.md) ---------------------------------------
+METRICS = register(
+    "METRICS", "0", "Enable the telemetry registry + instrumentation")
+METRICS_PUSH_INTERVAL = register(
+    "METRICS_PUSH_INTERVAL", "5",
+    "Seconds between per-rank snapshot pushes to the driver KV store")
+METRICS_DUMP = register(
+    "METRICS_DUMP", "", "Final JSON snapshot path written at shutdown")
+
+# -- fault tolerance / chaos (docs/fault_tolerance.md) ---------------------
+ELASTIC = register(
+    "ELASTIC", "0",
+    "Elastic worker mode: ranks come from the driver's rendezvous "
+    "store, not launcher env (set by hvdrun --min-np/--max-np)")
+ELASTIC_CHECK_INTERVAL = register(
+    "ELASTIC_CHECK_INTERVAL", "0.2",
+    "Seconds between elastic host-update checks at commit boundaries")
+START_TIMEOUT = register(
+    "START_TIMEOUT", "120",
+    "Seconds workers wait at rendezvous for the full cohort "
+    "(hvdrun --start-timeout)")
+CHAOS = register(
+    "CHAOS", "",
+    "Fault-injection spec (point:action[:param]*; validate: hvd-chaos)")
+CHAOS_LOG = register(
+    "CHAOS_LOG", "", "Append one line per chaos firing to this file")
+KV_RETRIES = register(
+    "KV_RETRIES", "8", "KV client: max retries per call")
+KV_BACKOFF = register(
+    "KV_BACKOFF", "0.05", "KV client: initial backoff seconds")
+KV_DEADLINE = register(
+    "KV_DEADLINE", "30", "KV client: overall per-call deadline seconds")
+HEARTBEAT_INTERVAL = register(
+    "HEARTBEAT_INTERVAL", "2",
+    "Worker: seconds between heartbeat lease renewals")
+HEARTBEAT_TIMEOUT = register(
+    "HEARTBEAT_TIMEOUT", "30",
+    "Driver: fail a worker whose lease stops changing for this long")
+SIGKILL_DEADLINE = register(
+    "SIGKILL_DEADLINE", "10",
+    "Driver: seconds between SIGTERM and SIGKILL on worker stop")
+CONSISTENCY_CHECK = register(
+    "CONSISTENCY_CHECK", "0",
+    "Data-plane guardian: cross-rank metadata digest check "
+    "(0 off, 1 every named collective, N>1 sampled)")
+CONSISTENCY_TIMEOUT = register(
+    "CONSISTENCY_TIMEOUT", "10",
+    "Seconds the pre-dispatch check waits for peer digests")
+COLLECTIVE_TIMEOUT = register(
+    "COLLECTIVE_TIMEOUT", "0",
+    "Stuck-collective watchdog: coordinated abort past this age; 0 off")
+CHECKPOINT_KEEP = register(
+    "CHECKPOINT_KEEP", "0",
+    "Keep only the newest N step_<N> checkpoints; 0 keeps everything")
+
+# -- kernels ----------------------------------------------------------------
+BRIDGE_FLASH = register(
+    "BRIDGE_FLASH", "auto",
+    "Route torch/TF bridge attention through the flash kernel: "
+    "auto (TPU only) | always | never")
+FLASH_DROPOUT = register(
+    "FLASH_DROPOUT", "auto",
+    "Flash-attention dropout strategy: auto | mask | prng")
+FLASH_DROPOUT_MASK_LIMIT = register(
+    "FLASH_DROPOUT_MASK_LIMIT", "128 MiB",
+    "Max bernoulli keep-mask bytes before auto falls back to the "
+    "on-chip prng path")
+
+# --------------------------------------------------------------------------
+# Launcher-set variables (analog of HOROVOD_RANK/SIZE/...; reference:
+# horovod/runner/gloo_run.py:65-77). NOT registered: they are outputs
+# the launcher exports for its workers, not knobs a user tunes — the
+# registry/docs cross-check covers knobs only.
+# --------------------------------------------------------------------------
 RANK = "RANK"
 SIZE = "SIZE"
 LOCAL_RANK = "LOCAL_RANK"
@@ -139,4 +251,7 @@ PEERS = "PEERS"                                # "host:port,..." one per rank
 RENDEZVOUS_ADDR = "RENDEZVOUS_ADDR"            # analog of HOROVOD_GLOO_RENDEZVOUS_ADDR
 RENDEZVOUS_PORT = "RENDEZVOUS_PORT"
 CONTROLLER = "CONTROLLER"                      # 'tcp' | 'loopback'
-CPU_OPERATIONS = "CPU_OPERATIONS"              # 'tcp' | 'xla'
+WORKER_ID = "WORKER_ID"                        # elastic slot identity
+ELASTIC_VERSION = "ELASTIC_VERSION"            # membership version joined
+JOB_TOKEN = "JOB_TOKEN"                        # KV-store auth token
+XLA_COORD = "XLA_COORD"                        # jax.distributed coordinator

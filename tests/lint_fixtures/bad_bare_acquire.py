@@ -1,0 +1,22 @@
+"""HVD302 fixture: acquire with no try/finally release in the scope —
+an exception in compute() leaks the lock forever. The second function
+shows the accepted explicit pattern (and `with` is always fine)."""
+
+import threading
+
+LOCK = threading.Lock()
+
+
+def leaky(compute):
+    LOCK.acquire()
+    out = compute()
+    LOCK.release()
+    return out
+
+
+def careful(compute):
+    LOCK.acquire()
+    try:
+        return compute()
+    finally:
+        LOCK.release()

@@ -1,0 +1,21 @@
+"""HVD303 fixture: unbounded blocking calls (urlopen, a timeout-less
+wait) inside a cycle-loop thread body and a method it calls."""
+
+import threading
+from urllib.request import urlopen
+
+
+class CycleDriver:
+    def __init__(self):
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="demo-cycle-driver",
+                                        daemon=True)
+
+    def _loop(self):
+        while True:
+            urlopen("http://coordinator/status")
+            self._publish()
+
+    def _publish(self):
+        self._done.wait()

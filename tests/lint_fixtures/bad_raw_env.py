@@ -1,0 +1,9 @@
+"""HVD304 fixture: raw os.environ reads of framework knobs (writes and
+non-framework names are exempt)."""
+
+import os
+
+interval = float(os.environ.get("HVDTPU_SOME_INTERVAL", "1.0"))
+token = os.environ["HOROVOD_TPU_SOME_TOKEN"]
+editor = os.environ.get("EDITOR", "vi")        # not a framework knob
+os.environ["HVDTPU_PEERS"] = "localhost:1234"  # write: launcher export

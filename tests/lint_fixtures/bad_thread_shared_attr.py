@@ -1,0 +1,20 @@
+"""HVD301 fixture: `self.count` is written by the thread target and by
+a method called from other threads, with no lock on either side."""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0

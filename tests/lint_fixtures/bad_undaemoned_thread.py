@@ -1,0 +1,16 @@
+"""HVD305 fixture: threads with neither daemon=True nor any visible
+join()/.daemon = True path."""
+
+import threading
+
+
+def fire_and_forget(work):
+    threading.Thread(target=work).start()
+
+
+class Keeper:
+    def __init__(self, work):
+        self._thread = threading.Thread(target=work)
+
+    def start(self):
+        self._thread.start()

@@ -1,0 +1,39 @@
+"""Clean concurrency fixture: every HVD30x-negative pattern in one
+file — locked shared writes, with-statement locks, bounded blocking
+calls, daemon threads, and a joined non-daemon thread."""
+
+import threading
+import time
+
+from horovod_tpu.utils import envparse
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="good-cycle-worker",
+                                        daemon=True)
+
+    def _loop(self):
+        while not self._stop.wait(timeout=0.1):
+            with self._lock:
+                self.count += 1
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def run_batch(work):
+    t = threading.Thread(target=work)
+    t.start()
+    time.sleep(0.01)
+    t.join()
+    return envparse.get_float("SOME_INTERVAL", 1.0)

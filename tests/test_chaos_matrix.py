@@ -279,6 +279,37 @@ def test_corrupted_latest_checkpoint_falls_back_and_resumes(tmp_path,
         chaos.reset()
 
 
+def test_sanitizer_quiet_under_chaos(tmp_path):
+    """(g) hvd-sanitize rides a faulted elastic job: workers run with
+    HVDTPU_SANITIZE=1 (instrumented locks, blocking tripwire, leak
+    audit) AND the consistency guard doing board I/O on the cycle
+    thread, while chaos injects a collective failure. The sanitizer
+    must neither deadlock the run nor false-positive: recovery
+    completes as in row (bonus), with zero LockOrderError and zero
+    blocking-call findings in any worker's stderr (the guardian's
+    bounded board calls ride sanitizer.allowed())."""
+    marker = tmp_path / "sanitize.marker"
+    rc, driver, log_path, chaos_log = _run_chaos_job(
+        tmp_path,
+        f"collective:fail:name=step3:rank=1:marker={marker}",
+        capture_output=True,
+        HVDTPU_SANITIZE="1",
+        HVDTPU_CONSISTENCY_CHECK="1",
+        ELASTIC_TEST_EPOCHS=6, ELASTIC_TEST_EPOCH_SLEEP=0.3)
+    content = _log_content(log_path)
+    assert rc == 0, content
+    assert marker.exists()
+    done = [line for line in content.splitlines() if "DONE" in line]
+    assert len(done) == 2, content
+    entries = _parse_log(log_path)
+    assert max(e[1] for e in entries) == 5
+    stderr = _captured_stderr(tmp_path)
+    assert "LockOrderError" not in stderr, stderr
+    assert "hvd-sanitize: blocking call" not in stderr, stderr
+    assert "hvd-sanitize:" not in stderr or \
+        "non-daemon thread" not in stderr, stderr
+
+
 def test_collective_failure_injection_recovers(tmp_path):
     """Bonus row: an injected collective failure (the 'collective'
     point raising HorovodInternalError once, on rank 1's epoch-3

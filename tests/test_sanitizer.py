@@ -1,0 +1,489 @@
+"""hvd-sanitize: the runtime concurrency sanitizer (lock-order graph,
+blocking-call tripwire, thread-leak audit, NULL disabled mode), the
+HVD301–305 static rules over the fixture corpus, the knob registry
+cross-check (HVD306), and the `hvd-lint --self` self-analysis sweep
+that pins horovod_tpu/ itself clean.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.analysis import ast_lint, sanitizer
+from horovod_tpu.exceptions import LockOrderError
+from horovod_tpu.utils import envparse
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "horovod_tpu")
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+KNOB_DOCS = os.path.join(REPO, "docs", "knobs.md")
+
+
+def rules_of(diags):
+    return sorted(d.rule for d in diags)
+
+
+@pytest.fixture
+def sanitize_on(monkeypatch):
+    monkeypatch.setenv("HVDTPU_SANITIZE", "1")
+    sanitizer.reset()
+    yield sanitizer
+    monkeypatch.delenv("HVDTPU_SANITIZE")
+    sanitizer.reset()   # restores time.sleep and drops graph state
+
+
+@pytest.fixture
+def sanitize_off(monkeypatch):
+    monkeypatch.delenv("HVDTPU_SANITIZE", raising=False)
+    monkeypatch.delenv("HOROVOD_TPU_SANITIZE", raising=False)
+    sanitizer.reset()
+    yield sanitizer
+    sanitizer.reset()
+
+
+# ==========================================================================
+# Runtime layer: lock-order graph
+# ==========================================================================
+class TestLockOrder:
+    def test_abba_cycle_names_both_stacks(self, sanitize_on):
+        """Acceptance: a deterministic two-thread ABBA fixture. Thread 1
+        nests A->B (recording the order); thread 2 then nests B->A and
+        must get LockOrderError BEFORE blocking, with both acquisition
+        stacks in the message."""
+        A = sanitizer.make_lock("fixture.A")
+        B = sanitizer.make_lock("fixture.B")
+        recorded = threading.Event()
+
+        def first():
+            with A:
+                with B:
+                    pass
+            recorded.set()
+
+        caught = []
+
+        def second():
+            recorded.wait(5)
+            try:
+                with B:
+                    with A:
+                        pass
+            except LockOrderError as exc:
+                caught.append(exc)
+
+        t1 = threading.Thread(target=first, name="abba-t1")
+        t2 = threading.Thread(target=second, name="abba-t2")
+        t1.start()
+        t1.join(5)
+        t2.start()
+        t2.join(5)
+        assert caught, "LockOrderError did not fire on the ABBA cycle"
+        msg = str(caught[0])
+        assert "'fixture.A'" in msg and "'fixture.B'" in msg
+        # Both stacks, each attributed to its thread.
+        assert "current acquisition (thread 'abba-t2')" in msg
+        assert "first recorded 'fixture.B' -> 'fixture.A'" in msg \
+            or "first recorded 'fixture.A' -> 'fixture.B'" in msg
+        assert "thread 'abba-t1'" in msg
+        assert msg.count("in first") >= 1 and msg.count("in second") >= 1
+
+    def test_correct_order_still_works_after_abba_error(self,
+                                                        sanitize_on):
+        """The offending reverse edge must NOT be recorded when the
+        cycle raises — otherwise the graph is poisoned and the
+        LEGITIMATE order raises forever after the first offender."""
+        A = sanitizer.make_lock("poison.A")
+        B = sanitizer.make_lock("poison.B")
+        with A:
+            with B:
+                pass
+        with pytest.raises(LockOrderError):
+            with B:
+                with A:
+                    pass
+        with A:       # the legitimate order keeps working
+            with B:
+                pass
+
+    def test_consistent_order_is_quiet(self, sanitize_on):
+        A = sanitizer.make_lock("ord.A")
+        B = sanitizer.make_lock("ord.B")
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        assert not [f for f in sanitizer.findings()]
+
+    def test_reentrant_rlock_is_not_a_cycle(self, sanitize_on):
+        R = sanitizer.make_rlock("reent.R")
+        with R:
+            with R:
+                pass  # same object: reentrancy, not ordering
+
+    def test_same_named_sibling_locks_flagged(self, sanitize_on):
+        """Two instances of one lock class nesting under each other have
+        no instance order — flagged like a cycle."""
+        l1 = sanitizer.make_lock("pool.slot")
+        l2 = sanitizer.make_lock("pool.slot")
+        with pytest.raises(LockOrderError):
+            with l1:
+                with l2:
+                    pass
+
+    def test_nonblocking_try_acquire_is_exempt_and_clean(self,
+                                                         sanitize_on):
+        """acquire(blocking=False) is the deadlock-AVOIDANCE pattern:
+        no order check (a reverse-order try is legitimate) and no edge
+        recorded (a failed try must not poison the graph)."""
+        A = sanitizer.make_lock("try.A")
+        B = sanitizer.make_lock("try.B")
+        with A:
+            with B:
+                pass
+        with B:
+            assert A.acquire(blocking=False)  # reverse order: no raise
+            A.release()
+        with A:       # and the legitimate order is unpoisoned
+            with B:
+                pass
+
+    def test_condition_wraps_tracked_rlock(self, sanitize_on):
+        cond = sanitizer.make_condition("cv.test")
+        with cond:
+            cond.notify_all()
+        # wait() exercises _release_save/_acquire_restore delegation
+        with cond:
+            assert cond.wait(timeout=0.01) is False
+
+
+# ==========================================================================
+# Runtime layer: blocking-call tripwire + thread-leak audit
+# ==========================================================================
+class TestTripwire:
+    def _run_on_fake_cycle_thread(self, fn):
+        def body():
+            sanitizer.mark_critical("fake-cycle")
+            try:
+                fn()
+            finally:
+                sanitizer.unmark_critical()
+        t = threading.Thread(target=body, name="fake-cycle")
+        t.start()
+        t.join(10)
+
+    def test_flags_sleep_and_wait_on_critical_thread(self, sanitize_on):
+        def body():
+            time.sleep(sanitizer.SLEEP_ALLOWANCE_S + 0.05)
+            sanitizer.check_blocking("Handle.wait", "grad.0")
+        self._run_on_fake_cycle_thread(body)
+        whats = [f.what for f in sanitizer.findings()]
+        assert any(w.startswith("time.sleep") for w in whats), whats
+        assert any("Handle.wait" in w for w in whats), whats
+        assert all("fake-cycle" in f.thread
+                   for f in sanitizer.findings())
+
+    def test_pacing_sleep_and_allowed_scope_are_exempt(self, sanitize_on):
+        def body():
+            time.sleep(0.001)  # cycle pacing: under the allowance
+            with sanitizer.allowed("bounded board I/O"):
+                sanitizer.check_blocking("urlopen", "http://kv/x")
+        self._run_on_fake_cycle_thread(body)
+        assert sanitizer.findings() == []
+
+    def test_critical_mark_is_released_on_thread_exit(self,
+                                                      sanitize_on):
+        """Loop bodies unmark in a finally: thread idents are recycled,
+        so a stale entry would smear 'critical' onto a later unrelated
+        thread (elastic stop/start cycles)."""
+        self._run_on_fake_cycle_thread(lambda: None)
+        state = sanitizer._state()
+        assert state._critical == {}, state._critical
+
+    def test_non_critical_thread_is_exempt(self, sanitize_on):
+        sanitizer.check_blocking("urlopen", "http://kv/y")
+        time.sleep(0.001)
+        assert sanitizer.findings() == []
+
+    def test_handle_wait_tripwire_is_wired(self, sanitize_on):
+        """coordinator.Handle.wait goes through check_blocking."""
+        from horovod_tpu.coordinator import Handle
+
+        def body():
+            h = Handle("tripwire.op")
+            h._complete(42)
+            assert h.wait(timeout=1) == 42
+        self._run_on_fake_cycle_thread(body)
+        assert any("Handle.wait" in f.what
+                   for f in sanitizer.findings())
+
+    def test_thread_leak_audit_names_non_daemon_threads(self,
+                                                        sanitize_on):
+        release = threading.Event()
+        leak = threading.Thread(target=release.wait, name="leaky-worker",
+                                daemon=False)
+        leak.start()
+        try:
+            leaks = sanitizer.audit_shutdown()
+            assert "leaky-worker" in leaks
+            assert any(f.kind == "thread-leak"
+                       and "leaky-worker" in f.what
+                       for f in sanitizer.findings())
+        finally:
+            release.set()
+            leak.join(5)
+
+    def test_daemon_threads_pass_the_audit(self, sanitize_on):
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, name="daemon-ok",
+                             daemon=True)
+        t.start()
+        try:
+            assert "daemon-ok" not in sanitizer.audit_shutdown()
+        finally:
+            release.set()
+            t.join(5)
+
+
+# ==========================================================================
+# Disabled mode: the NULL guard (zero instrumentation)
+# ==========================================================================
+class TestDisabledGuard:
+    def test_factories_return_plain_primitives(self, sanitize_off):
+        assert not sanitizer.enabled()
+        plain_lock_t = type(threading.Lock())
+        plain_rlock_t = type(threading.RLock())
+        assert type(sanitizer.make_lock("x")) is plain_lock_t
+        assert type(sanitizer.make_rlock("x")) is plain_rlock_t
+        assert type(sanitizer.make_condition("x")) is threading.Condition
+
+    def test_time_sleep_is_unpatched(self, sanitize_off):
+        assert not getattr(time.sleep, "__hvd_sanitize__", False)
+
+    def test_guards_are_noops_and_nothing_accumulates(self, sanitize_off):
+        sanitizer.mark_critical("anything")
+        sanitizer.check_blocking("urlopen", "http://x")
+        time.sleep(0.001)
+        sanitizer.unmark_critical()
+        assert sanitizer.audit_shutdown() == []
+        assert sanitizer.findings() == []
+
+    def test_enable_then_disable_restores_sleep(self, monkeypatch):
+        monkeypatch.setenv("HVDTPU_SANITIZE", "1")
+        sanitizer.reset()
+        assert sanitizer.enabled()
+        assert getattr(time.sleep, "__hvd_sanitize__", False)
+        monkeypatch.delenv("HVDTPU_SANITIZE")
+        sanitizer.reset()
+        assert not sanitizer.enabled()
+        assert not getattr(time.sleep, "__hvd_sanitize__", False)
+
+
+# ==========================================================================
+# Static layer: HVD301–305 fixture corpus
+# ==========================================================================
+class TestConcurrencyRules:
+    def lint(self, name):
+        return ast_lint.lint_file(os.path.join(FIXTURES, name))
+
+    def test_shared_attr_fixture(self):
+        diags = self.lint("bad_thread_shared_attr.py")
+        assert rules_of(diags) == ["HVD301"]
+        assert "self.count" in diags[0].message
+
+    def test_bare_acquire_fixture(self):
+        diags = self.lint("bad_bare_acquire.py")
+        assert rules_of(diags) == ["HVD302"]
+        # the try/finally variant in the same file is NOT flagged
+        assert diags[0].line < 15
+
+    def test_blocking_loop_fixture(self):
+        diags = self.lint("bad_blocking_loop.py")
+        assert rules_of(diags) == ["HVD303", "HVD303"]
+        msgs = " ".join(d.message for d in diags)
+        assert "urlopen" in msgs and "wait" in msgs
+
+    def test_raw_env_fixture(self):
+        diags = self.lint("bad_raw_env.py")
+        assert rules_of(diags) == ["HVD304", "HVD304"]
+
+    def test_undaemoned_thread_fixture(self):
+        assert rules_of(self.lint("bad_undaemoned_thread.py")) == \
+            ["HVD305", "HVD305"]
+
+    def test_clean_threading_fixture(self):
+        assert self.lint("good_threading.py") == []
+
+    def test_suppression_applies_to_hvd3xx(self):
+        src = ("import os\n"
+               "x = os.environ.get('HVDTPU_FOO')"
+               "  # hvd-lint: disable=HVD304\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_locked_writes_are_clean(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self.n = 0\n"
+               "        self._t = threading.Thread(target=self._loop,\n"
+               "                                   daemon=True)\n"
+               "    def _loop(self):\n"
+               "        with self._lock:\n"
+               "            self.n += 1\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self.n = 0\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_bounded_calls_in_loops_are_clean(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._stop = threading.Event()\n"
+               "        self._t = threading.Thread(\n"
+               "            target=self._loop, name='x-heartbeat',\n"
+               "            daemon=True)\n"
+               "    def _loop(self):\n"
+               "        while not self._stop.wait(timeout=1.0):\n"
+               "            self._stop.wait(0.1)\n")
+        assert ast_lint.lint_source(src) == []
+
+
+# ==========================================================================
+# Knob registry <-> docs cross-check (HVD306)
+# ==========================================================================
+class TestKnobRegistry:
+    def test_registry_matches_docs(self):
+        diags = ast_lint.check_knob_docs(KNOB_DOCS)
+        assert diags == [], "\n".join(d.format() for d in diags)
+
+    def test_detects_drift_both_ways(self, tmp_path, monkeypatch):
+        doc = tmp_path / "knobs.md"
+        rows = [f"| `HVDTPU_{name}` | {meta['default'] or '—'} | x |"
+                for name, meta in sorted(envparse.KNOBS.items())
+                if name != "SANITIZE"]
+        rows.append("| `HVDTPU_IMAGINARY_KNOB` | x | x |")
+        doc.write_text("\n".join(rows) + "\n")
+        diags = ast_lint.check_knob_docs(str(doc))
+        msgs = " ".join(d.message for d in diags)
+        assert rules_of(diags) == ["HVD306", "HVD306"]
+        assert "SANITIZE" in msgs            # registered, undocumented
+        assert "IMAGINARY_KNOB" in msgs      # documented, unregistered
+
+    def test_detects_default_mismatch(self, tmp_path):
+        """The registered default is CHECKED data: a docs row whose
+        default cell disagrees with register() is HVD306."""
+        doc = tmp_path / "knobs.md"
+        rows = []
+        for name, meta in sorted(envparse.KNOBS.items()):
+            default = ("999999" if name == "KV_RETRIES"
+                       else meta["default"] or "—")
+            rows.append(f"| `HVDTPU_{name}` | {default} | x |")
+        doc.write_text("\n".join(rows) + "\n")
+        diags = ast_lint.check_knob_docs(str(doc))
+        assert rules_of(diags) == ["HVD306"]
+        assert "KV_RETRIES" in diags[0].message
+        assert "999999" in diags[0].message
+
+    def test_default_normalization_accepts_equivalents(self):
+        from horovod_tpu.analysis.ast_lint import _norm_default
+        assert _norm_default("0 (off)") == _norm_default("0")
+        assert _norm_default("—") == _norm_default("")
+
+    def test_previously_raw_knobs_are_registered(self):
+        for name in ("SANITIZE", "ELASTIC_CHECK_INTERVAL",
+                     "START_TIMEOUT", "BRIDGE_FLASH", "FLASH_DROPOUT",
+                     "FLASH_DROPOUT_MASK_LIMIT"):
+            assert name in envparse.KNOBS, name
+            assert envparse.KNOBS[name]["doc"]
+
+    def test_registered_knobs_resolve_through_prefixes(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_ELASTIC_CHECK_INTERVAL", "3.5")
+        assert envparse.get_float(envparse.ELASTIC_CHECK_INTERVAL,
+                                  0.2) == 3.5
+
+
+# ==========================================================================
+# Self-analysis: horovod_tpu/ must hold to its own rules (tier-1)
+# ==========================================================================
+def test_self_sweep_clean():
+    """Acceptance: every rule over the whole package + the knob-docs
+    cross-check, zero findings."""
+    diags = ast_lint.lint_paths([PKG]) + ast_lint.check_knob_docs(
+        KNOB_DOCS)
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+def test_coordinator_restart_runs_exactly_one_cycle_thread():
+    """stop() then start() must drain the old cycle thread before
+    spawning the new one — a revived old loop would double-dispatch."""
+    import types
+
+    from horovod_tpu.coordinator import Coordinator
+    runtime = types.SimpleNamespace(
+        topology=types.SimpleNamespace(rank=0, size=1),
+        mode="single", backend=None, timeline=None, autotuner=None)
+    coord = Coordinator(runtime)
+    coord.start()
+    first = coord._thread
+    coord.stop()
+    coord.start()
+    try:
+        # The old thread was drained BEFORE the new one spawned (other
+        # coordinators may live in this process, so assert on THIS
+        # coordinator's threads, not the global enumeration).
+        assert coord._thread is not first
+        assert not first.is_alive()
+        assert coord._thread.is_alive()
+    finally:
+        coord.stop()
+
+
+def _run_cli(*args):
+    from conftest import clean_spawn_env
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.cli", *args],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_self_flag_runs_clean():
+    proc = _run_cli("--self")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_check_knobs_only():
+    proc = _run_cli("--check-knobs")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_knobs_md_implies_check(tmp_path):
+    """--knobs-md PATH without --check-knobs must still read the file
+    (a named file the user expects to be validated), and an unreadable
+    explicit path is a finding, not a silent green."""
+    proc = _run_cli("--knobs-md", str(tmp_path / "missing.md"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "cannot read knob docs" in proc.stdout
+
+
+def test_cli_detects_hvd3xx_in_fixtures():
+    proc = _run_cli(FIXTURES, "--format", "json", "--fail-on", "warning")
+    assert proc.returncode == 1
+    import json as _json
+    found = {d["rule"] for d in _json.loads(proc.stdout)}
+    assert {"HVD301", "HVD302", "HVD303", "HVD304",
+            "HVD305"} <= found, found
+
+
+def test_list_rules_includes_hvd3xx():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("HVD301", "HVD302", "HVD303", "HVD304", "HVD305",
+                 "HVD306"):
+        assert rule in proc.stdout

@@ -414,11 +414,11 @@ def test_timeline_flushes_once_per_drain(tmp_path):
     first = [True]
     orig = tl._emit_item
 
-    def gated(file, item, fst):
+    def gated(file, item, *rest):
         if first[0]:
             first[0] = False
             hold.wait(10)
-        orig(file, item, fst)
+        orig(file, item, *rest)
 
     tl._emit_item = gated
     tl.start()
@@ -450,9 +450,9 @@ def test_timeline_stop_race_free_when_join_times_out(tmp_path):
     hold = threading.Event()
     orig = tl._emit_item
 
-    def blocked(file, item, fst):
+    def blocked(file, item, *rest):
         hold.wait(10)
-        orig(file, item, fst)
+        orig(file, item, *rest)
 
     tl._emit_item = blocked
     tl.start()
@@ -480,9 +480,9 @@ def test_timeline_restart_while_old_writer_straggles(tmp_path):
     hold = threading.Event()
     orig = tl._emit_item
 
-    def blocked(file, item, fst):
+    def blocked(file, item, *rest):
         hold.wait(10)
-        orig(file, item, fst)
+        orig(file, item, *rest)
 
     tl._emit_item = blocked
     tl.start()
