@@ -90,7 +90,7 @@ def _bench_resnet(hvd, hvd_jax, on_tpu):
 
 
 def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
-                       metric=None):
+                       metric=None, compression=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -120,7 +120,15 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, seq), jnp.int32))
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    opt = hvd_jax.DistributedOptimizer(optax.adamw(1e-4))
+    # --compression sweep: the gradient collectives inside the train
+    # step run the block-quantized EQuARX pipeline (docs/compression.md)
+    # — this is the direct attack on the gradient-bytes half of the
+    # transformer gap (ROADMAP items 1 + 5).
+    comp = (getattr(hvd.Compression, compression)
+            if compression else None)
+    opt = hvd_jax.DistributedOptimizer(
+        optax.adamw(1e-4),
+        **({"compression": comp} if comp is not None else {}))
 
     def loss_fn(p, b):
         x, y = b
@@ -153,7 +161,7 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
     # 6N per token (fwd+bwd matmuls) + attention's 12*L*s*h quadratic term.
     flops_per_tok = 6 * n_params + 12 * cfg.layers * seq * cfg.hidden
     mfu = tok_s * flops_per_tok / V5E_BF16_PEAK
-    return {
+    out = {
         "metric": metric or ("transformer_lm_365m_seq512_train_samples"
                              "_per_sec_per_chip"),
         "value": round(per_chip, 2),
@@ -162,6 +170,23 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
         # MFU against the v5e bf16 peak instead (module docstring).
         "vs_baseline": round(mfu, 3),
     }
+    if compression:
+        # Wire-format accounting for the gradient collectives: the
+        # in-jit pipeline cannot touch host counters, so the ratio is
+        # computed from the codec's wire layout (payload + per-block
+        # scales) against the fp32 gradient bytes — BENCH_r* records
+        # the gradient-bytes delta next to the samples/s delta.
+        from horovod_tpu.compression import codecs as _codecs
+        from horovod_tpu.utils import envparse as _envparse
+        block = _envparse.get_int(_envparse.COMPRESSION_BLOCK,
+                                  _codecs.DEFAULT_BLOCK)
+        grad_bytes = n_params * 4
+        wire_bytes = _codecs.CODECS[compression].wire_bytes(
+            n_params, block, 4)
+        out["compression"] = compression
+        out["compression_ratio"] = round(wire_bytes / grad_bytes, 4)
+        out["grad_bytes_saved_per_step"] = int(grad_bytes - wire_bytes)
+    return out
 
 
 def _bench_keras(hvd, on_tpu):
@@ -446,6 +471,18 @@ def main():
                 return
 
     emit(_bench_transformer, hvd, hvd_jax, on_tpu)
+    # --compression: sweep the transformer line across codecs so
+    # BENCH_r* records the gradient-bytes delta (the `none` point is
+    # the headline transformer line just emitted). int8 always; fp8
+    # when the jax build carries it.
+    if "--compression" in sys.argv:
+        from horovod_tpu.compression import codecs as _codecs
+        sweep = ["int8"] + (["fp8"] if _codecs.fp8_supported() else [])
+        for codec in sweep:
+            emit(_bench_transformer, hvd, hvd_jax, on_tpu,
+                 compression=codec, required=False,
+                 metric=f"transformer_lm_365m_seq512_compression_"
+                        f"{codec}_train_samples_per_sec_per_chip")
     # Long-context line: seq 2048 is where the einsum path cannot run at
     # all (27G logits > 15.75G HBM) and the flash kernel carries it.
     # TPU-only: off-TPU the small stand-in config would rerun the same

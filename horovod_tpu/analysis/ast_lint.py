@@ -27,6 +27,11 @@ a static finding. Three rules:
   BARRIER (or broadcast to) every rank internally, so guarding them
   with ``if hvd.rank() == 0:`` means the other ranks never reach the
   barrier — the classic non-root-only checkpointing deadlock.
+- **HVD205** (warning) — a lossy compressor (``Compression.fp16/bf16/
+  int8/fp8``) on a broadcast/initial-sync collective, or on a visibly
+  integer/bool tensor: compression exists for gradient reduction only
+  (reference semantics); state sync must be exact and counts/masks
+  have no lossy representation.
 
 The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
 analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
@@ -113,6 +118,23 @@ DIST_OPT_CALLS = frozenset({
 CHECKPOINT_CALLS = frozenset({
     "save", "save_step", "restore", "restore_latest",
 })
+# Lossy members of the Compression surface (ops/compression.py): wire
+# quantizers plus the narrowing casts. Reference semantics: compression
+# exists for gradient REDUCTION — state sync (broadcast) must be exact,
+# and integer/bool payloads have no meaningful lossy representation
+# (rule HVD205).
+LOSSY_COMPRESSORS = frozenset({"fp16", "bf16", "int8", "fp8"})
+SYNC_COLLECTIVE_CALLS = frozenset({
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "broadcast_object",
+})
+# Attribute names that mark an integer/bool tensor expression
+# (dtype=jnp.int32, x.astype(np.bool_), torch.int64, ...).
+_INTY_DTYPE_ATTRS = frozenset({
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "bool_", "bool", "long",
+})
 # Presence of any of these identifiers means initial-state sync happens
 # through a channel HVD202 should not second-guess.
 _SYNC_MARKERS = frozenset({
@@ -170,6 +192,7 @@ class _Analyzer(ast.NodeVisitor):
         self.dist_opt_node = None
         self.has_broadcast = False
         self.uses_elastic = False
+        self.int_names = set()      # names assigned integer-looking values
         self._flagged = set()       # id(call) already reported
 
     # -- imports -----------------------------------------------------------
@@ -359,6 +382,90 @@ class _Analyzer(ast.NodeVisitor):
                 self._report_204(call, "while")
         self.generic_visit(node)
 
+    # -- HVD205: lossy compression misuse ----------------------------------
+    @staticmethod
+    def _lossy_compression_kw(call):
+        """Name of the lossy Compression member passed as
+        ``compression=`` (``Compression.int8`` / ``hvd.Compression.fp16``
+        / a bare imported alias), or None."""
+        for kw in call.keywords:
+            if kw.arg != "compression":
+                continue
+            if isinstance(kw.value, (ast.Attribute, ast.Name)):
+                term = _terminal_name(kw.value)
+                if term in LOSSY_COMPRESSORS:
+                    return term
+        return None
+
+    @staticmethod
+    def _expr_is_inty(expr):
+        """Integer/bool evidence inside one expression: an int/bool
+        dtype attribute or a randint construction."""
+        for n in ast.walk(expr):
+            if (isinstance(n, ast.Attribute)
+                    and n.attr in _INTY_DTYPE_ATTRS):
+                return True
+            if (isinstance(n, ast.Call)
+                    and _terminal_name(n.func) == "randint"):
+                return True
+        return False
+
+    def _looks_integer_tensor(self, expr):
+        """True when the tensor expression is visibly integer/bool
+        (:meth:`_expr_is_inty`) or names a variable previously assigned
+        one (one-hop local dataflow — visit_Assign records those)."""
+        if self._expr_is_inty(expr):
+            return True
+        return any(isinstance(n, ast.Name) and n.id in self.int_names
+                   for n in ast.walk(expr))
+
+    def visit_Assign(self, node):
+        # One-hop dataflow for HVD205: `labels = ...int32...` marks the
+        # NAME, so a later `allreduce(labels, compression=...)` is
+        # recognizable. Reassignment from a float-looking value clears
+        # the mark (last write wins, like the interpreter).
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if names:
+            inty = self._expr_is_inty(node.value)
+            for name in names:
+                if inty:
+                    self.int_names.add(name)
+                else:
+                    self.int_names.discard(name)
+        self.generic_visit(node)
+
+    def _report_205(self, call, comp, why):
+        self._flagged.add(id(call))
+        fn = _terminal_name(call.func)
+        self.diags.append(Diagnostic.make(
+            "HVD205",
+            f"lossy compressor `Compression.{comp}` on `{fn}`: {why}",
+            file=self.filename, line=call.lineno,
+            hint="compression is for gradient reduction "
+                 "(allreduce/grouped_allreduce of float gradients) "
+                 "only — drop the compression= argument here; "
+                 + _DOC_HINT))
+
+    def _check_205(self, node):
+        comp = self._lossy_compression_kw(node)
+        if comp is None or id(node) in self._flagged:
+            return
+        term = _terminal_name(node.func)
+        if (term in SYNC_COLLECTIVE_CALLS
+                and self._is_hvd_call(node, SYNC_COLLECTIVE_CALLS)):
+            self._report_205(
+                node, comp,
+                "broadcast/initial-sync collectives must be exact — a "
+                "lossy wire format would start ranks from divergent "
+                "(and silently different) state")
+        elif (self._is_collective(node) and node.args
+                and self._looks_integer_tensor(node.args[0])):
+            self._report_205(
+                node, comp,
+                "the tensor is integer/bool, which has no meaningful "
+                "lossy representation (counts and masks corrupt "
+                "silently)")
+
     def visit_Call(self, node):
         term = _terminal_name(node.func)
         if term == "init" and self._is_hvd_call(node, {"init"}):
@@ -368,6 +475,7 @@ class _Analyzer(ast.NodeVisitor):
                 self.dist_opt_node = node
         elif term in BROADCAST_STATE_CALLS:
             self.has_broadcast = True
+        self._check_205(node)
         self.generic_visit(node)
 
     def visit_Attribute(self, node):

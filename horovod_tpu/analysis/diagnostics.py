@@ -29,6 +29,9 @@ RULES = {
                         "control flow"),
     "HVD204": (ERROR, "checkpoint save/restore call guarded by a rank "
                       "condition (they barrier/broadcast internally)"),
+    "HVD205": (WARNING, "lossy compressor applied to an integer/bool "
+                        "tensor or a broadcast/initial-sync collective "
+                        "(compression is for gradient reduction only)"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "

@@ -222,6 +222,113 @@ class XlaSingleBackend(Backend):
         return adasum.adasum_allreduce_stacked(
             self, arrays, process_set, prescale, postscale)
 
+    # -- quantized allreduce (EQuARX pipeline) -----------------------------
+    @_timed("allreduce_quantized")
+    def allreduce_quantized(self, arrays, op, process_set, codec, block,
+                            prescale=None, postscale=None,
+                            residuals=None):
+        """Block-quantized fused allreduce: quantize → all_to_all (the
+        reduce-scatter leg, wire dtype) → dequantized f32 accumulation →
+        requantize → all_gather (wire dtype again) → dequantize. Both
+        collective legs carry ~1 byte/value + one f32 scale per
+        ``block`` instead of the input dtype's width (PAPERS.md: EQuARX,
+        arXiv:2506.17615).
+
+        ``residuals`` (error feedback, optional): f32 arrays aligned
+        with ``arrays``; each is added to the (prescaled) input before
+        quantization, and the call returns ``(outs, new_residuals)``
+        where ``new_residuals[i] = input_i - dequant(quant(input_i))``
+        — the quantization debt to carry into the next step. With
+        ``residuals=None`` the second element is None.
+
+        Only Sum/Average are supported: dequantize-then-accumulate is a
+        linear-reduction identity; Min/Max/Product have no wide-dtype
+        reduce stage (the policy never routes them here)."""
+        if op not in (reduce_ops.Sum, reduce_ops.Average):
+            raise ValueError(
+                "quantized allreduce supports Sum/Average, got "
+                f"{reduce_ops.op_name(op)}")
+        mesh = self._mesh(process_set)
+        n = mesh.devices.size
+        ef = residuals is not None
+        key = ("arq", process_set.process_set_id, op, codec.name,
+               int(block), ef)
+
+        def build():
+            from ..compression.codecs import padded_len
+
+            def pipeline(flats, post):
+                """flats: list of f32 per-rank flat vectors (residual
+                already folded in). Returns (reduced flats, local
+                quantization errors)."""
+                sizes = [f.shape[0] for f in flats]
+                flat = (jnp.concatenate(flats) if len(flats) > 1
+                        else flats[0])
+                total = flat.shape[0]
+                padded = padded_len(total, n, block)
+                if padded != total:
+                    flat = jnp.pad(flat, (0, padded - total))
+                rows = flat.reshape(n, padded // n)
+                q, s = codec.encode(rows, block)
+                # Local reconstruction error BEFORE the exchange — the
+                # residual each virtual rank carries forward.
+                err = (rows - codec.decode(q, s, block)).reshape(padded)
+                q = lax.all_to_all(q, AXIS, split_axis=0, concat_axis=0,
+                                   tiled=True)
+                s = lax.all_to_all(s, AXIS, split_axis=0, concat_axis=0,
+                                   tiled=True)
+                red = jnp.sum(codec.decode(q, s, block), axis=0)
+                if op == reduce_ops.Average:
+                    red = red / n
+                red = _scale(red, post)
+                q2, s2 = codec.encode(red, block)
+                qg = lax.all_gather(q2, AXIS, tiled=True)
+                sg = lax.all_gather(s2, AXIS, tiled=True)
+                out = codec.decode(qg, sg, block)
+                outs, errs, off = [], [], 0
+                for size in sizes:
+                    outs.append(out[off:off + size])
+                    errs.append(err[off:off + size])
+                    off += size
+                return outs, errs
+
+            def body(scales, xs, es):
+                pre, post = scales
+                flats = []
+                for i, x in enumerate(xs):
+                    f = _scale(x.reshape(-1).astype(jnp.float32), pre)
+                    if es is not None:
+                        f = f + es[i].reshape(-1)
+                    flats.append(f)
+                outs, errs = pipeline(flats, post)
+                res, out_errs = [], []
+                for x, o, err in zip(xs, outs, errs):
+                    res.append(o.reshape(x.shape).astype(x.dtype))
+                    out_errs.append(err.reshape(x.shape))
+                if es is None:
+                    return tuple(res)
+                return tuple(res), tuple(out_errs)
+
+            in_specs = ((P(), P(AXIS), P(AXIS)) if ef
+                        else (P(), P(AXIS), None))
+            sm = _shard_map(body, mesh=mesh, in_specs=in_specs,
+                            out_specs=P(AXIS))
+            return jax.jit(sm)
+
+        fn = self._cached(key, build)
+        pre = jnp.asarray(1.0 if prescale is None else prescale,
+                          dtype=jnp.float32)
+        post = jnp.asarray(1.0 if postscale is None else postscale,
+                           dtype=jnp.float32)
+        ins = tuple(self.shard(process_set, jnp.asarray(a))
+                    for a in arrays)
+        if ef:
+            res_in = tuple(self.shard(process_set, jnp.asarray(r))
+                           for r in residuals)
+            outs, errs = fn((pre, post), ins, res_in)
+            return list(outs), list(errs)
+        return list(fn((pre, post), ins, None)), None
+
     # -- allgather ---------------------------------------------------------
     @_timed("allgather")
     def allgather(self, arrays, process_set):

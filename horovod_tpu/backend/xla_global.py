@@ -199,6 +199,20 @@ class XlaGlobalBackend(TcpBackend):
         # Delegated-plane bucket floor (autotunable; see autotune.py).
         self.min_bucket = envparse.get_int(envparse.MIN_BUCKET, 256)
         self._fn_cache = {}
+        # Gradient compression on the delegated plane: only the env
+        # policy's catch-all wire rule applies (fused native responses
+        # carry handles, not tensor names — no globs, no error
+        # feedback; docs/compression.md). Every rank parses the same
+        # env, so the selection is identical cluster-wide.
+        from ..compression.policy import simple_wire_policy
+        (self._q_codec, self._q_block,
+         self._q_threshold) = simple_wire_policy()
+        if self._q_codec is not None:
+            get_logger().info(
+                "xla-global: quantized allreduce enabled (codec=%s "
+                "block=%d threshold=%d; no error feedback on the "
+                "delegated plane)", self._q_codec, self._q_block,
+                self._q_threshold)
 
     def set_min_bucket(self, n):
         """Autotune hook: floor for collective bucket sizes (elements).
@@ -298,7 +312,35 @@ class XlaGlobalBackend(TcpBackend):
         from jax.sharding import PartitionSpec as P
         lax = jax.lax
 
-        if kind.startswith("allreduce"):
+        if kind == "qallreduce":
+            # EQuARX pipeline over the global mesh: quantize →
+            # all_to_all (the reduce-scatter leg, wire dtype) → f32
+            # accumulate → requantize → all_gather → dequantize. The
+            # caller pads n to a multiple of nprocs * block and only
+            # routes float SUM reductions here (docs/compression.md).
+            op, post, codec_name, block = extra
+            from ..compression.codecs import CODECS
+            codec = CODECS[codec_name]
+            nprocs = int(mesh.devices.size)
+            import jax.numpy as jnp
+
+            def body(x):  # x: (1, n) local block, n % (nprocs*block)==0
+                rows = x[0].astype(jnp.float32).reshape(nprocs, -1)
+                q, s = codec.encode(rows, block)
+                q = lax.all_to_all(q, "hvd", split_axis=0,
+                                   concat_axis=0, tiled=True)
+                s = lax.all_to_all(s, "hvd", split_axis=0,
+                                   concat_axis=0, tiled=True)
+                red = jnp.sum(codec.decode(q, s, block), axis=0)
+                if post != 1.0:
+                    red = red * np.asarray(post, dtype=red.dtype)
+                q2, s2 = codec.encode(red, block)
+                qg = lax.all_gather(q2, "hvd", tiled=True)
+                sg = lax.all_gather(s2, "hvd", tiled=True)
+                return codec.decode(qg, sg, block,
+                                    dtype=x.dtype)[None]
+            out_specs = P()
+        elif kind.startswith("allreduce"):
             op, post = extra
             def body(x):  # x: (1, n) local block; prescale applied by caller
                 if op == _RED_SUM:
@@ -383,10 +425,26 @@ class XlaGlobalBackend(TcpBackend):
                                      dtype=dtype))
         flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
         n = int(flat.shape[0])
-        fn = self._collective(
-            mesh, "allreduce", _bucket(n, self.min_bucket), dtype,
-            (op, float(d["postscale"])))
-        out = self._run_stacked(mesh, fn, _pad(flat, _bucket(n, self.min_bucket), op))[0]
+        if (self._q_codec is not None and op == _RED_SUM
+                and np.dtype(dtype).kind == "f"
+                and n >= self._q_threshold):
+            # Quantized pipeline (env policy catch-all; __init__ note).
+            # Pad the power-of-two bucket up to a whole number of
+            # blocks per rank; zero padding is SUM-neutral.
+            from ..compression.codecs import padded_len
+            pn = padded_len(_bucket(n, self.min_bucket),
+                            int(mesh.devices.size), self._q_block)
+            fn = self._collective(
+                mesh, "qallreduce", pn, dtype,
+                (op, float(d["postscale"]), self._q_codec,
+                 self._q_block))
+            out = self._run_stacked(mesh, fn, _pad(flat, pn, op))[0]
+        else:
+            fn = self._collective(
+                mesh, "allreduce", _bucket(n, self.min_bucket), dtype,
+                (op, float(d["postscale"])))
+            out = self._run_stacked(
+                mesh, fn, _pad(flat, _bucket(n, self.min_bucket), op))[0]
         off = 0
         for h, nelem in zip(d["handles"], sizes):
             nelem = int(nelem)

@@ -1,0 +1,224 @@
+"""Gradient compression plane (ISSUE 6; ROADMAP item 1 — EQuARX).
+
+First-class subsystem behind the ``HVDTPU_COMPRESSION`` knob family:
+
+- :mod:`codecs` — block-wise int8/fp8 quantization (per-block scales)
+  and the none/fp16/bf16 casts behind one jit-traceable ``Codec``
+  interface, plus the in-jit :func:`codecs.quantized_allreduce_axis`.
+- :mod:`residual` — the error-feedback store (tensor name × elastic
+  version; reset whenever the joined version moves).
+- :mod:`policy` — per-tensor selection: size threshold, dtype, name
+  globs; loud Adasum / process-set rejects.
+- :class:`CompressionPlane` — what the coordinator holds: stamps
+  entries at submit time (so the guardian digest and the fusion
+  grouping both see the selected codec), hands residuals to the
+  backend's quantized pipeline, and feeds the telemetry metrics
+  (``hvd_compression_ratio`` / ``_bytes_saved_total`` / ``_error``).
+
+Disabled contract (the telemetry/chaos/guardian standard): with
+``HVDTPU_COMPRESSION`` unset, :func:`make_plane` returns ``None`` — the
+coordinator's submit path pays one attribute check and allocates
+nothing, no residual state exists, and no extra collectives run
+(guard-tested in tests/test_compression.py).
+"""
+
+import numpy as np
+
+from . import codecs, policy, residual  # noqa: F401  (subsystem surface)
+from ..telemetry import core as telemetry
+from ..utils import envparse
+from ..utils.logging_util import get_logger
+
+# Quantization-error histogram range: gradients live well under 1.0 and
+# errors bottom out around f32 epsilon of the block scale.
+_ERROR_BUCKETS = telemetry.log_buckets(1e-9, 1.0, factor=4.0)
+
+
+class CompressionPlane:
+    """Policy + residual store + metrics, attached to one coordinator
+    (rebuilt on every ``init()``, like the guardian)."""
+
+    def __init__(self, pol, delegated=False):
+        self.policy = pol
+        self.block = envparse.get_int(envparse.COMPRESSION_BLOCK,
+                                      codecs.DEFAULT_BLOCK)
+        if self.block <= 0:
+            raise ValueError(
+                f"HVDTPU_COMPRESSION_BLOCK must be positive, got "
+                f"{self.block}")
+        self.error_feedback = envparse.get_bool(
+            envparse.COMPRESSION_ERROR_FEEDBACK, True)
+        self.residuals = residual.ResidualStore()
+        self._delegated = delegated
+        self._warned_native = False
+        self._warned_fallback = False
+        self._log = get_logger()
+        self._metrics_on = telemetry.enabled()
+        # hvd_compression_error forces a device→host sync of every
+        # residual it reads, on the cycle thread — sample 1-in-16
+        # buckets (first bucket included) so the histogram stays
+        # populated without making metrics a per-step transfer of the
+        # whole gradient set.
+        self._err_buckets = 0
+        self._m_ratio = telemetry.gauge(
+            "hvd_compression_ratio",
+            "Wire bytes / original payload bytes of the last "
+            "compressed bucket", labelnames=("codec",))
+        self._m_saved = telemetry.counter(
+            "hvd_compression_bytes_saved_total",
+            "Payload bytes kept off the wire by compression",
+            labelnames=("codec",))
+        self._m_err = telemetry.histogram(
+            "hvd_compression_error",
+            "Per-tensor max-abs quantization error (the error-feedback "
+            "residual's magnitude)", labelnames=("codec",),
+            buckets=_ERROR_BUCKETS)
+
+    # -- submit side -------------------------------------------------------
+    def stamp(self, entry):
+        """Resolve ``entry.codec`` from the explicit request (a codec
+        name string set by ``Compression.int8``-style markers) or the
+        env policy, into the ``(name, block)`` tuple the fusion plane
+        groups by and the guardian digests. Raises the loud Adasum /
+        process-set rejects; called from Coordinator.submit so the
+        error surfaces on the submitting thread."""
+        explicit = entry.codec
+        entry.codec = None
+        if self._delegated:
+            # The delegated xla-global data plane executes fused NATIVE
+            # responses (handles, not names) and applies the env
+            # policy's catch-all at execution time instead
+            # (policy.simple_wire_policy) — per-entry stamping has
+            # nothing to attach to. The pure-TCP plane stamps normally:
+            # its backend runs the host-side quantized-allgather path.
+            if explicit is not None and not self._warned_native:
+                self._warned_native = True
+                self._log.warning(
+                    "compression: per-tensor codec requests are ignored "
+                    "on the delegated xla-global plane — it applies the "
+                    "HVDTPU_COMPRESSION catch-all at the data plane "
+                    "instead (no error feedback, no name globs; "
+                    "docs/compression.md)")
+            return
+        nelems = sum(int(np.prod(getattr(a, "shape", ()) or (1,)))
+                     for a in entry.arrays)
+        dtype = (entry.arrays[0].dtype
+                 if entry.arrays and hasattr(entry.arrays[0], "dtype")
+                 else None)
+        if explicit is not None:
+            codec = codecs.get_codec(explicit)
+            if not codec.wire:
+                # Cast compressors run at the user layer (compress /
+                # decompress around the collective) — nothing to stamp.
+                return
+            self._validate_wire(explicit, entry)
+            entry.codec = (explicit, self.block)
+            return
+        name = self.policy.select(
+            entry.name, nelems, dtype, entry.op,
+            entry.process_set.process_set_id)
+        if name is None:
+            return
+        codec = codecs.CODECS[name]
+        entry.codec = (name, self.block if codec.wire else 0)
+
+    def _validate_wire(self, codec_name, entry):
+        from ..ops import reduce_ops
+        if entry.op not in (None, reduce_ops.Sum, reduce_ops.Average,
+                            reduce_ops.Adasum):
+            raise ValueError(
+                f"compression={codec_name!r} with "
+                f"op={reduce_ops.op_name(entry.op)}: quantized "
+                "collectives support Sum/Average only — dequantize-"
+                "then-accumulate is a linear-reduction identity "
+                "(docs/compression.md)")
+        if entry.op == reduce_ops.Adasum:
+            raise ValueError(
+                f"compression={codec_name!r} with op=Adasum: Adasum "
+                "needs exact per-rank gradients (quantizing them "
+                "silently changes the scale-invariant combination). "
+                "Drop the compressor or use Sum/Average "
+                "(docs/compression.md).")
+        if entry.process_set.process_set_id != 0:
+            raise ValueError(
+                f"compression={codec_name!r} on process set "
+                f"{entry.process_set.process_set_id}: quantized "
+                "collectives are only wired for the global process set "
+                "(docs/compression.md).")
+
+    # -- dispatch side (coordinator cycle thread) --------------------------
+    def residuals_in(self, bucket):
+        """Flat residual list aligned with the bucket's flat array list
+        (zeros where none is stored or the shape moved), or None when
+        error feedback is off."""
+        if not self.error_feedback:
+            return None
+        import jax.numpy as jnp
+        out = []
+        for e in bucket:
+            stored = self.residuals.get(e.name) if e.name else None
+            if (stored is None or len(stored) != len(e.arrays)
+                    or any(r.shape != a.shape
+                           for r, a in zip(stored, e.arrays))):
+                stored = [jnp.zeros(a.shape, jnp.float32)
+                          for a in e.arrays]
+            out.extend(stored)
+        return out
+
+    def store_residuals(self, bucket, flat_residuals):
+        i = 0
+        for e in bucket:
+            k = len(e.arrays)
+            if e.name:
+                self.residuals.put(e.name, flat_residuals[i:i + k])
+            i += k
+
+    def warn_fallback(self, backend_name):
+        if not self._warned_fallback:
+            self._warned_fallback = True
+            self._log.warning(
+                "compression: backend %r has no quantized-collective "
+                "pipeline; compressed buckets fall back to the plain "
+                "allreduce (lossless, but no bandwidth win)",
+                backend_name)
+
+    def record(self, codec_name, bucket, flat_arrays, flat_residuals):
+        """Telemetry for one executed bucket: ratio gauge, bytes-saved
+        counter, and (when residuals exist) the per-tensor max-abs
+        quantization error histogram. No-op with metrics off."""
+        if not self._metrics_on:
+            return
+        codec = codecs.CODECS[codec_name]
+        orig = wire = 0
+        for a in flat_arrays:
+            n = int(np.prod(a.shape))
+            orig += n * a.dtype.itemsize
+            wire += codec.wire_bytes(n, self.block, a.dtype.itemsize)
+        if orig:
+            self._m_ratio.labels(codec=codec_name).set(wire / orig)
+            self._m_saved.labels(codec=codec_name).inc(max(0, orig - wire))
+        if flat_residuals is not None:
+            self._err_buckets += 1
+            if (self._err_buckets - 1) % 16:
+                return
+            i = 0
+            for e in bucket:
+                k = len(e.arrays)
+                err = max(float(np.max(np.abs(np.asarray(r))))
+                          for r in flat_residuals[i:i + k])
+                self._m_err.labels(codec=codec_name).observe(err)
+                i += k
+
+
+def make_plane(runtime=None, force=False):
+    """CompressionPlane when ``HVDTPU_COMPRESSION`` is set (or
+    ``force``, for explicit per-call codec markers with the env unset);
+    None otherwise — the disabled-mode contract."""
+    spec = envparse.get_str(envparse.COMPRESSION, "")
+    if not spec and not force:
+        return None
+    delegated = bool(runtime is not None
+                     and getattr(getattr(runtime, "backend", None),
+                                 "delegate_data_ops", False))
+    return CompressionPlane(policy.CompressionPolicy.from_env(),
+                            delegated=delegated)

@@ -1,0 +1,143 @@
+"""Per-tensor compression policy.
+
+``HVDTPU_COMPRESSION`` selects the codec; the grammar is either a bare
+codec name (applies to every eligible tensor)::
+
+    HVDTPU_COMPRESSION=int8
+
+or a semicolon-separated, first-match-wins list of ``glob=codec`` rules
+over tensor names, with a bare codec acting as the ``*`` catch-all::
+
+    HVDTPU_COMPRESSION='*bias*=none;embed*=bf16;int8'
+
+Eligibility (checked before the rules): the entry is an allreduce of a
+floating tensor with at least ``HVDTPU_COMPRESSION_THRESHOLD`` elements
+(default 1024 — tiny tensors pay more in scale metadata and dispatch
+overhead than their bytes are worth) under a Sum or Average reduction.
+Min/Max/Product reductions are not gradient math and are silently left
+uncompressed.
+
+Two interactions are rejected LOUDLY instead of silently skipped
+(ISSUE 6 contract — a user who turned compression on must never get
+different numerics than they asked for without an explanation):
+
+- **Adasum**: the scale-invariant combination is computed from exact
+  dot products of the un-reduced per-rank gradients; quantizing its
+  inputs silently changes the projection. ``ValueError`` tells the
+  user to exclude the tensors (``<glob>=none``) or drop Adasum.
+- **Non-global process sets**: the quantized pipeline is only wired
+  (and only tested) over the global cohort; a subset mesh would need
+  its own residual scoping. ``ValueError`` until that exists.
+
+Malformed specs raise at plane construction (``hvd.init()`` time) —
+the chaos-spec contract: a typo'd knob must never silently disable the
+feature it configures.
+"""
+
+import fnmatch
+
+from . import codecs
+from ..ops import reduce_ops
+from ..utils import envparse
+
+DEFAULT_THRESHOLD = 1024
+
+
+def parse_rules(spec):
+    """``spec`` -> [(glob, codec_name)]; validates codec names (and the
+    fp8 build requirement) eagerly."""
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            glob, _, codec_name = part.partition("=")
+            glob, codec_name = glob.strip(), codec_name.strip()
+            if not glob or not codec_name:
+                raise ValueError(
+                    f"malformed HVDTPU_COMPRESSION rule {part!r}: "
+                    "expected '<name-glob>=<codec>'")
+        else:
+            glob, codec_name = "*", part
+        codecs.get_codec(codec_name)  # loud on unknown/unsupported
+        rules.append((glob, codec_name))
+    return rules
+
+
+class CompressionPolicy:
+    """Evaluates the rule list for one TensorEntry's metadata."""
+
+    def __init__(self, rules, threshold=DEFAULT_THRESHOLD):
+        self.rules = list(rules)
+        self.threshold = int(threshold)
+
+    @classmethod
+    def from_env(cls):
+        spec = envparse.get_str(envparse.COMPRESSION, "")
+        rules = parse_rules(spec)
+        threshold = envparse.get_int(envparse.COMPRESSION_THRESHOLD,
+                                     DEFAULT_THRESHOLD)
+        return cls(rules, threshold=threshold)
+
+    def codec_for_name(self, name):
+        """First matching rule's codec name, or None."""
+        for glob, codec_name in self.rules:
+            if fnmatch.fnmatchcase(name or "", glob):
+                return codec_name
+        return None
+
+    def select(self, name, nelems, dtype, op, process_set_id):
+        """Codec name for an allreduce with this metadata, or None.
+        Raises on the Adasum / process-set interactions (module doc)."""
+        if not self.rules:
+            return None
+        import jax.numpy as jnp
+        if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+            return None
+        if nelems < self.threshold:
+            return None
+        codec_name = self.codec_for_name(name)
+        if codec_name is None or codec_name == "none":
+            return None
+        if not codecs.CODECS[codec_name].lossy:
+            return None
+        if op == reduce_ops.Adasum:
+            raise ValueError(
+                f"HVDTPU_COMPRESSION selected codec {codec_name!r} for "
+                f"Adasum allreduce {name!r}: Adasum's scale-invariant "
+                "combination needs exact per-rank gradients, and "
+                "quantizing them would silently change the result. "
+                "Exclude these tensors ('<glob>=none' rule) or use "
+                "Sum/Average (docs/compression.md).")
+        if op not in (reduce_ops.Sum, reduce_ops.Average):
+            return None  # Min/Max/Product: not gradient reductions
+        if process_set_id not in (0, None):
+            raise ValueError(
+                f"HVDTPU_COMPRESSION selected codec {codec_name!r} for "
+                f"allreduce {name!r} on process set {process_set_id}: "
+                "quantized collectives are only wired for the global "
+                "process set (residual scoping for subset cohorts does "
+                "not exist). Exclude these tensors with a "
+                "'<glob>=none' rule (docs/compression.md).")
+        return codec_name
+
+
+def simple_wire_policy():
+    """(codec_name, block, threshold) for planes that have sizes and
+    dtypes but no tensor names (the xla-global delegated data plane —
+    fused native responses carry handles, not names). Only a catch-all
+    ``*`` wire rule applies there; named globs need names and stay on
+    the python fusion plane. Returns (None, block, threshold) when
+    compression is off or cast-only."""
+    spec = envparse.get_str(envparse.COMPRESSION, "")
+    block = envparse.get_int(envparse.COMPRESSION_BLOCK,
+                             codecs.DEFAULT_BLOCK)
+    threshold = envparse.get_int(envparse.COMPRESSION_THRESHOLD,
+                                 DEFAULT_THRESHOLD)
+    for glob, codec_name in parse_rules(spec):
+        if glob == "*":
+            if codecs.CODECS[codec_name].wire:
+                return codec_name, block, threshold
+            return None, block, threshold
+    return None, block, threshold

@@ -1,0 +1,79 @@
+"""Error-feedback residual store.
+
+Plain quantized SGD is biased: gradient components smaller than the
+per-block quantization step round to zero on every step and their
+contribution is lost forever. Error feedback closes the loop — the
+quantization error of step t is carried into step t+1's input
+(``compress(g + e)``; e' = (g + e) - decompress(compress(g + e))), which
+restores convergence to the uncompressed limit for SGD-family updates
+(the satellite convergence test pins exactly this).
+
+Residuals are keyed by **tensor name × elastic version**: a name is the
+only identity stable across steps on the eager plane, and a membership
+change invalidates every residual — the new cohort's virtual-rank slices
+do not line up with the old one's, so a stale residual would inject one
+cohort's quantization debt into another's gradients. The store checks
+the joined elastic version on every access and drops everything when it
+moves (exit-restart workers get a fresh process — and a fresh store —
+anyway; the in-process reset path gets the same guarantee from this
+check, plus a second line of defense: each ``basics.init()`` builds a
+new coordinator and with it a new plane).
+
+Residuals live in float32 regardless of the gradient dtype (a bf16
+residual would itself round away the small components it exists to
+preserve) and cost one extra copy of each compressed tensor — the
+documented memory price of ``HVDTPU_COMPRESSION_ERROR_FEEDBACK=1``
+(docs/compression.md).
+"""
+
+from ..analysis import sanitizer
+from ..utils import envparse
+from ..utils.logging_util import get_logger
+
+
+class ResidualStore:
+    """name -> list of per-array residuals (stacked like the entry's
+    arrays). Touched only on the compressed dispatch path, so the lock
+    is uncontended; it exists for the elastic-reset race (a framework
+    thread reading while the cycle thread writes)."""
+
+    def __init__(self):
+        self._lock = sanitizer.make_lock("compression.residuals")
+        self._store = {}
+        self._version = self._current_version()
+        self._log = get_logger()
+
+    @staticmethod
+    def _current_version():
+        return envparse.get_str(envparse.ELASTIC_VERSION, "0")
+
+    def _maybe_reset_locked(self):
+        version = self._current_version()
+        if version != self._version:
+            dropped = len(self._store)
+            self._store.clear()
+            self._log.warning(
+                "compression: residual store reset (elastic version "
+                "%s -> %s, %d residual(s) dropped) — error-feedback "
+                "state never crosses cohorts", self._version, version,
+                dropped)
+            self._version = version
+
+    def get(self, name):
+        """Residual list for ``name`` or None (first occurrence)."""
+        with self._lock:
+            self._maybe_reset_locked()
+            return self._store.get(name)
+
+    def put(self, name, residuals):
+        with self._lock:
+            self._maybe_reset_locked()
+            self._store[name] = list(residuals)
+
+    def reset(self):
+        with self._lock:
+            self._store.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._store)

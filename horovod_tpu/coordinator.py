@@ -98,11 +98,11 @@ class TensorEntry:
     __slots__ = ("name", "kind", "op", "root_rank", "arrays", "splits",
                  "prescale", "postscale", "process_set", "handle",
                  "enqueue_time", "shapes", "uneven", "guard_token",
-                 "chaos_mismatch")
+                 "chaos_mismatch", "codec")
 
     def __init__(self, name, kind, arrays, process_set, op=None,
                  root_rank=None, splits=None, prescale=None, postscale=None,
-                 uneven=False):
+                 uneven=False, codec=None):
         self.name = name
         self.kind = kind
         self.arrays = arrays
@@ -121,6 +121,11 @@ class TensorEntry:
         self.guard_token = None
         # Chaos 'collective:mismatch': publish a corrupted digest.
         self.chaos_mismatch = False
+        # Compression: a codec-name string at submit (explicit
+        # Compression.int8-style marker), resolved by the plane's
+        # stamp() into the (name, block) tuple the fusion plane groups
+        # by and the guardian digests; None = uncompressed.
+        self.codec = codec
 
 
 def _nbytes(a):
@@ -171,6 +176,13 @@ class Coordinator:
         from . import guardian
         self._guardian = guardian.make_guard(runtime)
         self._watchdog = guardian.make_watchdog(runtime)
+        # Gradient-compression plane (compression/; docs/compression.md).
+        # None when HVDTPU_COMPRESSION is unset: the submit path pays
+        # two None checks and nothing else. Lazily created when an
+        # explicit per-call codec marker (Compression.int8) arrives
+        # with the env unset.
+        from . import compression as compression_mod
+        self._compression = compression_mod.make_plane(runtime)
         self._stall_scan_period = (max(1.0, min(self.stall_warn_s / 2.0,
                                                 10.0))
                                    if self.stall_warn_s > 0 else 10.0)
@@ -333,6 +345,26 @@ class Coordinator:
                     return self._chaos_swallow(entry)
                 if sig.action == "mismatch":
                     entry.chaos_mismatch = True
+        if entry.kind == "allreduce" and (self._compression is not None
+                                          or entry.codec is not None):
+            if self._compression is None:
+                # Explicit Compression.int8-style marker with the env
+                # policy unset: build a plane on demand (default policy,
+                # residual store, metrics).
+                from . import compression as compression_mod
+                self._compression = compression_mod.make_plane(
+                    self.runtime, force=True)
+                backend = self.runtime.backend
+                if getattr(backend, "drives_own_cycle", False):
+                    # The native loop handed its (then-None) plane ref
+                    # to the backend at start — refresh it.
+                    backend.compression_plane = self._compression
+            # Stamp BEFORE the guardian digest so every rank's digest
+            # carries the selected codec (a codec mismatch fails fast
+            # as CollectiveMismatchError instead of corrupting bytes).
+            # Raises the loud Adasum / process-set rejects here, on the
+            # submitting thread.
+            self._compression.stamp(entry)
         if self._guardian is not None:
             # Publish the digest BEFORE the entry can reach a dispatch
             # cycle, so a peer's verify never races an unpublished
@@ -438,6 +470,10 @@ class Coordinator:
         reference: horovod/common/operations.cc:706). Cycles run even with
         an empty local queue: peers may need this rank for negotiation."""
         backend.entry_done_cb = self._release_name
+        # The pure-TCP plane executes wire-codec entries host-side
+        # (quantized allgather + f32 reduce) and threads error-feedback
+        # residuals through this plane (None when compression is off).
+        backend.compression_plane = self._compression
         while self._running:
             time.sleep(self.cycle_time_s)
             with self._lock:
@@ -713,9 +749,11 @@ class Coordinator:
             self._m_cycle_s.observe(time.perf_counter() - cycle_t0)
 
     def _run_fused_allreduces(self, backend, entries, timeline):
-        """Bucket by (process set, op, scales, dtype), concat flattened
-        tensors into fusion buffers bounded by the fusion threshold, and run
-        one backend collective per buffer."""
+        """Bucket by (process set, op, scales, dtype, codec), concat
+        flattened tensors into fusion buffers bounded by the fusion
+        threshold, and run one backend collective per buffer. The codec
+        is part of the key so a compressed bucket is homogeneous — one
+        quantized pipeline per buffer, never a mixed wire format."""
         import jax.numpy as jnp
         groups = {}
         for e in entries:
@@ -723,7 +761,7 @@ class Coordinator:
             pre = 1.0 if e.prescale is None else float(e.prescale)
             post = 1.0 if e.postscale is None else float(e.postscale)
             key = (e.process_set.process_set_id, e.op, pre, post,
-                   str(jnp.asarray(a).dtype))
+                   str(jnp.asarray(a).dtype), e.codec)
             groups.setdefault(key, []).append(e)
 
         for key, group in groups.items():
@@ -753,16 +791,22 @@ class Coordinator:
         names = [e.name for e in bucket]
         if self._metrics_on:
             self._record_fusion_stats(bucket)
+        span_kind = ("fused_allreduce" if e0.codec is None
+                     else "fused_allreduce_compressed")
         try:
             with tele_span(names, "FUSED_ALLREDUCE", timeline=timeline,
                            histogram=self._m_dispatch_s.labels(
-                               kind="fused_allreduce")):
+                               kind=span_kind)):
                 flat = []
                 for e in bucket:
                     flat.extend(e.arrays)
-                results = backend.allreduce(
-                    flat, e0.op, e0.process_set,
-                    prescale=e0.prescale, postscale=e0.postscale)
+                if e0.codec is not None:
+                    results = self._run_compressed(backend, bucket,
+                                                   flat, e0)
+                else:
+                    results = backend.allreduce(
+                        flat, e0.op, e0.process_set,
+                        prescale=e0.prescale, postscale=e0.postscale)
                 i = 0
                 for e in bucket:
                     k = len(e.arrays)
@@ -781,6 +825,43 @@ class Coordinator:
             self._log.error("fused allreduce failed: %s", exc)
             for e in bucket:
                 e.handle._fail(_wrap_error(exc))
+
+    def _run_compressed(self, backend, bucket, flat, e0):
+        """One compressed fusion bucket (docs/compression.md). Cast
+        codecs (fp16/bf16) ride a plain allreduce in the narrow dtype;
+        wire codecs (int8/fp8) run the backend's quantized
+        reduce-scatter → wide-dtype reduce → requantize → allgather
+        pipeline, threading the error-feedback residuals through the
+        plane's store. Backends without the pipeline (loopback) fall
+        back to the plain allreduce — lossless, logged once."""
+        from .compression import codecs as comp_codecs
+        codec_name, block = e0.codec
+        codec = comp_codecs.CODECS[codec_name]
+        plane = self._compression
+        if not codec.wire:
+            import jax.numpy as jnp
+            cast = [codec.encode(jnp.asarray(a), block)[0] for a in flat]
+            results = backend.allreduce(
+                cast, e0.op, e0.process_set,
+                prescale=e0.prescale, postscale=e0.postscale)
+            results = [r.astype(a.dtype)
+                       for r, a in zip(results, flat)]
+            plane.record(codec_name, bucket, flat, None)
+            return results
+        if not hasattr(backend, "allreduce_quantized"):
+            plane.warn_fallback(backend.name)
+            return backend.allreduce(
+                flat, e0.op, e0.process_set,
+                prescale=e0.prescale, postscale=e0.postscale)
+        residuals = plane.residuals_in(bucket)
+        results, new_residuals = backend.allreduce_quantized(
+            flat, e0.op, e0.process_set, codec, block,
+            prescale=e0.prescale, postscale=e0.postscale,
+            residuals=residuals)
+        if new_residuals is not None:
+            plane.store_residuals(bucket, new_residuals)
+        plane.record(codec_name, bucket, flat, new_residuals)
+        return results
 
     def _record_fusion_stats(self, bucket):
         """Fusion-plane accounting (metrics on only): queue-wait per

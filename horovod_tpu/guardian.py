@@ -178,9 +178,23 @@ def make_cross_process_board():
 # Digests
 # ---------------------------------------------------------------------------
 
-# Fields compared across ranks, in reporting order.
+# Fields compared across ranks, in reporting order. "codec" is the
+# compression plane's selection (codec name + block size): ranks
+# disagreeing on it would run DIFFERENT wire pipelines for the same
+# named collective — int8 payloads reduced against raw floats — so a
+# mismatch must fail fast naming the field, not corrupt numerics.
 _DIGEST_FIELDS = ("kind", "op", "dtype", "shapes", "process_set",
-                  "prescale", "postscale", "root_rank")
+                  "prescale", "postscale", "root_rank", "codec")
+
+
+def _codec_digest(entry):
+    codec = getattr(entry, "codec", None)
+    if codec is None:
+        return None
+    if isinstance(codec, tuple):
+        name, block = codec
+        return f"{name}@b{block}" if block else name
+    return str(codec)
 
 
 def entry_digest(entry):
@@ -205,6 +219,7 @@ def entry_digest(entry):
         "postscale": None if entry.postscale is None
         else float(entry.postscale),
         "root_rank": entry.root_rank,
+        "codec": _codec_digest(entry),
     }
 
 

@@ -38,6 +38,7 @@ from ..process_sets import global_process_set
 HVD_AXIS = "hvd"
 
 
+from ..utils.jax_compat import axis_size as _axis_size  # noqa: E402
 from ..utils.jax_compat import pvary as _pvary  # noqa: E402
 from ..utils.jax_compat import shard_map as _shard_map  # noqa: E402
 
@@ -55,7 +56,7 @@ def _reduce_in_axis(grads, op, axis_name, prescale=None, postscale=None):
             # All ranks hold the identical tree-reduction, but the ppermute
             # schedule leaves the value typed device-varying; a psum of g/n
             # is a semantic no-op that re-establishes replica invariance.
-            n = lax.axis_size(axis_name)
+            n = _axis_size(axis_name)
             g = lax.psum(g / n, axis_name)
         else:
             raise ValueError(
@@ -103,6 +104,24 @@ class DistributedOptimizer:
         self.postscale = postscale_factor
         self.average_aggregated = average_aggregated_gradients
         self.process_set = process_set
+        # Wire codecs (Compression.int8/fp8) run the quantized pipeline
+        # INSIDE the reduction (docs/compression.md): in-jit via
+        # quantized_allreduce_axis on the axis path, via the entry codec
+        # marker on the eager plane. Adasum needs exact per-rank
+        # gradients — reject loudly instead of quantizing them.
+        self._wire_codec = getattr(compression, "wire_codec", None)
+        if self._wire_codec is not None:
+            from ..compression import codecs as _codecs
+            _codecs.get_codec(self._wire_codec)  # loud on fp8-less jax
+            if op not in (reduce_ops.Average, reduce_ops.Sum):
+                raise ValueError(
+                    f"compression={self._wire_codec!r} supports "
+                    "Average/Sum gradient reductions only (Adasum's "
+                    "scale-invariant combination needs exact per-rank "
+                    "gradients; docs/compression.md)")
+            from ..utils import envparse as _envparse
+            self._wire_block = _envparse.get_int(
+                _envparse.COMPRESSION_BLOCK, _codecs.DEFAULT_BLOCK)
 
     # -- optax interface ---------------------------------------------------
     def init(self, params):
@@ -113,6 +132,8 @@ class DistributedOptimizer:
         return (inner, acc, jnp.zeros((), jnp.int32))
 
     def _reduce(self, grads):
+        if self._wire_codec is not None:
+            return self._reduce_quantized(grads)
         ctxs = None
         comp_grads = grads
         if self.compression is not Compression.none:
@@ -146,6 +167,42 @@ class DistributedOptimizer:
                 treedef, [self.compression.decompress(g, c)
                           for g, c in zip(leaves, ctxs)])
         return out
+
+    def _reduce_quantized(self, grads):
+        """Wire-codec reduction: both collective legs carry the
+        quantized format. Axis path = in-jit EQuARX pipeline per leaf
+        (stateless — error feedback needs cross-step state and lives on
+        the eager plane); eager SPMD path = the entry codec marker
+        through grouped_allreduce; single-controller jit path =
+        identity (the partitioner already reduced replicated params and
+        there is no wire to compress)."""
+        from ..compression.codecs import quantized_allreduce_axis
+
+        if self.axis_name is not None:
+            average = self.op == reduce_ops.Average
+
+            def red(g):
+                if self.prescale is not None:
+                    g = g * jnp.asarray(self.prescale).astype(g.dtype)
+                g = quantized_allreduce_axis(
+                    g, self.axis_name, codec=self._wire_codec,
+                    block=self._wire_block, average=average)
+                if self.postscale is not None:
+                    g = g * jnp.asarray(self.postscale).astype(g.dtype)
+                return g
+            return jax.tree.map(red, grads)
+
+        rt = basics.runtime()
+        if rt.mode == basics.MODE_SPMD:
+            from ..ops.collectives import grouped_allreduce
+            leaves, treedef = jax.tree.flatten(grads)
+            reduced = grouped_allreduce(
+                leaves, op=self.op, compression=self.compression,
+                prescale_factor=self.prescale or 1.0,
+                postscale_factor=self.postscale or 1.0,
+                process_set=self.process_set)
+            return jax.tree.unflatten(treedef, reduced)
+        return grads
 
     def update(self, grads, state, params=None):
         inner_state, acc, count = state
@@ -296,17 +353,22 @@ def make_train_step(loss_fn, dist_opt, mesh=None, axis_name=HVD_AXIS,
         return (new_params, new_aux, new_opt_state,
                 lax.pmean(loss, axis_name))
 
+    # Wire-codec compression ends in an all_gather whose output IS
+    # replicated by construction (every rank receives every requantized
+    # shard) but the replication checker cannot prove it — same
+    # exception as make_zero_train_step's gathered params.
+    check = getattr(dist_opt, "_wire_codec", None) is None
     if has_aux:
         sharded = _shard_map(
             body_aux, mesh=mesh,
             in_specs=(P(), P(), P(), P(axis_name)),
-            out_specs=(P(), P(), P(), P()))
+            out_specs=(P(), P(), P(), P()), check_vma=check)
         donate_argnums = (0, 1, 2) if donate else ()
     else:
         sharded = _shard_map(
             body_plain, mesh=mesh,
             in_specs=(P(), P(), P(axis_name)),
-            out_specs=(P(), P(), P()))
+            out_specs=(P(), P(), P()), check_vma=check)
         donate_argnums = (0, 1) if donate else ()
     return jax.jit(sharded, donate_argnums=donate_argnums)
 

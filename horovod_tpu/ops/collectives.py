@@ -101,15 +101,19 @@ def _check_stacked(tensor, process_set, kind):
 # --------------------------------------------------------------------------
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
-                    process_set=global_process_set):
+                    process_set=global_process_set, codec=None):
     """Async allreduce; returns a Handle (reference:
-    horovod/torch/mpi_ops.py:154)."""
+    horovod/torch/mpi_ops.py:154). ``codec`` is the wire-codec name a
+    quantizing compressor stamps (``Compression.int8.wire_codec``) —
+    the collective itself runs the quantized pipeline, so the marker
+    must travel with the entry rather than transform the tensor."""
     op = reduce_ops.handle_average_backwards_compatibility(op, average)
     tensor = jnp.asarray(tensor)
     _check_stacked(tensor, process_set, "allreduce")
     entry = TensorEntry(name or _auto_name("allreduce"), "allreduce",
                         [tensor], process_set, op=op,
-                        prescale=prescale_factor, postscale=postscale_factor)
+                        prescale=prescale_factor, postscale=postscale_factor,
+                        codec=codec)
     return _submit(entry)
 
 
@@ -120,7 +124,8 @@ def allreduce(tensor, average=None, name=None, compression=Compression.none,
     tensor = jnp.asarray(tensor)
     compressed, ctx = compression.compress(tensor)
     handle = allreduce_async(compressed, average, name, op, prescale_factor,
-                             postscale_factor, process_set)
+                             postscale_factor, process_set,
+                             codec=getattr(compression, "wire_codec", None))
     return compression.decompress(synchronize(handle), ctx)
 
 
@@ -161,7 +166,7 @@ def _empty_group_handle(kind):
 
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0,
-                            process_set=global_process_set):
+                            process_set=global_process_set, codec=None):
     """Grouped allreduce: the group is fused atomically — one compiled
     collective for all tensors (reference: horovod/torch/mpi_ops.py:375 +
     group_table.cc semantics)."""
@@ -173,7 +178,8 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         _check_stacked(a, process_set, "grouped_allreduce")
     entry = TensorEntry(name or _auto_name("grouped_allreduce"), "allreduce",
                         arrays, process_set, op=op,
-                        prescale=prescale_factor, postscale=postscale_factor)
+                        prescale=prescale_factor, postscale=postscale_factor,
+                        codec=codec)
     return _submit(entry)
 
 
@@ -188,7 +194,9 @@ def grouped_allreduce(tensors, average=None, name=None,
         ctxs.append(ctx)
     handle = grouped_allreduce_async(compressed, average, name, op,
                                      prescale_factor, postscale_factor,
-                                     process_set)
+                                     process_set,
+                                     codec=getattr(compression,
+                                                   "wire_codec", None))
     outputs = synchronize(handle)
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]

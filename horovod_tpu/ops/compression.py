@@ -1,8 +1,21 @@
-"""Gradient compression (reference: horovod/torch/compression.py,
-horovod/tensorflow/compression.py — same 74-line API in both bindings).
+"""Gradient compression: the user-facing ``Compression`` surface
+(reference: horovod/torch/compression.py,
+horovod/tensorflow/compression.py — same API in both bindings).
 
-On TPU the natural wire format is bfloat16 (MXU-native); fp16 is kept for
-parity with the reference.
+Two families behind the one reference-shaped class:
+
+- **Cast compressors** (``fp16``/``bf16``): compress/decompress are
+  dtype casts around the collective, exactly the reference semantics.
+  On TPU the natural wire format is bfloat16 (MXU-native); fp16 is
+  kept for parity.
+- **Wire compressors** (``int8``/``fp8``): block-wise quantization that
+  must be fused INTO the collective (summing raw int8 payloads would
+  be garbage), so ``compress`` is an identity and the ``wire_codec``
+  marker routes the allreduce through the dispatch plane's quantized
+  reduce-scatter → wide-dtype reduce → requantize → allgather pipeline
+  (horovod_tpu/compression/; docs/compression.md). Block size, error
+  feedback, and policy-based selection ride the ``HVDTPU_COMPRESSION*``
+  knobs.
 """
 
 import jax.numpy as jnp
@@ -66,9 +79,42 @@ class BF16Compressor(Compressor):
         return tensor
 
 
+class _WireCompressor(Compressor):
+    """Base for quantized codecs executed inside the collective: the
+    user-layer compress/decompress are identities, and ``wire_codec``
+    tells the dispatch plane which quantized pipeline to run."""
+
+    wire_codec = None
+
+    @classmethod
+    def compress(cls, tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class Int8Compressor(_WireCompressor):
+    """Block-wise int8 quantized allreduce (EQuARX pipeline; per-block
+    f32 scales, error-feedback residuals on the eager plane)."""
+
+    wire_codec = "int8"
+
+
+class FP8Compressor(_WireCompressor):
+    """Block-wise-scaled float8_e4m3fn quantized allreduce. Needs a jax
+    build with ``jnp.float8_e4m3fn`` — selecting it elsewhere is a loud
+    error at dispatch, never a silent fp32 fallback."""
+
+    wire_codec = "fp8"
+
+
 class Compression:
     """Optional gradient compression algorithms used during allreduce."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor

@@ -257,15 +257,18 @@ def _allreduce_async_impl(tensor, op, name, prescale, postscale,
         return _local_handle(out)
     arr, bf16 = _to_np(tensor)
     comp_ctx = None
-    if compression is not None:
+    wire = getattr(compression, "wire_codec", None)
+    if compression is not None and wire is None:
         # Compressor classes operate fine on numpy (astype/issubdtype):
-        # no device round-trip on the hot gradient path.
+        # no device round-trip on the hot gradient path. Wire codecs
+        # (int8/fp8) compress INSIDE the collective instead — the codec
+        # marker below routes them (docs/compression.md).
         carr, comp_ctx = compression.compress(arr)
         arr = np.ascontiguousarray(carr)
     inner = _c.allreduce_async(arr, op=op, name=name,
                                prescale_factor=prescale or 1.0,
                                postscale_factor=postscale or 1.0,
-                               process_set=process_set)
+                               process_set=process_set, codec=wire)
     return _Handle(inner, tensor, inplace, bf16, compression=compression,
                    comp_ctx=comp_ctx)
 

@@ -222,6 +222,22 @@ CHECKPOINT_KEEP = register(
     "CHECKPOINT_KEEP", "0",
     "Keep only the newest N step_<N> checkpoints; 0 keeps everything")
 
+# -- gradient compression (docs/compression.md) ----------------------------
+COMPRESSION = register(
+    "COMPRESSION", "",
+    "Gradient-compression policy: a codec (none/fp16/bf16/int8/fp8) or "
+    "';'-separated '<name-glob>=<codec>' rules, first match wins")
+COMPRESSION_THRESHOLD = register(
+    "COMPRESSION_THRESHOLD", "1024",
+    "Min elements before the compression policy applies to a tensor")
+COMPRESSION_BLOCK = register(
+    "COMPRESSION_BLOCK", "256",
+    "Quantization block size: one f32 scale per this many values")
+COMPRESSION_ERROR_FEEDBACK = register(
+    "COMPRESSION_ERROR_FEEDBACK", "1",
+    "Carry per-tensor quantization error into the next step's "
+    "gradient (eager/fusion plane only)")
+
 # -- kernels ----------------------------------------------------------------
 BRIDGE_FLASH = register(
     "BRIDGE_FLASH", "auto",

@@ -22,6 +22,19 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None,
                check_rep=check_vma)
 
 
+def axis_size(axis_name):
+    """Static size of a named mesh axis from inside shard_map/pmap.
+    ``lax.axis_size`` only exists on newer jax; older releases expose
+    the same number through the core axis-env frame."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    import jax.core
+    frame = jax.core.axis_frame(axis_name)
+    # Older cores return the frame object, newer ones the bare size.
+    return getattr(frame, "size", frame)
+
+
 def pvary(x, axis_name):
     """Mark a value device-varying along ``axis_name`` (no-op if it
     already is). Papers over the lax.pcast / lax.pvary API transition."""

@@ -1,0 +1,23 @@
+"""Fixture: lossy compression misuse (HVD205 x3, docs/lint.md)."""
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+
+weights = jnp.zeros((8, 128), dtype=jnp.float32)
+labels = jnp.zeros((8, 64), dtype=jnp.int32)
+mask = np.random.RandomState(0).randint(0, 2, size=(8, 32))
+
+# HVD205: broadcast must be exact — a lossy wire format diverges ranks.
+hvd.broadcast(weights, root_rank=0, compression=hvd.Compression.int8)
+
+# HVD205: integer tensor through a lossy compressor.
+hvd.allreduce(labels, op=hvd.Sum, compression=hvd.Compression.fp16)
+
+# HVD205: randint-built mask through a lossy compressor.
+hvd.allreduce(mask, op=hvd.Sum, compression=hvd.Compression.int8)
+
+# Fine: float gradients are what compression is for.
+hvd.allreduce(weights, op=hvd.Average, compression=hvd.Compression.int8)

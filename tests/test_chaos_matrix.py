@@ -23,6 +23,7 @@ import os
 import sys
 import time
 
+import numpy as np
 import pytest
 
 from horovod_tpu.runner import spawn
@@ -308,6 +309,57 @@ def test_sanitizer_quiet_under_chaos(tmp_path):
     assert "hvd-sanitize: blocking call" not in stderr, stderr
     assert "hvd-sanitize:" not in stderr or \
         "non-daemon thread" not in stderr, stderr
+
+
+def test_compression_recovery_matches_uncompressed(tmp_path):
+    """Compression row (ISSUE 6): the same injected collective failure
+    (rank 1's epoch-3 allreduce raises once) under
+    HVDTPU_COMPRESSION=int8 with error feedback. Elastic recovery must
+    complete exactly as in the uncompressed run — the residual store is
+    rebuilt with the new cohort (stale error-feedback state never
+    crosses membership versions; the version-bump reset is unit-tested
+    in test_compression.py) — and the accumulated training total must
+    match the uncompressed recovery run within quantization tolerance.
+    The COMPRESSION log line proves the quantized plane actually
+    engaged rather than silently falling back."""
+
+    def run(sub, compressed):
+        sub.mkdir()
+        extra = {"ELASTIC_TEST_EPOCHS": 6, "ELASTIC_TEST_EPOCH_SLEEP": 0.3}
+        if compressed:
+            extra["HVDTPU_COMPRESSION"] = "int8"
+            extra["HVDTPU_COMPRESSION_THRESHOLD"] = "1"
+        marker = sub / "collective.marker"
+        rc, driver, log_path, _ = _run_chaos_job(
+            sub, f"collective:fail:name=step3:rank=1:marker={marker}",
+            **extra)
+        content = _log_content(log_path)
+        assert rc == 0, content
+        assert marker.exists()  # the failure fired
+        assert driver.blacklist == set()
+        done = [line for line in content.splitlines() if "DONE" in line]
+        assert len(done) == 2, content
+        entries = _parse_log(log_path)
+        assert max(e[1] for e in entries) == 5
+        totals = sorted(float(line.rpartition("total=")[2])
+                        for line in done)
+        return totals, content
+
+    q_totals, q_content = run(tmp_path / "int8", compressed=True)
+    # The quantized plane really ran on every worker, with residuals
+    # stored for the named step tensors (post-recovery cohort).
+    comp_lines = [line for line in q_content.splitlines()
+                  if "COMPRESSION residuals=" in line]
+    assert len(comp_lines) == 2, q_content
+    assert all(int(line.rpartition("=")[2]) > 0 for line in comp_lines), \
+        comp_lines
+    plain_totals, plain_content = run(tmp_path / "plain",
+                                      compressed=False)
+    assert "COMPRESSION" not in plain_content
+    # Post-recovery training totals match within quantization
+    # tolerance: recovery under compression restores the same commit
+    # and converges to the same numbers.
+    np.testing.assert_allclose(q_totals, plain_totals, atol=1e-3)
 
 
 def test_collective_failure_injection_recovers(tmp_path):
