@@ -90,7 +90,9 @@ def _bench_resnet(hvd, hvd_jax, on_tpu):
 
 
 def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
-                       metric=None, compression=None):
+                       metric=None, compression=None, overlap=None):
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -126,6 +128,11 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
     # transformer gap (ROADMAP items 1 + 5).
     comp = (getattr(hvd.Compression, compression)
             if compression else None)
+    # --overlap sweep: the bucketed comm/compute overlap path
+    # (HVDTPU_OVERLAP, docs/performance.md) is baked into the train step
+    # at optimizer construction, so flip the knob before building it.
+    if overlap is not None:
+        os.environ["HVDTPU_OVERLAP"] = "1" if overlap else "0"
     opt = hvd_jax.DistributedOptimizer(
         optax.adamw(1e-4),
         **({"compression": comp} if comp is not None else {}))
@@ -186,6 +193,16 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
         out["compression"] = compression
         out["compression_ratio"] = round(wire_bytes / grad_bytes, 4)
         out["grad_bytes_saved_per_step"] = int(grad_bytes - wire_bytes)
+    if overlap is not None:
+        from horovod_tpu.ops import bucketing as _bucketing
+        from horovod_tpu.utils import envparse as _envparse
+        out["overlap"] = int(bool(overlap))
+        bucket_bytes = _envparse.get_int(
+            _envparse.BUCKET_BYTES, _bucketing.DEFAULT_BUCKET_BYTES)
+        out["bucket_bytes"] = bucket_bytes
+        if overlap:
+            out["buckets"] = len(_bucketing.plan_buckets(
+                jax.tree.leaves(params), bucket_bytes))
     return out
 
 
@@ -483,6 +500,52 @@ def main():
                  compression=codec, required=False,
                  metric=f"transformer_lm_365m_seq512_compression_"
                         f"{codec}_train_samples_per_sec_per_chip")
+    # --overlap: A/B the bucketed comm/compute overlap path (overlap
+    # on/off × compression none/int8) on the transformer line and
+    # archive the four rows to BENCH_r06.json (docs/performance.md).
+    if "--overlap" in sys.argv:
+        # The sweep mutates the overlap knobs per row; snapshot them so
+        # the lines AFTER the sweep (seq2048, keras, resnet headline)
+        # run under the caller's configuration, not the last row's.
+        _saved_knobs = {k: os.environ.get(k)
+                        for k in ("HVDTPU_OVERLAP", "HVDTPU_BUCKET_BYTES")}
+        # The off-TPU stand-in config has ~2 MB of gradients — at the
+        # 16 MiB default everything lands in one bucket and the A/B
+        # degenerates. Scale the bucket down so the sweep exercises a
+        # real multi-bucket schedule (a user-set knob always wins).
+        if not on_tpu and envparse.get_env(envparse.BUCKET_BYTES) is None:
+            os.environ["HVDTPU_BUCKET_BYTES"] = str(256 * 1024)
+        rows = []
+        for ov in (0, 1):
+            for codec in (None, "int8"):
+                tag = (f"overlap_{'on' if ov else 'off'}_comp_"
+                       f"{codec or 'none'}")
+                try:
+                    row = _bench_transformer(
+                        hvd, hvd_jax, on_tpu, overlap=ov,
+                        compression=codec,
+                        metric=f"transformer_lm_365m_seq512_{tag}"
+                               "_train_samples_per_sec_per_chip")
+                except Exception as e:  # noqa: BLE001 — best-effort row
+                    print(f"# bench: overlap row {tag} failed: {e!r}",
+                          file=sys.stderr, flush=True)
+                    continue
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+        try:
+            with open("BENCH_r06.json", "w") as f:
+                json.dump({"cmd": "python bench.py --overlap",
+                           "rows": rows}, f, indent=1)
+            print("# bench: overlap A/B archived to BENCH_r06.json",
+                  file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            print(f"# bench: BENCH_r06.json write failed: {e}",
+                  file=sys.stderr, flush=True)
+        for k, v in _saved_knobs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     # Long-context line: seq 2048 is where the einsum path cannot run at
     # all (27G logits > 15.75G HBM) and the flash kernel carries it.
     # TPU-only: off-TPU the small stand-in config would rerun the same

@@ -32,6 +32,13 @@ a static finding. Three rules:
   integer/bool tensor: compression exists for gradient reduction only
   (reference semantics); state sync must be exact and counts/masks
   have no lossy representation.
+- **HVD206** (warning) — a per-tensor eager ``allreduce`` whose tensor
+  is the iteration variable of an enclosing ``for`` loop (one blocking
+  collective per tensor): each call pays full dispatch + negotiation
+  latency serially. The bucketed API reduces the whole set in fused
+  buckets — ``grouped_allreduce(list)`` for explicit reductions, or
+  ``DistributedOptimizer`` (whose dispatch plane buckets and, under
+  ``HVDTPU_OVERLAP=1``, overlaps them with backprop) for gradients.
 
 The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
 analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
@@ -104,6 +111,11 @@ _UNNAMED_OK = (frozenset({
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_variables", "broadcast_object", "allgather_object",
 }) | LAX_COLLECTIVE_CALLS)
+# Per-tensor eager allreduce spellings (rule HVD206): the grouped_*
+# family IS the bucketed API and is exempt by construction.
+PER_TENSOR_ALLREDUCE_CALLS = frozenset({
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+})
 RANK_CALLS = frozenset({"rank", "local_rank", "cross_rank", "axis_index"})
 BROADCAST_STATE_CALLS = frozenset({
     "broadcast_parameters", "broadcast_optimizer_state",
@@ -380,6 +392,96 @@ class _Analyzer(ast.NodeVisitor):
                 self._report_201(call, "while")
             for call in self._checkpoint_calls_in(node.body):
                 self._report_204(call, "while")
+        self.generic_visit(node)
+
+    # -- HVD206: per-tensor allreduce in a loop ----------------------------
+    def _report_206(self, call):
+        self._flagged.add(id(call))
+        fn = _terminal_name(call.func)
+        self.diags.append(Diagnostic.make(
+            "HVD206",
+            f"per-tensor `{fn}` over the loop variable: one blocking "
+            "collective per tensor pays dispatch + negotiation latency "
+            "serially, which the bucketed API amortizes into fused "
+            "buckets",
+            file=self.filename, line=call.lineno,
+            hint="collect the tensors and make one grouped_allreduce() "
+                 "call, or reduce gradients through "
+                 "DistributedOptimizer (bucketed dispatch; "
+                 "HVDTPU_OVERLAP=1 overlaps buckets with backprop); "
+                 + _DOC_HINT))
+
+    @staticmethod
+    def _tensor_is_loop_var(expr, names):
+        """True when the reduced tensor IS the loop variable or a
+        subscript/attribute/arithmetic view of it. Values that reach
+        the loop variable only THROUGH a function call
+        (``allreduce(train_step(model, batch))``) are new per-iteration
+        data — the canonical per-batch metric reduction — and cannot be
+        bucketed, so the walk stops at Call boundaries."""
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Name) and n.id in names:
+                return True
+            if isinstance(n, ast.Call):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return False
+
+    def visit_For(self, node):
+        # A per-tensor eager allreduce whose tensor IS (or indexes
+        # through) the loop variable — the reduce-one-tensor-per-
+        # iteration shape. An unrelated allreduce in a training loop
+        # (one metric per epoch/batch) is not a finding.
+        names = {n.id for n in ast.walk(node.target)
+                 if isinstance(n, ast.Name)}
+        if names:
+            for sub in _scan_statements(node.body):
+                if (isinstance(sub, ast.Call)
+                        and id(sub) not in self._flagged
+                        and self._is_hvd_call(
+                            sub, PER_TENSOR_ALLREDUCE_CALLS)
+                        and sub.args
+                        and self._tensor_is_loop_var(sub.args[0], names)):
+                    self._report_206(sub)
+        self.generic_visit(node)
+
+    def _check_206_comp(self, node):
+        # The comprehension spelling of the same shape:
+        # [allreduce(g) for g in grads].
+        names = set()
+        for gen in node.generators:
+            names |= {n.id for n in ast.walk(gen.target)
+                      if isinstance(n, ast.Name)}
+        if not names:
+            return
+        body = [node.value, node.key] if isinstance(node, ast.DictComp) \
+            else [node.elt]
+        for part in body:
+            for sub in ast.walk(part):
+                if (isinstance(sub, ast.Call)
+                        and id(sub) not in self._flagged
+                        and self._is_hvd_call(
+                            sub, PER_TENSOR_ALLREDUCE_CALLS)
+                        and sub.args
+                        and self._tensor_is_loop_var(sub.args[0], names)):
+                    self._report_206(sub)
+
+    def visit_ListComp(self, node):
+        self._check_206_comp(node)
+        self.generic_visit(node)
+
+    def visit_SetComp(self, node):
+        self._check_206_comp(node)
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node):
+        self._check_206_comp(node)
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node):
+        self._check_206_comp(node)
         self.generic_visit(node)
 
     # -- HVD205: lossy compression misuse ----------------------------------

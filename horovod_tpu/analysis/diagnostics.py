@@ -32,6 +32,10 @@ RULES = {
     "HVD205": (WARNING, "lossy compressor applied to an integer/bool "
                         "tensor or a broadcast/initial-sync collective "
                         "(compression is for gradient reduction only)"),
+    "HVD206": (WARNING, "per-tensor eager allreduce inside a loop "
+                        "(serializes per-collective latency; use "
+                        "grouped_allreduce or DistributedOptimizer's "
+                        "bucketed dispatch)"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "

@@ -242,6 +242,22 @@ class Coordinator:
         self._m_fusion_eff = telemetry.gauge(
             "hvd_coordinator_fusion_efficiency",
             "payload / (payload + padding) of the last fused buffer")
+        # Bucketed comm/compute overlap (HVDTPU_OVERLAP;
+        # docs/performance.md): the eager plane issues fusion buckets
+        # asynchronously in priority (submission) order, then completes
+        # them — instead of one blocking dispatch per bucket.
+        from .ops.bucketing import DEFAULT_BUCKET_BYTES
+        self._overlap = envparse.get_bool(envparse.OVERLAP)
+        self._bucket_bytes = envparse.get_int(
+            envparse.BUCKET_BYTES, DEFAULT_BUCKET_BYTES)
+        self._m_overlap_fraction = telemetry.gauge(
+            "hvd_overlap_fraction",
+            "Share of the last cycle's collective in-flight time hidden "
+            "under other work (issue/prep of later buckets) rather than "
+            "blocking the cycle thread")
+        self._m_overlap_hidden_s = telemetry.histogram(
+            "hvd_overlap_hidden_seconds",
+            "Per-bucket collective time hidden under later dispatches")
         self._m_stalled = telemetry.gauge(
             "hvd_coordinator_stalled_ops",
             "In-flight operations older than the stall threshold")
@@ -764,35 +780,66 @@ class Coordinator:
                    str(jnp.asarray(a).dtype), e.codec)
             groups.setdefault(key, []).append(e)
 
+        # Overlap mode trades the 128 MiB fusion ceiling for smaller
+        # buckets: several independently dispatchable collectives per
+        # cycle beat one giant barrier (docs/performance.md). Only the
+        # single-controller (XlaSingle) and loopback backends reach this
+        # path — backends that drive their own cycle (tcp/xla-global)
+        # negotiate in _loop_native — so backend.allreduce here is the
+        # lazy jax dispatch the async issue phase assumes.
+        threshold = (self._bucket_bytes if self._overlap
+                     else self.fusion_threshold)
+        all_buckets = []
         for key, group in groups.items():
-            # Split group into buckets under the fusion threshold.
-            buckets, cur, cur_bytes = [], [], 0
+            # Split group into buckets under the threshold.
+            cur, cur_bytes = [], 0
             for e in group:
                 b = sum(_nbytes(jnp.asarray(a)) for a in e.arrays)
-                if cur and cur_bytes + b > self.fusion_threshold:
-                    buckets.append(cur)
+                if cur and cur_bytes + b > threshold:
+                    all_buckets.append(cur)
                     cur, cur_bytes = [], 0
                 cur.append(e)
                 cur_bytes += b
             if cur:
-                buckets.append(cur)
-            for bucket in buckets:
+                all_buckets.append(cur)
+        if not self._overlap or len(all_buckets) <= 1:
+            for bucket in all_buckets:
                 self._execute_allreduce_bucket(backend, bucket, timeline)
+            return
+        # Priority order: first-submitted first. Framework grad hooks
+        # submit gradients in the order backprop produces them (last
+        # layers first), so earlier entries are the ones the peer plane
+        # has been ready to reduce longest.
+        all_buckets.sort(key=lambda b: min(e.enqueue_time for e in b))
+        issued = []
+        for bucket in all_buckets:
+            self._execute_allreduce_bucket(backend, bucket, timeline,
+                                           issued=issued)
+        if self._metrics_on and issued:
+            self._observe_overlap(issued)
 
-    def _execute_allreduce_bucket(self, backend, bucket, timeline):
+    def _execute_allreduce_bucket(self, backend, bucket, timeline,
+                                  issued=None):
         """One fused collective for a bucket of allreduce entries.
 
         On TPU "fusion" means handing the whole bucket to one compiled XLA
         program — the backend receives the full list and XLA emits a single
         fused collective schedule, replacing the reference's hand-written
         batched memcpy kernels (reference: cuda/cuda_kernels.cu:45-139).
+
+        Dispatch is asynchronous (jax arrays are futures): handles
+        complete with lazy results and waiters force them off the cycle
+        thread. On the overlap path (``issued`` is a list) the span is
+        labeled per-bucket and (bucket, results, t_issued) is recorded
+        so :meth:`_observe_overlap` can measure how much of each
+        bucket's in-flight time stayed hidden under later dispatches.
         """
         e0 = bucket[0]
         names = [e.name for e in bucket]
         if self._metrics_on:
             self._record_fusion_stats(bucket)
-        span_kind = ("fused_allreduce" if e0.codec is None
-                     else "fused_allreduce_compressed")
+        base = "fused_allreduce" if issued is None else "bucket_allreduce"
+        span_kind = base if e0.codec is None else base + "_compressed"
         try:
             with tele_span(names, "FUSED_ALLREDUCE", timeline=timeline,
                            histogram=self._m_dispatch_s.labels(
@@ -807,6 +854,8 @@ class Coordinator:
                     results = backend.allreduce(
                         flat, e0.op, e0.process_set,
                         prescale=e0.prescale, postscale=e0.postscale)
+                if issued is not None:
+                    issued.append((bucket, results, time.monotonic()))
                 i = 0
                 for e in bucket:
                     k = len(e.arrays)
@@ -862,6 +911,57 @@ class Coordinator:
             plane.store_residuals(bucket, new_residuals)
         plane.record(codec_name, bucket, flat, new_residuals)
         return results
+
+    def _observe_overlap(self, issued):
+        """Metrics-on only: walk the overlap buckets in issue order and
+        classify each bucket's in-flight time as *hidden* or *blocked*.
+        A bucket found already complete (``is_ready``) before its force
+        genuinely finished while the cycle thread was doing other work
+        — issuing later buckets or draining earlier ones — and its
+        whole flight counts as hidden; a bucket that still has to be
+        forced counts only the force's wait, as blocked (time merely
+        elapsed while we waited on an EARLIER bucket is NOT hidden —
+        this collective may have made no progress then, so crediting it
+        would inflate the gauge into meaninglessness on serial
+        backends). ``hvd_overlap_fraction`` = hidden/(hidden+blocked);
+        per-bucket hidden time feeds ``hvd_overlap_hidden_seconds``.
+        Runs only under HOROVOD_TPU_METRICS: forcing results on the
+        cycle thread is a measurement cost the default path must not
+        pay (waiters force lazily in their own threads either way)."""
+        import jax
+        hidden = blocked = 0.0
+        ready_at = {}
+
+        def sweep(start, now):
+            for j in range(start, len(issued)):
+                if j not in ready_at and _results_ready(issued[j][1]):
+                    ready_at[j] = now
+
+        sweep(0, time.monotonic())
+        for idx, (bucket, results, t_issued) in enumerate(issued):
+            names = [e.name for e in bucket]
+            if idx in ready_at:
+                h = max(0.0, ready_at[idx] - t_issued)
+                hidden += h
+                self._m_overlap_hidden_s.observe(h)
+                continue
+            t0 = time.monotonic()
+            try:
+                with tele_span(names, "BUCKET_INFLIGHT",
+                               timeline=self.runtime.timeline,
+                               histogram=self._m_dispatch_s.labels(
+                                   kind="bucket_wait")):
+                    jax.block_until_ready(results)
+            except Exception:  # noqa: BLE001 — surfaced to the waiter
+                # A deferred collective failure raises at the waiter's
+                # own force too; measurement must not eat the cycle.
+                continue
+            blocked += max(0.0, time.monotonic() - t0)
+            # Later buckets that completed while this one blocked were
+            # genuinely running concurrently — record before moving on.
+            sweep(idx + 1, time.monotonic())
+        if hidden + blocked > 0.0:
+            self._m_overlap_fraction.set(hidden / (hidden + blocked))
 
     def _record_fusion_stats(self, bucket):
         """Fusion-plane accounting (metrics on only): queue-wait per
@@ -932,6 +1032,18 @@ class Coordinator:
             _nbytes(np.asarray(a)) if not hasattr(a, "dtype") else
             _nbytes(a) for a in e.arrays)
         return out
+
+
+def _results_ready(results):
+    """True when every jax array in a bucket's results has completed
+    (``is_ready``); non-jax results count as ready."""
+    try:
+        leaves = results if isinstance(results, (list, tuple)) \
+            else [results]
+        return all(r.is_ready() for r in leaves
+                   if hasattr(r, "is_ready"))
+    except Exception:  # noqa: BLE001 — a failed result is "done" too
+        return True
 
 
 def _wrap_error(exc):

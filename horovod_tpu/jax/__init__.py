@@ -109,6 +109,17 @@ class DistributedOptimizer:
         # quantized_allreduce_axis on the axis path, via the entry codec
         # marker on the eager plane. Adasum needs exact per-rank
         # gradients — reject loudly instead of quantizing them.
+        # Bucketed comm/compute overlap (HVDTPU_OVERLAP;
+        # docs/performance.md): the in-jit axis reduction is emitted as
+        # one collective per ~HVDTPU_BUCKET_BYTES bucket instead of one
+        # per leaf, giving XLA's scheduler per-bucket dependencies it
+        # can overlap with the remaining backward pass. Read once at
+        # construction — the train step bakes the plan at trace time.
+        from ..utils import envparse as _ep
+        from ..ops import bucketing as _bucketing
+        self._overlap = _ep.get_bool(_ep.OVERLAP)
+        self._bucket_bytes = _ep.get_int(
+            _ep.BUCKET_BYTES, _bucketing.DEFAULT_BUCKET_BYTES)
         self._wire_codec = getattr(compression, "wire_codec", None)
         if self._wire_codec is not None:
             from ..compression import codecs as _codecs
@@ -143,8 +154,19 @@ class DistributedOptimizer:
             ctxs = [p[1] for p in pairs]
 
         if self.axis_name is not None:
-            out = _reduce_in_axis(comp_grads, self.op, self.axis_name,
-                                  self.prescale, self.postscale)
+            if self._overlap and self.op in (reduce_ops.Average,
+                                             reduce_ops.Sum):
+                from ..ops.bucketing import bucketed_reduce_axis
+                leaves, treedef = jax.tree.flatten(comp_grads)
+                out = jax.tree.unflatten(treedef, bucketed_reduce_axis(
+                    leaves, self.op, self.axis_name,
+                    bucket_bytes=self._bucket_bytes,
+                    prescale=self.prescale, postscale=self.postscale))
+            else:
+                # Adasum (or OVERLAP=0): per-leaf reduction — Adasum's
+                # per-tensor combination cannot be bucketed.
+                out = _reduce_in_axis(comp_grads, self.op, self.axis_name,
+                                      self.prescale, self.postscale)
         else:
             rt = basics.runtime()
             if rt.mode == basics.MODE_SPMD:
@@ -180,6 +202,19 @@ class DistributedOptimizer:
 
         if self.axis_name is not None:
             average = self.op == reduce_ops.Average
+            if self._overlap:
+                # One quantized pipeline per bucket: both collective
+                # legs of every bucket ride the wire format, and the
+                # per-bucket dependencies overlap with backprop exactly
+                # like the plain bucketed path (docs/performance.md).
+                from ..ops.bucketing import bucketed_reduce_axis
+                leaves, treedef = jax.tree.flatten(grads)
+                return jax.tree.unflatten(treedef, bucketed_reduce_axis(
+                    leaves, self.op, self.axis_name,
+                    bucket_bytes=self._bucket_bytes,
+                    prescale=self.prescale, postscale=self.postscale,
+                    wire_codec=self._wire_codec,
+                    block=self._wire_block))
 
             def red(g):
                 if self.prescale is not None:
@@ -231,20 +266,23 @@ class DistributedOptimizer:
         count = count + 1
         do_step = (count % self.k) == 0
 
-        def apply(operand):
-            inner_state, acc = operand
-            g = acc
-            if self.average_aggregated:
-                g = jax.tree.map(lambda a: a / self.k, g)
-            updates, new_inner = self.inner.update(g, inner_state, params)
-            return updates, new_inner, jax.tree.map(jnp.zeros_like, acc)
+        g = acc
+        if self.average_aggregated:
+            g = jax.tree.map(lambda a: a / self.k, g)
+        updates, stepped_inner = self.inner.update(g, inner_state, params)
 
-        def skip(operand):
-            inner_state, acc = operand
-            return (jax.tree.map(jnp.zeros_like, acc), inner_state, acc)
+        # Merge the stepped and held states with a select rather than
+        # lax.cond: the optimizer update is a few elementwise ops per
+        # parameter (noise next to the backward pass), and cond branches
+        # break the shard_map replication checker on pre-vma jax
+        # ("branches produced mismatched replication types").
+        def pick(a, b):
+            return jnp.where(do_step, a, b)
 
-        updates, new_inner, new_acc = lax.cond(
-            do_step, apply, skip, (inner_state, acc))
+        updates = jax.tree.map(lambda u: pick(u, jnp.zeros_like(u)),
+                               updates)
+        new_inner = jax.tree.map(pick, stepped_inner, inner_state)
+        new_acc = jax.tree.map(lambda a: pick(jnp.zeros_like(a), a), acc)
         return updates, (new_inner, new_acc, count)
 
     def _update_aggregated_eager(self, grads, state, params):

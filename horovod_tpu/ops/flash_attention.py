@@ -34,6 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..utils import envparse
+from ..utils import jax_compat
 
 _bridge_fallback_noted = set()
 
@@ -289,11 +290,8 @@ def _fwd_call(q, k, v, lens, sm_scale, causal, block_q, block_k,
         _struct((bh, sq, d), q.dtype, q, k, v, lens),
         _struct((bh, 1, sq), jnp.float32, q, k, v, lens),
     ]
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
-    except TypeError:  # older/newer jax without this field
-        compiler_params = None
+    compiler_params = jax_compat.tpu_compiler_params(
+        ("parallel", "parallel", "arbitrary"))
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -494,11 +492,8 @@ def _bwd_call(q, k, v, o, do, lse, lens, sm_scale, causal, block_q, block_k,
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
 
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
-    except TypeError:
-        compiler_params = None
+    compiler_params = jax_compat.tpu_compiler_params(
+        ("parallel", "parallel", "arbitrary"))
 
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i, lens: (b, i, 0)),
@@ -711,7 +706,11 @@ def _varying(*xs):
             *(jax.typeof(x).vma for x in xs if hasattr(x, "dtype")
               or not np.isscalar(x))))
     except (AttributeError, TypeError):
-        return False
+        # Pre-varying-types jax: no vma on avals. Any named axis in the
+        # tracing env means we are inside a shard_map/pmap body, where
+        # interpret-mode pallas_call has no replication rule — treat it
+        # as varying so the caller takes the einsum fallback.
+        return jax_compat.inside_named_axis()
 
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,

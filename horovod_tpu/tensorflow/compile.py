@@ -472,10 +472,11 @@ def _classify_static_mask(mval, kind, n_q, n_k):
     bottom-right-aligned causal pattern — keep[i, j] iff
     j <= i + (n_k - n_q), which the kernel reproduces with
     q_offset = n_k - n_q — else None (fall back to einsum)."""
-    import jax
-    jnp = _jnp()
-    with jax.ensure_compile_time_eval():
-        m = np.asarray(jnp.asarray(mval))
+    # mval is concrete (the caller filtered tracers) — concretize with
+    # numpy directly: jnp.asarray would re-lift it into the ambient
+    # trace (JVP/grad) where even ensure_compile_time_eval cannot
+    # concretize it back on older jax.
+    m = np.asarray(mval)
     if kind == "select":
         if m.dtype != np.bool_:
             return None
@@ -498,6 +499,11 @@ def _classify_static_mask(mval, kind, n_q, n_k):
     if (flat[0] == causal).all():
         return "causal", n_k - n_q
     return None
+
+
+def _concrete_or_none(x):
+    from ..utils.jax_compat import concrete_or_none
+    return concrete_or_none(x)
 
 
 def _try_flash_attention(env, plan, opr):
@@ -524,31 +530,31 @@ def _try_flash_attention(env, plan, opr):
         return None
     sm_scale = 1.0
     if plan["scale"] is not None:
-        sval = env.get(plan["scale"])
-        if isinstance(sval, jax.core.Tracer):
+        sval = _concrete_or_none(env.get(plan["scale"]))
+        if sval is None:
             _note_flash_fallback("non-constant attention scale")
             return None
-        with jax.ensure_compile_time_eval():
-            sm_scale = float(jnp.asarray(sval))
+        # concrete (tracers filtered above): concretize via numpy —
+        # jnp.asarray would re-lift into an ambient JVP/grad trace.
+        sm_scale = float(np.asarray(sval))
         if plan["scale_kind"] == "div":
             if sm_scale == 0.0:
                 return None
             sm_scale = 1.0 / sm_scale
     causal = False
     if plan["mask"] is not None:
-        mval = env.get(plan["mask"])
-        if isinstance(mval, jax.core.Tracer):
+        mval = _concrete_or_none(env.get(plan["mask"]))
+        if mval is None:
             _note_flash_fallback(
                 "attention mask is not a compile-time constant")
             return None
         if plan["mask_kind"] == "select":
             # the on-false fill must actually block (≤ -1e8)
-            neg = env.get(plan["neg"])
-            if isinstance(neg, jax.core.Tracer):
+            neg = _concrete_or_none(env.get(plan["neg"]))
+            if neg is None:
                 _note_flash_fallback("non-constant masked-softmax fill")
                 return None
-            with jax.ensure_compile_time_eval():
-                neg_ok = bool((jnp.asarray(neg) <= -1e8).all())
+            neg_ok = bool((np.asarray(neg) <= -1e8).all())
             if not neg_ok:
                 _note_flash_fallback(
                     "masked-softmax fill value is not a large negative")

@@ -118,17 +118,21 @@ def _resolve_static_mask(attn_mask, jnp):
     if attn_mask is None:
         return None
     import jax
-    if isinstance(attn_mask, jax.core.Tracer):
+
+    from ..utils.jax_compat import concrete_or_none
+    concrete = concrete_or_none(attn_mask)
+    if concrete is None:
         return attn_mask
-    # The mask is concrete (const-folded), but any op on it inside the
-    # jit trace would be staged — inspect it at compile time instead.
-    with jax.ensure_compile_time_eval():
-        m = jnp.asarray(attn_mask)
-        if m.dtype == jnp.bool_:
-            if bool(m.all()):
-                return None
-        elif bool((m == 0).all()):
+    # The mask is concrete (const-folded, possibly behind a check_rep
+    # RewriteTracer under shard_map), but any op on it inside the jit
+    # trace would be staged — inspect it at compile time instead.
+    import numpy as _np
+    m = _np.asarray(concrete)
+    if m.dtype == _np.bool_:
+        if bool(m.all()):
             return None
+    elif bool((m == 0).all()):
+        return None
     return attn_mask
 
 

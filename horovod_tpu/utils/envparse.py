@@ -238,6 +238,16 @@ COMPRESSION_ERROR_FEEDBACK = register(
     "Carry per-tensor quantization error into the next step's "
     "gradient (eager/fusion plane only)")
 
+# -- comm/compute overlap (docs/performance.md) ----------------------------
+OVERLAP = register(
+    "OVERLAP", "0",
+    "Bucketed comm/compute overlap: per-bucket gradient collectives "
+    "the scheduler can run under remaining backprop (in-jit axis "
+    "path), priority-ordered async bucket dispatch (eager plane)")
+BUCKET_BYTES = register(
+    "BUCKET_BYTES", "16 MiB",
+    "Payload bytes per gradient bucket on the overlap path")
+
 # -- kernels ----------------------------------------------------------------
 BRIDGE_FLASH = register(
     "BRIDGE_FLASH", "auto",

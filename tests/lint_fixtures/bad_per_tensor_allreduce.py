@@ -1,0 +1,30 @@
+"""Fixture: per-tensor allreduce in a loop (HVD206 x3, docs/lint.md)."""
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.collectives import allreduce_async
+
+hvd.init()
+
+grads = [jnp.zeros((8, 128)) for _ in range(4)]
+named = {"w": jnp.zeros((8,)), "b": jnp.zeros((8,))}
+
+# HVD206: one blocking collective per gradient, serial latency.
+reduced = []
+for g in grads:
+    reduced.append(hvd.allreduce(g, op=hvd.Average))
+
+# HVD206: same shape through the dict spelling.
+for k, g in named.items():
+    named[k] = hvd.allreduce(g, name=k)
+
+# HVD206: async does not help — handles are created one tensor at a time.
+handles = [allreduce_async(g) for _ in range(1) for g in grads]
+
+# Fine: the bucketed API — the whole list fuses into buckets.
+reduced = hvd.grouped_allreduce(grads, op=hvd.Average)
+
+# Fine: a metric reduced once per epoch is not a per-tensor loop.
+for epoch in range(3):
+    loss = hvd.allreduce(jnp.zeros(()), name="loss")

@@ -166,6 +166,44 @@ class TestAstRules:
     def test_suppression_comments(self):
         assert self.lint("good_suppressed.py") == []
 
+    def test_per_tensor_allreduce_fixture(self):
+        assert rules_of(self.lint("bad_per_tensor_allreduce.py")) == \
+            ["HVD206", "HVD206", "HVD206"]
+
+    def test_loop_invariant_allreduce_is_clean(self):
+        # One metric per epoch is not the per-tensor-reduction shape.
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.init()\n"
+               "for epoch in range(5):\n"
+               "    loss = hvd.allreduce(metric, name='loss')\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_per_batch_metric_through_call_is_clean(self):
+        # The canonical per-batch metric reduction: the value reaches
+        # the loop variable only through a function call, so it is new
+        # per-iteration data — not bucketable, not a finding.
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.init()\n"
+               "for batch in loader:\n"
+               "    loss = hvd.allreduce(train_step(model, batch),\n"
+               "                         name='loss')\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_grouped_allreduce_in_loop_is_clean(self):
+        # grouped_* IS the bucketed API; chunked grouped calls are fine.
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.init()\n"
+               "for chunk in chunks:\n"
+               "    outs = hvd.grouped_allreduce(chunk)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_per_tensor_allreduce_suppressible(self):
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.init()\n"
+               "for g in grads:\n"
+               "    hvd.allreduce(g)  # hvd-lint: disable=HVD206\n")
+        assert ast_lint.lint_source(src) == []
+
     def test_rank_guarded_logging_is_clean(self):
         src = ("import horovod_tpu as hvd\n"
                "hvd.init()\n"

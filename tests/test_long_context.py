@@ -7,6 +7,7 @@ on TPU the compiled kernel engages) — the ring schedule, collectives and
 autodiff path are identical either way."""
 
 import jax
+from horovod_tpu.utils.jax_compat import shard_map, vary_replicated
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -54,11 +55,14 @@ def test_ring_flash_seq4k_gradient_parity():
     x, y = _data()
 
     def ring_loss(p, x, y):
+        # p is a replicated shard_map input: declare it varying so its
+        # cotangent reduces across 'sp' (vma-jax auto-inserts this).
+        p = jax.tree.map(lambda w: vary_replicated(w, "sp"), p)
         out = _model(p, x, lambda q, k, v: ring_attention(
             q, k, v, "sp", causal=True, impl="flash"))
         return jax.lax.pmean(jnp.mean((out - y) ** 2), "sp")
 
-    g_ring = jax.jit(jax.shard_map(
+    g_ring = jax.jit(shard_map(
         jax.grad(ring_loss), mesh=_mesh(),
         in_specs=(P(), P(None, "sp", None), P(None, "sp", None)),
         out_specs=P()))(p, x, y)
@@ -84,6 +88,7 @@ def test_ring_flash_seq4k_training_descends():
 
     def step(p, x, y):
         def loss_fn(p):
+            p = jax.tree.map(lambda w: vary_replicated(w, "sp"), p)
             out = _model(p, x, lambda q, k, v: ring_attention(
                 q, k, v, "sp", causal=True, impl="flash"))
             return jax.lax.pmean(jnp.mean((out - y) ** 2), "sp")
@@ -91,7 +96,7 @@ def test_ring_flash_seq4k_training_descends():
         new_p = jax.tree.map(lambda w, gw: w - lr * gw, p, g)
         return new_p, loss
 
-    jstep = jax.jit(jax.shard_map(
+    jstep = jax.jit(shard_map(
         step, mesh=_mesh(),
         in_specs=(P(), P(None, "sp", None), P(None, "sp", None)),
         out_specs=(P(), P())))
@@ -110,7 +115,7 @@ def test_ring_flash_seq4k_output_matches_dense(n):
     p = _params(3)
     x, _ = _data(4)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda p, x: _model(p, x, lambda q, k, v: ring_attention(
             q, k, v, "sp", causal=True, impl="flash")),
         mesh=_mesh(n), in_specs=(P(), P(None, "sp", None)),

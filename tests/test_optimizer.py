@@ -3,6 +3,7 @@ DistributedOptimizer tests in test/parallel/test_torch.py and the MNIST
 example smoke runs in CI, .buildkite/gen-pipeline.sh:155-279)."""
 
 import jax
+from horovod_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -229,7 +230,7 @@ def test_adasum_axis_matches_pairwise_vhdd_oracle(hvd, n_devices):
         for i in range(n)])
     mesh = Mesh(np.array(jax.devices()[:n]), ("r",))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda x: adasum_axis(x[0], "r")[None],
         mesh=mesh, in_specs=P("r"), out_specs=P("r")))(jnp.asarray(stacked))
     expect = _np_vhdd(stacked)

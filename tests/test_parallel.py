@@ -7,6 +7,7 @@ replicated execution.
 """
 
 import jax
+from horovod_tpu.utils.jax_compat import shard_map, vary_replicated
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -53,7 +54,7 @@ def test_ring_attention_matches_full(causal, impl):
     def body(q, k, v):
         return ring_attention(q, k, v, "sp", causal=causal, impl=impl)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=P(None, None, "sp", None),
         out_specs=P(None, None, "sp", None)))(q, k, v)
@@ -71,7 +72,7 @@ def test_ring_attention_gradients():
         def body(q, k, v):
             o = ring_attention(q, k, v, "sp", causal=True)
             return jnp.sum(o ** 2)
-        losses = jax.shard_map(
+        losses = shard_map(
             lambda q, k, v: jnp.array([body(q, k, v)]),
             mesh=mesh,
             in_specs=P(None, None, "sp", None), out_specs=P("sp"))(q, k, v)
@@ -99,7 +100,7 @@ def test_ulysses_matches_full(causal):
     def body(q, k, v):
         return ulysses_attention(q, k, v, "sp", causal=causal)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=P(None, None, "sp", None),
         out_specs=P(None, None, "sp", None)))(q, k, v)
@@ -116,7 +117,7 @@ def test_ulysses_rejects_indivisible_heads():
         return ulysses_attention(q, q, q, "sp")
 
     with pytest.raises(ValueError, match="divisible"):
-        jax.jit(jax.shard_map(
+        jax.jit(shard_map(
             body, mesh=mesh, in_specs=P(None, None, "sp", None),
             out_specs=P(None, None, "sp", None)))(q)
 
@@ -138,7 +139,7 @@ def test_pipeline_matches_sequential():
 
     # Inputs are sharded over pp (batch m lives on rank m // (M/n)) and
     # stream to stage 0 through the feed register — nothing replicated.
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda w, x: pipeline_apply(stage_fn, w, x, "pp"),
         mesh=mesh, in_specs=(P("pp"), P("pp")), out_specs=P()))(
             stacked, x)
@@ -166,7 +167,7 @@ def test_pipeline_gradients_match_sequential():
         def body(w, x):
             y = pipeline_apply(stage_fn, w, x, "pp")
             return jnp.sum(y ** 2)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(P("pp"), P("pp")),
             out_specs=P())(stacked_w, x)
 
@@ -219,7 +220,7 @@ def test_pipeline_transformer_stages_with_hetero_ends():
     tokens = jnp.asarray(
         np.random.RandomState(7).randint(0, vocab, size=(m, mb, seq)))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda w, e, hd, t: pipeline_apply(
             stage_fn, w, t, "pp", first_fn=first_fn, first_params=e,
             last_fn=last_fn, last_params=hd),
@@ -254,7 +255,7 @@ def test_pipeline_rounds_interleaved_placement():
         def body(w, x):
             y = pipeline_apply(stage_fn, w, x, "pp", rounds=rounds)
             return jnp.sum(y ** 2)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(P("pp"), P("pp")),
             out_specs=P())(w, x)
 
@@ -298,7 +299,7 @@ def test_moe_expert_parallel_matches_single():
 
     # Tokens replicated (every rank dispatches the same tokens would double
     # count — instead shard tokens over ep like dp ranks do).
-    y, aux = jax.jit(jax.shard_map(
+    y, aux = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P("ep"), P(), P("ep"), P("ep")),
         out_specs=(P("ep"), P("ep"))))(x, w_gate, w_in, w_out)
@@ -328,11 +329,14 @@ def test_moe_gate_gradient_matches_replicated_oracle():
 
     def loss_ep(x, wg, wi, wo):
         from jax import lax
+        # wg is the replicated gate: declare it varying so its cotangent
+        # is the cross-rank reduction (vma-jax auto-inserts this).
+        wg = vary_replicated(wg, "ep")
         y, _ = moe_apply(x, wg, wi, wo, axis_name="ep", k=2,
                          capacity_factor=8.0)
         return lax.psum(jnp.sum(y ** 2), "ep")
 
-    g_ep = jax.jit(jax.shard_map(
+    g_ep = jax.jit(shard_map(
         jax.grad(loss_ep, argnums=1), mesh=mesh,
         in_specs=(P("ep"), P(), P("ep"), P("ep")),
         out_specs=P()))(x, w_gate, w_in, w_out)
