@@ -39,6 +39,13 @@ a static finding. Three rules:
   buckets — ``grouped_allreduce(list)`` for explicit reductions, or
   ``DistributedOptimizer`` (whose dispatch plane buckets and, under
   ``HVDTPU_OVERLAP=1``, overlaps them with backprop) for gradients.
+- **HVD207** (warning) — a raw ``t0 = time.time()/perf_counter()``
+  begin read whose elapsed (``clock() - t0``) feeds a metric
+  ``observe()``: the ``telemetry.spans.span`` context is the single
+  instrument that feeds the histogram AND the timeline AND the trace
+  plane, and its disabled mode reads no clock at all. ``monotonic`` /
+  ``perf_counter_ns`` pairs and elapsed values that go to logs (not
+  metrics) are not findings.
 
 The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
 analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
@@ -611,6 +618,122 @@ class _Analyzer(ast.NodeVisitor):
 
 
 # ==========================================================================
+# HVD207: raw begin/end timing pairs instead of the span API
+# ==========================================================================
+
+# Clocks the span API replaces. monotonic/perf_counter_ns are exempt:
+# they back interval bookkeeping (stall ages, cycle pacing), not metric
+# observations.
+_SPAN_CLOCKS = frozenset({"time", "perf_counter"})
+
+
+def _is_span_clock_call(node):
+    """``time.time()`` / ``time.perf_counter()`` (or the bare
+    from-imported spellings) with no arguments."""
+    if not isinstance(node, ast.Call) or node.args or node.keywords:
+        return False
+    term = _terminal_name(node.func)
+    if term not in _SPAN_CLOCKS:
+        return False
+    if isinstance(node.func, ast.Attribute):
+        return _root_name(node.func) == "time"
+    return True
+
+
+def _clock_in(node):
+    """The clock call inside an expression that may be conditioned
+    (``t0 = time.perf_counter() if metrics_on else 0.0``)."""
+    if _is_span_clock_call(node):
+        return node
+    if isinstance(node, ast.IfExp):
+        for branch in (node.body, node.orelse):
+            if _is_span_clock_call(branch):
+                return branch
+    return None
+
+
+class _RawTimingAnalyzer:
+    """HVD207 over one module: per scope, find ``t0 = <clock>()``
+    followed by ``.observe(<clock>() - t0)`` (directly, or through one
+    ``elapsed = <clock>() - t0`` hop)."""
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+
+    def run(self, tree):
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        for scope in scopes:
+            self._scan_scope(scope)
+        return self.diags
+
+    @staticmethod
+    def _scope_walk(scope):
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _elapsed_of(expr, begin_names):
+        """The begin-variable name when ``expr`` is
+        ``<clock>() - <t0>``, else None."""
+        if (isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Sub)
+                and _is_span_clock_call(expr.left)
+                and isinstance(expr.right, ast.Name)
+                and expr.right.id in begin_names):
+            return expr.right.id
+        return None
+
+    def _scan_scope(self, scope):
+        # Separate passes: the scope walk is not in source order, so
+        # begin names must be fully collected before elapsed ones.
+        assigns = [n for n in self._scope_walk(scope)
+                   if isinstance(n, ast.Assign) and len(n.targets) == 1
+                   and isinstance(n.targets[0], ast.Name)]
+        begin_names = {n.targets[0].id: n.lineno for n in assigns
+                       if _clock_in(n.value) is not None}
+        if not begin_names:
+            return
+        elapsed_names = {}  # name -> (begin name, lineno)
+        for n in assigns:
+            t0 = self._elapsed_of(n.value, begin_names)
+            if t0 is not None:
+                elapsed_names[n.targets[0].id] = (t0, n.lineno)
+        for node in self._scope_walk(scope):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "observe" and node.args):
+                continue
+            arg = node.args[0]
+            t0 = self._elapsed_of(arg, begin_names)
+            if t0 is None and isinstance(arg, ast.Name) \
+                    and arg.id in elapsed_names:
+                t0 = elapsed_names[arg.id][0]
+            if t0 is None:
+                continue
+            self.diags.append(Diagnostic.make(
+                "HVD207",
+                f"raw `{t0} = time.time()/perf_counter()` begin/end "
+                "pair feeding `.observe()`: the span API is the single "
+                "instrument for the histogram, the timeline AND the "
+                "trace plane, and its disabled mode reads no clock",
+                file=self.filename, line=node.lineno,
+                hint="wrap the timed region in `with telemetry.span("
+                     "names, ACTIVITY, histogram=...)`; if the "
+                     "observation is genuinely conditional (a span "
+                     "observes unconditionally), document why and "
+                     "suppress with `# hvd-lint: disable=HVD207`; "
+                     + _DOC_HINT))
+
+
+# ==========================================================================
 # HVD3xx: concurrency & liveness (the static half of hvd-sanitize)
 # ==========================================================================
 
@@ -1069,6 +1192,7 @@ def lint_source(src, filename="<string>"):
     analyzer = _Analyzer(filename)
     analyzer.visit(tree)
     diags = analyzer.finish()
+    diags.extend(_RawTimingAnalyzer(filename).run(tree))
     diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)
     return dedupe(sorted(diags, key=Diagnostic.sort_key))

@@ -36,6 +36,10 @@ RULES = {
                         "(serializes per-collective latency; use "
                         "grouped_allreduce or DistributedOptimizer's "
                         "bucketed dispatch)"),
+    "HVD207": (WARNING, "raw time.time()/perf_counter() begin/end pair "
+                        "feeding a metric observe() — use the "
+                        "telemetry.spans.span API (one instrument for "
+                        "histogram + timeline + trace plane)"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "

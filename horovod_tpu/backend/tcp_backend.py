@@ -19,6 +19,7 @@ import numpy as np
 from . import Backend
 from .. import chaos
 from .. import native
+from .. import tracing
 from ..exceptions import HorovodInternalError, StalledTensorError
 from ..ops import reduce_ops
 from ..telemetry import core as telemetry
@@ -187,11 +188,17 @@ class TcpBackend(Backend):
             if self._metrics_on:
                 pending.t0 = time.perf_counter()
                 pending.nbytes = telemetry.payload_nbytes(entry.arrays)
+            # Trace plane: the instant this entry entered NATIVE
+            # negotiation — on the merged trace the gap between this and
+            # the peers' marks is the negotiation wait (one global read
+            # + None check when tracing AND flight recorder are off).
+            tracing.trace_event("neg", entry.name or entry.kind,
+                                o=getattr(entry, "corr", None))
             self._pending.append(pending)
             return True
         except Exception as exc:  # noqa: BLE001 - surfaced via the handle
             if self.entry_done_cb:
-                self.entry_done_cb(entry)
+                self.entry_done_cb(entry, ok=False)
             entry.handle._fail(exc if isinstance(exc, HorovodInternalError)
                                else HorovodInternalError(str(exc)))
             return False
@@ -477,7 +484,7 @@ class TcpBackend(Backend):
                 self.core.release(h)
                 self._handle_arrays.pop(h, None)
             if self.entry_done_cb:
-                self.entry_done_cb(p.entry)
+                self.entry_done_cb(p.entry, ok=False)
             msg = "; ".join(errs)
             # "STALLED:" is the native layer's stable marker; a mixed
             # multi-handle failure (stall + transport) classifies as
@@ -520,18 +527,18 @@ class TcpBackend(Backend):
                 pass
             self._handle_arrays.pop(h, None)
         if self.entry_done_cb:
-            self.entry_done_cb(p.entry)
+            self.entry_done_cb(p.entry, ok=False)
         p.entry.handle._fail(exc)
 
     def _fail_all(self, exc):
         for p in self._pending:
             if self.entry_done_cb:
-                self.entry_done_cb(p.entry)
+                self.entry_done_cb(p.entry, ok=False)
             p.entry.handle._fail(exc)
         self._pending = []
         for e in self._chaos_swallowed:
             if self.entry_done_cb:
-                self.entry_done_cb(e)
+                self.entry_done_cb(e, ok=False)
             e.handle._fail(exc)
         self._chaos_swallowed = []
         # Every in-flight submission is dead; drop the recorded arrays so

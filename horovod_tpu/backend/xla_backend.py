@@ -22,7 +22,6 @@ step re-dispatches a cached executable with zero negotiation.
 """
 
 import functools
-import time
 from collections import OrderedDict
 
 import jax
@@ -34,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from . import Backend
 from ..ops import reduce_ops
 from ..telemetry import core as telemetry
+from ..telemetry import span as tele_span
 from ..utils import envparse
 from ..utils.jax_compat import shard_map as _shard_map
 
@@ -54,10 +54,10 @@ def _timed(kind):
         def wrapper(self, payload, *args, **kwargs):
             if not self._metrics_on:
                 return fn(self, payload, *args, **kwargs)
-            t0 = time.perf_counter()
-            out = fn(self, payload, *args, **kwargs)
-            self._m_time.labels(backend=self.name, kind=kind).observe(
-                time.perf_counter() - t0)
+            with tele_span((), kind.upper(),
+                           histogram=self._m_time.labels(
+                               backend=self.name, kind=kind)):
+                out = fn(self, payload, *args, **kwargs)
             nbytes = telemetry.payload_nbytes(payload)
             if nbytes:
                 self._m_bytes.labels(backend=self.name,

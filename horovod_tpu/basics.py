@@ -82,6 +82,7 @@ class Runtime:
         self.timeline = None            # attached by timeline module on demand
         self.autotuner = None
         self.metrics_pusher = None      # telemetry.MetricsPusher (SPMD)
+        self.tracer = None              # tracing.Tracer (set by Coordinator)
         self._shutdown = False
 
     @property
@@ -244,6 +245,15 @@ def shutdown():
             _runtime.coordinator.stop()
         if _runtime.timeline is not None:
             _runtime.timeline.stop()
+        if _runtime.tracer is not None:
+            # Flush + close this cohort's trace shard and push it to the
+            # driver KV store (docs/tracing.md); an elastic re-init then
+            # opens a fresh shard under the new membership version.
+            _runtime.tracer.close()
+            from . import tracing
+            if tracing.active() is _runtime.tracer:
+                tracing._set_active(None)
+            _runtime.tracer = None
         if _runtime.metrics_pusher is not None:
             # Final push so shutdown-time counters (elastic restarts)
             # reach the driver before the store loses this rank.

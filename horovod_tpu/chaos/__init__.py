@@ -168,6 +168,11 @@ class Plan:
         self._log.warning("chaos: firing %r at %s (ctx=%s, fired=%d)",
                           rule.source, point, ctx, rs.fired)
         self._m_injections.labels(point=point, action=rule.action).inc()
+        # Flight-recorder breadcrumb: a postmortem that follows an
+        # injection shows the injection next to the abort it caused.
+        from .. import tracing
+        tracing.trace_event("chaos", rule.action, point=point,
+                            rule=rule.source)
         if self._log_path:
             try:
                 with open(self._log_path, "a") as f:

@@ -98,7 +98,7 @@ class TensorEntry:
     __slots__ = ("name", "kind", "op", "root_rank", "arrays", "splits",
                  "prescale", "postscale", "process_set", "handle",
                  "enqueue_time", "shapes", "uneven", "guard_token",
-                 "chaos_mismatch", "codec")
+                 "chaos_mismatch", "codec", "corr")
 
     def __init__(self, name, kind, arrays, process_set, op=None,
                  root_rank=None, splits=None, prescale=None, postscale=None,
@@ -126,6 +126,10 @@ class TensorEntry:
         # stamp() into the (name, block) tuple the fusion plane groups
         # by and the guardian digests; None = uncompressed.
         self.codec = codec
+        # Tracing correlation: this name's occurrence number, stamped by
+        # tracing.Tracer.on_submit (identical across ranks for a correct
+        # program); None when the trace plane is off.
+        self.corr = None
 
 
 def _nbytes(a):
@@ -183,6 +187,14 @@ class Coordinator:
         # with the env unset.
         from . import compression as compression_mod
         self._compression = compression_mod.make_plane(runtime)
+        # Cross-rank trace plane (tracing/; docs/tracing.md). None when
+        # HVDTPU_TRACE is off AND the flight recorder is disabled: the
+        # submit/complete paths pay one attribute check. With only the
+        # (default-on) flight recorder, each event is a bounded deque
+        # append — no file I/O, no KV traffic.
+        from . import tracing
+        self._tracer = tracing.make_tracer(runtime)
+        runtime.tracer = self._tracer
         self._stall_scan_period = (max(1.0, min(self.stall_warn_s / 2.0,
                                                 10.0))
                                    if self.stall_warn_s > 0 else 10.0)
@@ -387,6 +399,11 @@ class Coordinator:
             # digest from this rank. May touch the KV board: outside
             # the queue lock by design.
             self._guardian.on_submit(entry)
+        if self._tracer is not None:
+            # Stamp the correlation key (name x occurrence x elastic
+            # version) and record the submission instant — the cross-
+            # rank merge joins every rank's span on this key.
+            self._tracer.on_submit(entry)
         key = (entry.process_set.process_set_id, entry.name)
         guard = self._order_guard
         # Call-site capture only in ORDER_CHECK mode: the default hot
@@ -452,6 +469,15 @@ class Coordinator:
                 self._pending_names.pop(
                     (entry.process_set.process_set_id, entry.name), None)
 
+    def _entry_done(self, entry, ok=True):
+        """Native-plane completion callback (tcp/xla-global backends):
+        release the name and record the trace completion. Failure
+        paths pass ``ok=False`` so merged traces and postmortems flag
+        the failing collective instead of drawing a clean span."""
+        self._release_name(entry)
+        if self._tracer is not None:
+            self._tracer.on_complete(entry, ok=ok)
+
     # -- background cycle --------------------------------------------------
     def _loop(self):
         # The cycle thread paces the whole data plane (and runs the
@@ -485,7 +511,7 @@ class Coordinator:
         (the analog of the reference background thread calling RunLoopOnce,
         reference: horovod/common/operations.cc:706). Cycles run even with
         an empty local queue: peers may need this rank for negotiation."""
-        backend.entry_done_cb = self._release_name
+        backend.entry_done_cb = self._entry_done
         # The pure-TCP plane executes wire-codec entries host-side
         # (quantized allgather + f32 reduce) and threads error-feedback
         # residuals through this plane (None when compression is off).
@@ -501,10 +527,15 @@ class Coordinator:
                 backend.submit_entry(e)
             self.cycles += 1
             cycle_ts_us = time.perf_counter_ns() // 1000
+            # Raw begin/end pair instead of the span API on purpose:
+            # only cycles that MOVED tensors may observe (the native
+            # loop polls continuously and idle ticks would drown the
+            # histogram), and a span observes unconditionally.
+            # hvd-lint: disable=HVD207
             t0 = time.perf_counter() if self._metrics_on else 0.0
             processed = backend.run_cycle()
             if self._metrics_on and processed:
-                self._m_cycle_s.observe(time.perf_counter() - t0)
+                self._m_cycle_s.observe(time.perf_counter() - t0)  # noqa: E501  hvd-lint: disable=HVD207
                 self._m_queue_depth.set(len(batch))
             self.tensors_processed += processed
             self.bytes_processed = backend.core.bytes_processed()
@@ -636,6 +667,14 @@ class Coordinator:
         exc = CollectiveAbortError(diagnostic)
         self._log.error("%s", diagnostic)
         self._m_aborts.inc()
+        if self._tracer is not None:
+            # Forensics FIRST: the ring still holds the pre-abort
+            # events, and every rank joining the coordinated abort
+            # dumps its own — the postmortem bundle is "last N seconds,
+            # all ranks" (docs/tracing.md).
+            self._tracer.event("guardian", "abort",
+                               detail=diagnostic[:400])
+            self._tracer.dump_postmortem("collective_abort")
         if self._watchdog is not None:
             try:
                 self._watchdog.post_abort(diagnostic)
@@ -680,6 +719,11 @@ class Coordinator:
                 self._guardian.verify(e)
             except CollectiveMismatchError as exc:
                 self._log.error("%s", exc)
+                if self._tracer is not None:
+                    self._tracer.event("guardian", "mismatch",
+                                       coll=e.name,
+                                       detail=str(exc)[:400])
+                    self._tracer.dump_postmortem("collective_mismatch")
                 self._release_name(e)
                 e.handle._fail(exc)
                 continue
@@ -736,7 +780,6 @@ class Coordinator:
             batch = self._verify_consistency(batch)
             if not batch:
                 return
-        cycle_t0 = time.perf_counter() if self._metrics_on else 0.0
         self._m_queue_depth.set(len(batch))
         self.cycles += 1
         if self.runtime.autotuner is not None:
@@ -748,21 +791,27 @@ class Coordinator:
         # Group allreduces for fusion; run everything else in order.
         fusible = [e for e in batch if e.kind == "allreduce"]
         others = [e for e in batch if e.kind != "allreduce"]
-        try:
-            if fusible:
-                self._run_fused_allreduces(backend, fusible, timeline)
-            for e in others:
-                self._run_single(backend, e, timeline)
-        finally:
-            # Safety net for failure paths (idempotent: success paths
-            # already released their names before completing handles).
-            with self._lock:
-                for e in batch:
-                    if e.name:
-                        self._pending_names.pop(
-                            (e.process_set.process_set_id, e.name), None)
-        if self._metrics_on:
-            self._m_cycle_s.observe(time.perf_counter() - cycle_t0)
+        # Cycle timing through the span API (rule HVD207): batch is
+        # non-empty here, so every observation is a cycle that moved
+        # tensors; with metrics off the histogram is NULL and the span
+        # degenerates to NULL_SPAN — no clock reads.
+        with tele_span((), "CYCLE", histogram=self._m_cycle_s):
+            try:
+                if fusible:
+                    self._run_fused_allreduces(backend, fusible,
+                                               timeline)
+                for e in others:
+                    self._run_single(backend, e, timeline)
+            finally:
+                # Safety net for failure paths (idempotent: success
+                # paths already released their names before completing
+                # handles).
+                with self._lock:
+                    for e in batch:
+                        if e.name:
+                            self._pending_names.pop(
+                                (e.process_set.process_set_id, e.name),
+                                None)
 
     def _run_fused_allreduces(self, backend, entries, timeline):
         """Bucket by (process set, op, scales, dtype, codec), concat
@@ -866,6 +915,8 @@ class Coordinator:
                     self._release_name(e)
                     e.handle._complete(results[i:i + k] if k > 1
                                        else results[i])
+                    if self._tracer is not None:
+                        self._tracer.on_complete(e)
                     self.tensors_processed += k
                     self.bytes_processed += sum(_nbytes(a)
                                                 for a in e.arrays)
@@ -874,6 +925,8 @@ class Coordinator:
             self._log.error("fused allreduce failed: %s", exc)
             for e in bucket:
                 e.handle._fail(_wrap_error(exc))
+                if self._tracer is not None:
+                    self._tracer.on_complete(e, ok=False)
 
     def _run_compressed(self, backend, bucket, flat, e0):
         """One compressed fusion bucket (docs/compression.md). Cast
@@ -1002,9 +1055,13 @@ class Coordinator:
                 out = self._dispatch_single(backend, e)
                 self._release_name(e)
                 e.handle._complete(out)
+                if self._tracer is not None:
+                    self._tracer.on_complete(e)
         except Exception as exc:  # noqa: BLE001
             self._log.error("%s failed for %s: %s", e.kind, e.name, exc)
             e.handle._fail(_wrap_error(exc))
+            if self._tracer is not None:
+                self._tracer.on_complete(e, ok=False)
 
     def _dispatch_single(self, backend, e):
         if e.kind == "allgather":

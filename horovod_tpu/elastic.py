@@ -399,6 +399,12 @@ def run_fn(func, reset=_reset):
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
+                from . import tracing
+                tracing.trace_event(
+                    "elastic", "restore",
+                    cause=("collective_abort"
+                           if isinstance(e, CollectiveAbortError)
+                           else "internal"))
                 if isinstance(e, CollectiveAbortError):
                     # The stuck-collective watchdog aborted in-flight
                     # ops (guardian.py): the diagnostic names which
@@ -424,6 +430,8 @@ def run_fn(func, reset=_reset):
                 if _restart_mode():
                     _persist_and_exit(state, log, rereq=True)
             except HostsUpdatedInterrupt as e:
+                from . import tracing
+                tracing.trace_event("elastic", "hosts_updated")
                 log.info("elastic: hosts updated; re-rendezvousing")
                 skip_sync = e.skip_sync
                 if preempt_requested():

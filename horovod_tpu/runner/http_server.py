@@ -49,6 +49,12 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 1 and parts[0] in ("metrics", "metrics.json"):
             return self._serve_metrics(parts[0] == "metrics.json")
+        if len(parts) == 1 and parts[0] == "clock":
+            # Clock reference for cross-rank trace alignment
+            # (tracing/clock.py): workers sample this with an NTP-style
+            # round-trip to estimate their offset to the driver.
+            import time
+            return self._reply(200, repr(time.time()).encode())
         scope, key = self._split()
         if scope is None:
             return self._reply(400, b"")

@@ -9,14 +9,20 @@ Runtime start/stop mirrors hvd.start_timeline/stop_timeline
 """
 
 import json
+import os
 import queue
 import threading
 import time
+
+from .utils import envparse
 
 
 class Timeline:
     def __init__(self, path, jax_profiler_dir=None, mark_cycles=False):
         self.path = path
+        # Actual file of the CURRENT session (path, version-suffixed in
+        # elastic runs — see _shard_path); set by start().
+        self.shard_path = path
         # When set, the coordinator drops an instant event per negotiation
         # cycle (reference: --timeline-mark-cycles / MarkCycle events).
         self.mark_cycles = bool(mark_cycles)
@@ -51,11 +57,25 @@ class Timeline:
                              ts_us if ts_us is not None
                              else time.perf_counter_ns() // 1000))
 
+    def _shard_path(self):
+        """Elastic runs restart the timeline after every reset with the
+        SAME configured path (basics.init reads one env knob), which
+        used to truncate the pre-reset trace. Suffix the shard with the
+        membership version joined (``trace.json`` → ``trace.v3.json``)
+        so each cohort's timeline survives; non-elastic runs keep the
+        plain path."""
+        ver = envparse.get_env(envparse.ELASTIC_VERSION)
+        if ver is None:
+            return self.path
+        root, ext = os.path.splitext(self.path)
+        return f"{root}.v{ver}{ext or '.json'}"
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         if self._running:
             return
-        self._file = open(self.path, "w")
+        self.shard_path = self._shard_path()
+        self._file = open(self.shard_path, "w")
         self._file.write("[\n")
         # Fresh queue per session, and the writer gets its file
         # explicitly: a start() after a stop() whose join timed out must

@@ -1,0 +1,124 @@
+"""horovod_tpu.tracing: the cross-rank trace plane (docs/tracing.md).
+
+The per-rank Chrome timeline (timeline.py) cannot answer cluster
+questions — which rank made this collective late, where the step's
+critical path ran, what every rank was doing when the watchdog fired.
+This package closes that gap:
+
+- recorder.py — per-rank JSONL shards with correlated collective spans
+  (name × occurrence × elastic version) + the always-on flight-recorder
+  ring the guardian/chaos paths dump to a postmortem bundle;
+- clock.py — NTP-style offset sampling against the driver's ``/clock``
+  route, so cross-rank skew does not fabricate stragglers;
+- merge.py — driver-side merge into ONE Perfetto/Chrome trace: a track
+  per rank, flow arrows joining each collective's per-rank spans;
+- analyze.py — per-step critical path, per-collective straggler
+  attribution (feeding ``hvd_straggler_delay_seconds{rank}``), comm
+  breakdown reconciled against ``hvd_overlap_fraction``;
+- cli.py — the ``hvd-trace`` console entry (collect/merge/report/
+  postmortem).
+
+Cost contract: with ``HVDTPU_TRACE`` unset and
+``HVDTPU_FLIGHT_RECORDER=0``, :func:`make_tracer` returns ``None`` and
+instrumented sites pay one ``None`` check; :func:`trace_event` (the
+module-level hook for code with no coordinator reference) is one global
+read + ``None`` check. The flight recorder is ON by default — a bounded
+deque append per collective — so every abort leaves forensics even in
+jobs that never asked for tracing.
+"""
+
+import os
+
+from ..utils import envparse
+from ..utils.logging_util import get_logger
+from .recorder import (  # noqa: F401  (re-exported API)
+    DEFAULT_FLIGHT_EVENTS, FlightRecorder, ShardWriter, TRACE_SCOPE,
+    Tracer, trace_scope,
+)
+
+# The process-active tracer: backends/guardian/chaos/elastic record
+# through trace_event() without holding a coordinator reference.
+_ACTIVE = None
+
+
+def active():
+    """The process-active Tracer, or None when tracing AND the flight
+    recorder are both off."""
+    return _ACTIVE
+
+
+def trace_event(cat, name, **fields):
+    """Record a generic event on the active tracer; one global read +
+    None check when the plane is off."""
+    tr = _ACTIVE
+    if tr is not None:
+        tr.event(cat, name, **fields)
+
+
+def _set_active(tracer):
+    """Test hook / factory internal."""
+    global _ACTIVE
+    _ACTIVE = tracer
+
+
+def make_tracer(runtime):
+    """Build the rank's Tracer from the env knobs, or None when both
+    ``HVDTPU_TRACE`` and ``HVDTPU_FLIGHT_RECORDER`` are off (the
+    coordinator then pays one attribute check per submit and nothing
+    else). Registers the tracer as the process-active one."""
+    trace_on = envparse.get_bool(envparse.TRACE)
+    flight_n = (envparse.get_int(envparse.FLIGHT_RECORDER_EVENTS,
+                                 DEFAULT_FLIGHT_EVENTS)
+                if envparse.get_bool(envparse.FLIGHT_RECORDER, True)
+                else 0)
+    if not trace_on and flight_n <= 0:
+        _set_active(None)
+        return None
+
+    rank = runtime.topology.rank
+    # Unit-test runtime stubs may carry only a topology; the real
+    # Runtime.size property resolves device count in single mode.
+    size = getattr(runtime, "size", None)
+    if size is None:
+        size = getattr(runtime.topology, "size", 1)
+    version = envparse.get_int(envparse.ELASTIC_VERSION, 0)
+    flight = FlightRecorder(flight_n) if flight_n > 0 else None
+    trace_dir = envparse.get_str(envparse.TRACE_DIR, "hvd_traces")
+
+    # Clock alignment is sampled in BOTH modes when a rendezvous
+    # exists: flight-only postmortems merge cross-rank too, and an
+    # unaligned bundle reorders the forensics by exactly the skew.
+    from ..runner import rendezvous as rdv
+    push_cfg = rdv.rendezvous_config()
+    off, rtt = 0.0, None
+    if push_cfg is not None:
+        from . import clock
+        addr, port, token = push_cfg
+        off, rtt = clock.estimate_offset(addr, port, token=token)
+
+    writer = None
+    if trace_on:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(
+                trace_dir,
+                f"shard.r{rank}.p{os.getpid()}.v{version}.jsonl")
+            import socket
+            import time
+            meta = {"e": "meta", "kind": "shard", "rank": rank,
+                    "size": size, "ver": version, "pid": os.getpid(),
+                    "off": off, "rtt": rtt,
+                    "host": socket.gethostname(), "t": time.time()}
+            writer = ShardWriter(path, meta)
+        except OSError as exc:
+            get_logger().warning(
+                "tracing: cannot open trace shard under %s (%s); "
+                "shard tracing disabled, flight recorder stays on",
+                trace_dir, exc)
+            writer = None
+
+    tracer = Tracer(rank, size, version, shard_writer=writer,
+                    flight=flight, trace_dir=trace_dir,
+                    push_cfg=push_cfg, clock=(off, rtt))
+    _set_active(tracer)
+    return tracer

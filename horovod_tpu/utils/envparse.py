@@ -248,6 +248,23 @@ BUCKET_BYTES = register(
     "BUCKET_BYTES", "16 MiB",
     "Payload bytes per gradient bucket on the overlap path")
 
+# -- cross-rank tracing (docs/tracing.md) ----------------------------------
+TRACE = register(
+    "TRACE", "0",
+    "Cross-rank trace plane: write a per-rank JSONL trace shard with "
+    "correlated collective spans (name x occurrence x elastic version) "
+    "and push it to the driver KV store for hvd-trace merge/report")
+TRACE_DIR = register(
+    "TRACE_DIR", "hvd_traces",
+    "Directory for trace shards and flight-recorder postmortem dumps")
+FLIGHT_RECORDER = register(
+    "FLIGHT_RECORDER", "1",
+    "Always-on bounded ring of recent span/negotiation events; dumped "
+    "to a postmortem bundle on collective abort/mismatch (0 disables)")
+FLIGHT_RECORDER_EVENTS = register(
+    "FLIGHT_RECORDER_EVENTS", "4096",
+    "Flight-recorder ring capacity, events per rank")
+
 # -- kernels ----------------------------------------------------------------
 BRIDGE_FLASH = register(
     "BRIDGE_FLASH", "auto",

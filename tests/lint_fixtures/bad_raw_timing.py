@@ -1,0 +1,47 @@
+"""HVD207 fixture: raw clock begin/end pairs feeding metric observes.
+
+Three findings (direct perf_counter pair, time.time pair, one-hop
+elapsed variable); the monotonic pair and the log-only pair are not
+findings.
+"""
+
+import time
+from time import perf_counter
+
+HIST = None
+
+
+def direct_pair(hist):
+    t0 = time.perf_counter()
+    work()
+    hist.observe(time.perf_counter() - t0)  # HVD207
+
+
+def wall_clock_pair(hist):
+    start = time.time()
+    work()
+    hist.observe(time.time() - start)  # HVD207
+
+
+def one_hop_elapsed(hist):
+    t0 = perf_counter()
+    work()
+    elapsed = perf_counter() - t0
+    work()
+    hist.observe(elapsed)  # HVD207
+
+
+def fine_monotonic(hist):
+    t0 = time.monotonic()
+    work()
+    hist.observe(time.monotonic() - t0)  # ok: not a span clock
+
+
+def fine_log_only(log):
+    t0 = time.perf_counter()
+    work()
+    log.info("took %.3fs", time.perf_counter() - t0)  # ok: no metric
+
+
+def work():
+    pass

@@ -19,6 +19,7 @@ Drivers are constructed directly (not via launch_elastic_job) so the
 assertions can read fail_counts / resets / blacklist afterwards.
 """
 
+import json
 import os
 import sys
 import time
@@ -360,6 +361,91 @@ def test_compression_recovery_matches_uncompressed(tmp_path):
     # tolerance: recovery under compression restores the same commit
     # and converges to the same numbers.
     np.testing.assert_allclose(q_totals, plain_totals, atol=1e-3)
+
+
+def test_stall_abort_leaves_postmortem_bundle_and_merged_trace(tmp_path):
+    """Tracing row (ISSUE 8): the stall-abort scenario re-run with the
+    cross-rank trace plane on (HVDTPU_TRACE=1 + the default flight
+    recorder). Acceptance: (a) the guardian's coordinated abort makes
+    EVERY live rank of the aborted cohort dump its flight ring — the
+    postmortem bundle holds loadable shards from both workers, with the
+    stalled submission visible on rank 0 and absent on rank 1 (chaos
+    swallowed it before the tracer saw it); (b) `hvd-trace merge` over
+    the whole real 2-worker elastic run produces one Perfetto-loadable
+    trace with a track per rank and cross-rank flow arrows, and the
+    analyzer report names per-step critical paths and per-collective
+    straggler ranks."""
+    from horovod_tpu.tracing import analyze as trace_analyze
+    from horovod_tpu.tracing import cli as trace_cli
+    from horovod_tpu.tracing import merge as trace_merge
+    marker = tmp_path / "stall.marker"
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    rc, driver, log_path, chaos_log = _run_chaos_job(
+        tmp_path,
+        f"collective:stall:rank=1:name=step3:marker={marker}",
+        capture_output=True,
+        HVDTPU_COLLECTIVE_TIMEOUT="4",
+        HOROVOD_TPU_STALL_CHECK_TIME="1",
+        HVDTPU_TRACE="1",
+        HVDTPU_TRACE_DIR=str(trace_dir),
+        ELASTIC_TEST_EPOCHS=6, ELASTIC_TEST_EPOCH_SLEEP=0.2)
+    content = _log_content(log_path)
+    assert rc == 0, content
+    assert marker.exists()  # the stall fired
+    done = [line for line in content.splitlines() if "DONE" in line]
+    assert len(done) == 2, content
+
+    # (a) Postmortem bundle: flight dumps from BOTH live ranks of the
+    # aborted cohort, each loadable, each carrying real events.
+    pm = trace_merge.load_paths(
+        [str(trace_dir)], kinds=(trace_merge.POSTMORTEM_PREFIX,))
+    pm_ranks = {s["meta"]["rank"] for s in pm}
+    assert pm_ranks == {0, 1}, sorted(trace_dir.iterdir())
+    for s in pm:
+        assert s["meta"]["kind"] == "postmortem"
+        assert s["meta"]["reason"] == "collective_abort"
+        assert s["events"], s["path"]
+    by_rank = {s["meta"]["rank"]: s for s in pm}
+    # Rank 0 submitted the stalled step3 and never saw it finish; the
+    # chaos swallow means rank 1's ring has NO step3 submission — the
+    # bundle shows exactly which rank never arrived.
+    r0 = trace_merge.collective_spans(by_rank[0])
+    assert ("step3", 1) in r0 and r0[("step3", 1)]["fin"] is None, r0
+    assert ("step3", 1) not in trace_merge.collective_spans(by_rank[1])
+    # The abort breadcrumb itself is in the ring.
+    assert any(r.get("cat") == "guardian"
+               for s in pm for r in s["events"])
+    # The postmortem CLI bundles it into a loadable trace.
+    pm_out = tmp_path / "postmortem.json"
+    assert trace_cli.main(["postmortem", str(trace_dir),
+                           "--out", str(pm_out)]) == 0
+    assert json.loads(pm_out.read_text())["traceEvents"]
+
+    # (b) Full-run merge + analysis: shards from both workers (pre- and
+    # post-reset cohorts push under distinct versions/pids).
+    shards = trace_merge.load_paths(
+        [str(trace_dir)], kinds=(trace_merge.SHARD_PREFIX,))
+    shard_ranks = {s["meta"]["rank"] for s in shards}
+    assert shard_ranks == {0, 1}, sorted(trace_dir.iterdir())
+    # Workers sampled a real clock offset against the driver store.
+    assert any(s["meta"].get("rtt") is not None for s in shards)
+    merged_out = tmp_path / "merged.json"
+    assert trace_cli.main(["merge", str(trace_dir),
+                           "--out", str(merged_out)]) == 0
+    trace = json.loads(merged_out.read_text())
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "X"}
+    assert pids == {0, 1}, pids
+    # Cross-rank flow arrows exist: the same named collective appears
+    # on both ranks' tracks, joined by its correlation key.
+    assert any(e.get("ph") == "s" for e in trace["traceEvents"])
+    report = trace_analyze.analyze(shards)
+    assert report["steps"], report
+    assert all("critical_path" in st for st in report["steps"])
+    text = trace_analyze.render_report(report)
+    assert "per-step critical path" in text
+    assert "straggler attribution" in text
 
 
 def test_collective_failure_injection_recovers(tmp_path):

@@ -1,0 +1,684 @@
+"""Cross-rank tracing tests (ISSUE 8; docs/tracing.md).
+
+Fast tier-1 units: disabled-mode guard (the plane must cost one None
+check when off), correlation keys, shard JSONL round-trips, the flight
+recorder ring + postmortem dumps, clock-offset estimation against the
+real KV server, the skewed-clock 3-rank merge (clock alignment must
+keep fabricated stragglers out and name the TRUE one), the critical-
+path analyzer, KV push/collect, the hvd-trace CLI, the timeline
+elastic-version shard regression, and lint rule HVD207. The 2-worker
+elastic acceptance rows live in test_chaos_matrix.py (slow lane).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+import urllib.error
+
+import pytest
+
+from conftest import clean_spawn_env
+from horovod_tpu import tracing
+from horovod_tpu.runner.http_server import KVStoreServer
+from horovod_tpu.tracing import analyze, clock, merge, recorder
+from horovod_tpu.utils import envparse
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _runtime_stub(rank=0, size=2):
+    topo = types.SimpleNamespace(rank=rank)
+    return types.SimpleNamespace(topology=topo, size=size)
+
+
+@pytest.fixture
+def fresh_plane(monkeypatch):
+    """Isolate the process-active tracer and the trace knobs."""
+    for knob in ("HVDTPU_TRACE", "HVDTPU_TRACE_DIR",
+                 "HVDTPU_FLIGHT_RECORDER",
+                 "HVDTPU_FLIGHT_RECORDER_EVENTS",
+                 "HVDTPU_ELASTIC_VERSION"):
+        monkeypatch.delenv(knob, raising=False)
+    prev = tracing.active()
+    yield monkeypatch
+    tracing._set_active(prev)
+
+
+class _Entry:
+    def __init__(self, name, kind="allreduce"):
+        self.name = name
+        self.kind = kind
+        self.corr = None
+
+
+# -- knobs / disabled guard -------------------------------------------------
+
+def test_trace_knobs_registered():
+    for knob in ("TRACE", "TRACE_DIR", "FLIGHT_RECORDER",
+                 "FLIGHT_RECORDER_EVENTS"):
+        assert knob in envparse.KNOBS, knob
+
+
+def test_disabled_guard_returns_none(fresh_plane):
+    """Both knobs off => no tracer object at all, and the module hook
+    is a no-op — the coordinator then pays one None check per submit
+    (the telemetry/chaos disabled contract)."""
+    fresh_plane.setenv("HVDTPU_FLIGHT_RECORDER", "0")
+    assert tracing.make_tracer(_runtime_stub()) is None
+    assert tracing.active() is None
+    tracing.trace_event("guardian", "noop")  # must not raise
+
+
+def test_flight_only_mode_no_files(fresh_plane, tmp_path):
+    """Default mode (flight recorder on, tracing off): bounded ring,
+    zero file I/O."""
+    fresh_plane.setenv("HVDTPU_TRACE_DIR", str(tmp_path))
+    fresh_plane.setenv("HVDTPU_FLIGHT_RECORDER_EVENTS", "16")
+    tr = tracing.make_tracer(_runtime_stub())
+    assert tr is not None and tr._writer is None
+    for i in range(50):
+        tr.on_submit(_Entry(f"g.{i % 4}"))
+    assert len(tr._flight) == 16  # ring bounded by the knob
+    tr.close()
+    assert os.listdir(tmp_path) == []  # no shard was opened
+
+
+def test_correlation_key_occurrence_and_version(fresh_plane, tmp_path):
+    fresh_plane.setenv("HVDTPU_ELASTIC_VERSION", "7")
+    fresh_plane.setenv("HVDTPU_TRACE", "1")
+    fresh_plane.setenv("HVDTPU_TRACE_DIR", str(tmp_path))
+    tr = tracing.make_tracer(_runtime_stub(rank=1, size=2))
+    assert tr.version == 7
+    a1, a2, b1 = _Entry("grad.a"), _Entry("grad.a"), _Entry("grad.b")
+    for e in (a1, a2, b1):
+        tr.on_submit(e)
+    # Occurrence counts advance per NAME — the cross-rank join key.
+    assert (a1.corr, a2.corr, b1.corr) == (1, 2, 1)
+    tr.close()
+    shard = merge.load_shard(os.path.join(
+        tmp_path, os.listdir(tmp_path)[0]))
+    assert shard["meta"]["ver"] == 7
+    assert shard["meta"]["rank"] == 1
+
+
+def test_shard_jsonl_roundtrip(fresh_plane, tmp_path):
+    fresh_plane.setenv("HVDTPU_TRACE", "1")
+    fresh_plane.setenv("HVDTPU_TRACE_DIR", str(tmp_path))
+    tr = tracing.make_tracer(_runtime_stub())
+    e = _Entry("grad.a")
+    tr.on_submit(e)
+    tr.on_complete(e)
+    bad = _Entry("grad.b")
+    tr.on_submit(bad)
+    tr.on_complete(bad, ok=False)
+    tr.event("neg", "grad.a", o=1)
+    tr.close()
+    files = [f for f in os.listdir(tmp_path) if f.startswith("shard.")]
+    assert len(files) == 1
+    shard = merge.load_shard(os.path.join(tmp_path, files[0]))
+    kinds = [r["e"] for r in shard["events"]]
+    assert kinds == ["sub", "fin", "sub", "fin", "ev"]
+    assert shard["events"][3]["err"] == 1
+    spans = merge.collective_spans(shard)
+    assert spans[("grad.a", 1)]["fin"] >= spans[("grad.a", 1)]["sub"]
+    assert spans[("grad.b", 1)]["err"] is True
+
+
+# -- flight recorder / postmortem ------------------------------------------
+
+def test_postmortem_dump_and_load(fresh_plane, tmp_path):
+    fresh_plane.setenv("HVDTPU_TRACE_DIR", str(tmp_path))
+    tr = tracing.make_tracer(_runtime_stub(rank=1))
+    for i in range(5):
+        e = _Entry(f"grad.{i}")
+        tr.on_submit(e)
+        tr.on_complete(e)
+    tracing.trace_event("chaos", "fail", point="collective")
+    path = tr.dump_postmortem("collective_abort")
+    assert path is not None and os.path.exists(path)
+    shard = merge.load_shard(path)
+    assert shard["meta"]["kind"] == "postmortem"
+    assert shard["meta"]["reason"] == "collective_abort"
+    assert shard["meta"]["rank"] == 1
+    # The chaos breadcrumb rode the module-level hook into the ring.
+    cats = {r.get("cat") for r in shard["events"] if r["e"] == "ev"}
+    assert "chaos" in cats
+    assert sum(r["e"] == "sub" for r in shard["events"]) == 5
+
+
+def test_trace_event_hook_reaches_active_tracer(fresh_plane):
+    tr = tracing.make_tracer(_runtime_stub())
+    tracing.trace_event("guardian", "stall_observe", coll="x")
+    assert any(r.get("cat") == "guardian" for r in tr._flight.snapshot())
+
+
+# -- clock alignment --------------------------------------------------------
+
+def test_clock_route_and_offset_estimation():
+    server = KVStoreServer(job_token="tok")
+    port = server.start()
+    try:
+        ts = clock.server_time("127.0.0.1", port, token="tok")
+        assert abs(ts - time.time()) < 2.0
+        off, rtt = clock.estimate_offset("127.0.0.1", port, token="tok")
+        assert rtt is not None and rtt >= 0
+        assert abs(off) < 1.0  # same host, same clock
+        # The route is token-gated like every other route.
+        with pytest.raises(urllib.error.HTTPError):
+            clock.server_time("127.0.0.1", port, token="wrong")
+    finally:
+        server.stop()
+
+
+def test_clock_offset_recovers_injected_skew(monkeypatch):
+    """A server clock 250 ms behind must show up as a +0.25 s local
+    offset (local minus server), within the round trip."""
+    monkeypatch.setattr(clock, "server_time",
+                        lambda *a, **k: time.time() - 0.25)
+    off, rtt = clock.estimate_offset("ignored", 0)
+    assert rtt is not None
+    assert abs(off - 0.25) < 0.05
+
+
+def test_clock_unreachable_degrades_to_zero():
+    off, rtt = clock.estimate_offset("127.0.0.1", 1, samples=2)
+    assert (off, rtt) == (0.0, None)
+
+
+# -- merge + analyze under skewed clocks ------------------------------------
+
+def _write_synthetic_shard(dirpath, rank, clock_off, submits,
+                           version=0, size=3, rtt=0.004,
+                           kind="shard"):
+    """Write a shard whose STAMPS carry ``clock_off`` of skew (the
+    rank's clock runs fast by that much) and whose meta declares it —
+    exactly what a real worker records. ``submits``: [(name, occ,
+    true_sub_t, true_fin_t)]."""
+    path = os.path.join(dirpath, f"{kind}.r{rank}.p1.v{version}.jsonl")
+    meta = {"e": "meta", "kind": kind, "rank": rank, "size": size,
+            "ver": version, "pid": 1, "off": clock_off, "rtt": rtt,
+            "t": 0.0}
+    with open(path, "w") as f:
+        f.write(json.dumps(meta) + "\n")
+        for name, occ, sub_t, fin_t in submits:
+            f.write(json.dumps({"e": "sub", "t": sub_t + clock_off,
+                                "n": name, "k": "allreduce",
+                                "o": occ}) + "\n")
+            f.write(json.dumps({"e": "fin", "t": fin_t + clock_off,
+                                "n": name, "o": occ}) + "\n")
+    return path
+
+
+def _skewed_three_rank_dir(tmp_path):
+    """3 ranks, 3 steps x 2 collectives. TRUE timeline: ranks 0/1
+    submit together, rank 2 is 30 ms late every time (the genuine
+    straggler). CLOCKS: rank 1 runs +50 ms fast, rank 2 runs -50 ms
+    slow — without alignment rank 1 would look like the straggler and
+    rank 2 would look early."""
+    d = tmp_path / "shards"
+    d.mkdir()
+    base = 1000.0
+    names = ("grad.a", "grad.b")
+    true_sub = {}
+    for rank, skew, late in ((0, 0.0, 0.0), (1, 0.05, 0.0),
+                             (2, -0.05, 0.03)):
+        submits = []
+        for step in (1, 2, 3):
+            for j, name in enumerate(names):
+                t = base + step * 0.5 + j * 0.1 + late
+                fin = base + step * 0.5 + j * 0.1 + 0.03 + 0.02
+                submits.append((name, step, t, fin))
+                true_sub[(name, step, rank)] = t
+        _write_synthetic_shard(str(d), rank, skew, submits)
+    return d, true_sub
+
+
+def test_skewed_merge_names_true_straggler(tmp_path):
+    """THE clock-alignment acceptance: +/-50 ms of injected clock skew
+    (bigger than the 30 ms true lateness) must not fabricate or mask a
+    straggler once aligned."""
+    d, _ = _skewed_three_rank_dir(tmp_path)
+    shards = merge.load_paths([str(d)])
+    report = analyze.analyze(shards)
+    assert report["ranks"] == [0, 1, 2]
+    assert report["collectives"] == 6
+    # Every collective's straggler is the TRULY late rank 2...
+    for c in report["collective_table"]:
+        assert c["straggler_rank"] == 2, c
+        assert abs(c["submit_skew_s"] - 0.03) < 0.005
+    assert report["stragglers"][2]["gated"] == 6
+    assert report["stragglers"][1]["gated"] == 0
+    # ...and WITHOUT alignment the fast-clocked rank 1 would have been
+    # blamed — the skew is the fabrication alignment exists to kill.
+    raw = analyze.analyze(shards, align=False)
+    assert all(c["straggler_rank"] == 1
+               for c in raw["collective_table"])
+
+
+def test_skewed_merge_ordering_and_flows(tmp_path):
+    d, true_sub = _skewed_three_rank_dir(tmp_path)
+    shards = merge.load_paths([str(d)])
+    trace = merge.merge_shards(shards)
+    events = trace["traceEvents"]
+    pids = {e["pid"] for e in events if e["ph"] == "X"}
+    assert pids == {0, 1, 2}
+    # Aligned ordering: for each collective, rank 2's span starts LAST
+    # (true order), despite its clock stamping it earliest.
+    by_corr = {}
+    for e in events:
+        if e["ph"] == "X":
+            by_corr.setdefault(e["args"]["corr"], {})[
+                e["args"]["rank"]] = e["ts"]
+    assert len(by_corr) == 6
+    for corr, by_rank in by_corr.items():
+        assert max(by_rank, key=by_rank.get) == 2, (corr, by_rank)
+    # Flow arrows: one start per collective + one finish per other rank.
+    assert sum(e["ph"] == "s" for e in events) == 6
+    assert sum(e["ph"] == "f" for e in events) == 12
+    # Loadable JSON (Perfetto contract: traceEvents array of dicts).
+    blob = json.dumps(trace)
+    assert json.loads(blob)["traceEvents"]
+
+
+def test_critical_path_decomposition(tmp_path):
+    """One step, two sequential collectives with a gap between them:
+    critical path = both spans, the gap counts as compute."""
+    d = tmp_path / "one"
+    d.mkdir()
+    _write_synthetic_shard(
+        str(d), 0, 0.0,
+        [("a", 1, 100.0, 100.1),      # 100 ms collective
+         ("b", 1, 100.3, 100.45)],    # 200 ms gap, 150 ms collective
+        size=1)
+    report = analyze.analyze(merge.load_paths([str(d)]))
+    st = report["steps"][0]
+    assert st["step"] == 1
+    assert abs(st["duration_s"] - 0.45) < 1e-6
+    assert abs(st["critical_comm_s"] - 0.25) < 1e-6
+    assert abs(st["critical_gap_s"] - 0.2) < 1e-6
+    assert st["gating_collective"] == "b"
+    names = [c["name"] for c in st["critical_path"]]
+    assert names == ["b", "a"]  # walked backward from the last finish
+
+
+def test_straggler_gauge_published(tmp_path, monkeypatch):
+    from horovod_tpu.telemetry import core as telemetry
+    monkeypatch.setenv("HOROVOD_TPU_METRICS", "1")
+    telemetry.reset()
+    try:
+        d, _ = _skewed_three_rank_dir(tmp_path)
+        report = analyze.analyze(merge.load_paths([str(d)]))
+        analyze.publish_metrics(report)
+        snap = telemetry.snapshot()
+        fam = snap["families"]["hvd_straggler_delay_seconds"]
+        by_rank = {s["labels"]["rank"]: s["value"]
+                   for s in fam["samples"]}
+        assert by_rank["2"] > 0.1  # 6 x 30 ms
+        assert by_rank["0"] == 0.0
+    finally:
+        monkeypatch.delenv("HOROVOD_TPU_METRICS")
+        telemetry.reset()
+
+
+def test_elastic_versions_never_join(tmp_path):
+    """Review regression: spans from different elastic cohorts share
+    names and occurrence numbers (counters restart per cohort) but
+    must NEVER join — a v0/v1 join would overwrite same-rank spans and
+    'discover' a straggler delayed by the whole inter-cohort gap."""
+    d = tmp_path / "elastic"
+    d.mkdir()
+    for ver, t0 in ((0, 1000.0), (1, 1100.0)):  # 100 s apart
+        for rank in (0, 1):
+            _write_synthetic_shard(
+                str(d), rank, 0.0,
+                [("grad.a", 1, t0, t0 + 0.02)], version=ver, size=2)
+    report = analyze.analyze(merge.load_paths([str(d)]))
+    # Two collectives (one per cohort), not one mega-join.
+    assert report["collectives"] == 2
+    assert {c["version"] for c in report["collective_table"]} == {0, 1}
+    # No fabricated 100 s straggler: both cohorts submitted in sync.
+    for rec in report["stragglers"].values():
+        assert rec["delay_s"] < 0.001, report["stragglers"]
+    # Steps are version-scoped; each rank's comm aggregates BOTH of
+    # its cohort shards instead of last-shard-wins.
+    assert [(st["version"], st["step"])
+            for st in report["steps"]] == [(0, 1), (1, 1)]
+    assert abs(report["comm"][0]["collective_s"] - 0.04) < 1e-6
+    text = analyze.render_report(report)
+    assert "v0:1" in text and "v1:1" in text
+
+
+def test_postmortem_meta_carries_clock_offset(tmp_path):
+    """Review regression: postmortem bundles merge cross-rank too, so
+    the dump's meta must carry the sampled clock offset — off=0 would
+    reorder multi-host abort forensics by exactly the skew."""
+    tr = recorder.Tracer(0, 2, 0, trace_dir=str(tmp_path),
+                         flight=recorder.FlightRecorder(16),
+                         clock=(0.05, 0.002))
+    tr.event("chaos", "fail")
+    path = tr.dump_postmortem("abort")
+    meta = merge.load_shard(path)["meta"]
+    assert meta["off"] == 0.05 and meta["rtt"] == 0.002
+
+
+def test_native_failure_marks_span_error(fresh_plane):
+    """Review regression: the native-plane completion callback flags
+    failed entries so merged traces do not draw clean spans for them."""
+    from horovod_tpu.coordinator import Coordinator
+    tr = tracing.make_tracer(_runtime_stub())
+    coord = Coordinator.__new__(Coordinator)  # only _entry_done's deps
+    coord._tracer = tr
+    coord._release_name = lambda e: None
+    e = _Entry("grad.x")
+    tr.on_submit(e)
+    coord._entry_done(e, ok=False)
+    fins = [r for r in tr._flight.snapshot() if r["e"] == "fin"]
+    assert fins and fins[-1].get("err") == 1
+
+
+def test_clock_sampling_bails_after_first_failure(monkeypatch):
+    """Review regression: an unreachable /clock must cost ONE timeout,
+    not samples x timeout, on init's critical path."""
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise OSError("unreachable")
+
+    monkeypatch.setattr(clock, "server_time", boom)
+    assert clock.estimate_offset("x", 1, samples=5) == (0.0, None)
+    assert len(calls) == 1
+
+
+# -- KV push / collect ------------------------------------------------------
+
+def test_shard_push_and_collect_roundtrip(fresh_plane, tmp_path):
+    server = KVStoreServer(job_token="tok")
+    port = server.start()
+    try:
+        d = tmp_path / "worker"
+        d.mkdir()
+        fresh_plane.setenv("HVDTPU_TRACE", "1")
+        fresh_plane.setenv("HVDTPU_TRACE_DIR", str(d))
+        fresh_plane.setenv("HVDTPU_RENDEZVOUS_ADDR", "127.0.0.1")
+        fresh_plane.setenv("HVDTPU_RENDEZVOUS_PORT", str(port))
+        fresh_plane.setenv("HVDTPU_JOB_TOKEN", "tok")
+        tr = tracing.make_tracer(_runtime_stub(rank=0, size=1))
+        e = _Entry("grad.a")
+        tr.on_submit(e)
+        tr.on_complete(e)
+        tr.dump_postmortem("test_reason")
+        tr.close()  # pushes the shard
+        out = tmp_path / "collected"
+        written = merge.collect_shards("127.0.0.1", port, "tok", 0,
+                                       str(out))
+        kinds = sorted(os.path.basename(p).split(".")[0]
+                       for p in written)
+        assert kinds == ["postmortem", "shard"]
+        shard = merge.load_shard([p for p in written
+                                  if "shard" in p][0])
+        assert [r["e"] for r in shard["events"]] == ["sub", "fin"]
+        # Clock offset was sampled against the live server.
+        assert shard["meta"]["rtt"] is not None
+    finally:
+        server.stop()
+
+
+def test_collect_survives_missing_rank_push(tmp_path):
+    """Review regression: shard pushes are best-effort, so a rank whose
+    push failed must not hide every higher rank's shard from collect."""
+    server = KVStoreServer(job_token="")
+    port = server.start()
+    try:
+        for rank in (0, 2):  # rank 1's push "failed"
+            meta = {"e": "meta", "kind": "shard", "rank": rank,
+                    "size": 3, "ver": 0, "off": 0.0, "rtt": None}
+            server.put("trace.0", f"shard.{rank}",
+                       json.dumps(meta) + "\n")
+        out = tmp_path / "collected"
+        written = merge.collect_shards("127.0.0.1", port, "", 0,
+                                       str(out), max_ranks=8)
+        got = sorted(os.path.basename(p) for p in written)
+        assert got == ["shard.r0.v0.jsonl", "shard.r2.v0.jsonl"], got
+    finally:
+        server.stop()
+
+
+def test_push_truncation_keeps_meta_and_tail(fresh_plane, tmp_path,
+                                             monkeypatch):
+    server = KVStoreServer(job_token="")
+    port = server.start()
+    try:
+        monkeypatch.setattr(recorder, "PUSH_CAP_BYTES", 512)
+        tr = recorder.Tracer(0, 1, 0, trace_dir=str(tmp_path),
+                             push_cfg=("127.0.0.1", port, ""))
+        path = tmp_path / "shard.r0.p1.v0.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps({"e": "meta", "rank": 0}) + "\n")
+            for i in range(100):
+                f.write(json.dumps({"e": "ev", "t": i, "cat": "x",
+                                    "n": f"pad{i:04d}"}) + "\n")
+        tr._push_file(str(path), "shard.0")
+        raw = server.get("trace.0", "shard.0")
+        assert raw is not None and len(raw) <= 512 + 64
+        lines = raw.decode().splitlines()
+        assert json.loads(lines[0])["e"] == "meta"  # header survives
+        assert json.loads(lines[-1])["n"] == "pad0099"  # newest tail
+    finally:
+        server.stop()
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_merge_report_postmortem(tmp_path, capsys):
+    from horovod_tpu.tracing import cli
+    d, _ = _skewed_three_rank_dir(tmp_path)
+    # Postmortem dump rides next to the shards like a real abort.
+    _write_synthetic_shard(str(d), 0, 0.0, [("a", 1, 1.0, 1.1)],
+                           kind="postmortem")
+    out = tmp_path / "merged.json"
+    assert cli.main(["merge", str(d), "--out", str(out)]) == 0
+    trace = json.loads(out.read_text())
+    assert trace["traceEvents"]
+    capsys.readouterr()
+    assert cli.main(["report", str(d)]) == 0
+    text = capsys.readouterr().out
+    assert "per-step critical path" in text
+    assert "straggler attribution" in text
+    assert "comm breakdown" in text
+    pm_out = tmp_path / "pm.json"
+    assert cli.main(["postmortem", str(d), "--out", str(pm_out)]) == 0
+    text = capsys.readouterr().out
+    assert "postmortem bundle: 1 rank dump(s)" in text
+    assert json.loads(pm_out.read_text())["traceEvents"]
+
+
+def test_cli_report_json_mode(tmp_path, capsys):
+    from horovod_tpu.tracing import cli
+    d, _ = _skewed_three_rank_dir(tmp_path)
+    assert cli.main(["report", str(d), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["collectives"] == 6
+
+
+def test_cli_missing_shards_fails(tmp_path, capsys):
+    from horovod_tpu.tracing import cli
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli.main(["report", str(empty)]) == 1
+
+
+def test_cli_console_entry_registered():
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        text = f.read()
+    assert 'hvd-trace = "horovod_tpu.tracing.cli:main"' in text
+
+
+# -- coordinator integration (subprocess: own runtime + knobs) -------------
+
+E2E_SCRIPT = r"""
+import os, sys, json
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+n = hvd.size()
+for step in range(3):
+    hvd.allreduce(jnp.ones((n, 8)), op=hvd.Sum, name="grad.a")
+    hvd.allreduce(jnp.ones((n, 4)), op=hvd.Sum, name="grad.b")
+from horovod_tpu import basics
+tr = basics.runtime().tracer
+assert tr is not None
+assert len(tr._flight) == 12, len(tr._flight)
+hvd.shutdown()
+print("E2E-OK")
+"""
+
+
+def test_coordinator_records_correlated_spans(tmp_path):
+    """Real single-controller runtime with HVDTPU_TRACE=1: every eager
+    allreduce leaves a correlated sub/fin pair; occurrences advance per
+    step; shutdown closes the shard."""
+    env = clean_spawn_env(
+        PYTHONPATH=REPO,
+        HVDTPU_TRACE="1",
+        HVDTPU_TRACE_DIR=str(tmp_path),
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    proc = subprocess.run([sys.executable, "-c", E2E_SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    files = [f for f in os.listdir(tmp_path) if f.startswith("shard.")]
+    assert len(files) == 1
+    shard = merge.load_shard(os.path.join(tmp_path, str(files[0])))
+    spans = merge.collective_spans(shard)
+    assert set(spans) == {(f"grad.{x}", occ)
+                          for x in "ab" for occ in (1, 2, 3)}
+    assert all(sp["fin"] is not None for sp in spans.values())
+    report = analyze.analyze([shard])
+    assert [st["step"] for st in report["steps"]] == [1, 2, 3]
+
+
+def test_coordinator_disabled_no_files(tmp_path):
+    """HVDTPU_TRACE off (flight recorder explicitly off too): no trace
+    dir writes, runtime.tracer is None — the disabled guard."""
+    script = E2E_SCRIPT.replace(
+        "assert tr is not None",
+        "assert tr is None").replace(
+        "assert len(tr._flight) == 12, len(tr._flight)", "")
+    env = clean_spawn_env(
+        PYTHONPATH=REPO,
+        HVDTPU_FLIGHT_RECORDER="0",
+        HVDTPU_TRACE_DIR=str(tmp_path),
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert os.listdir(tmp_path) == []
+
+
+# -- timeline elastic-version shards (satellite regression) ----------------
+
+def test_timeline_elastic_reset_does_not_truncate(tmp_path,
+                                                  monkeypatch):
+    """Regression: Timeline.start() after an elastic reset used to
+    reopen the SAME path in 'w' mode, truncating the pre-reset trace.
+    Shards are now suffixed with the membership version."""
+    from horovod_tpu.timeline import Timeline
+    path = str(tmp_path / "trace.json")
+
+    monkeypatch.setenv("HVDTPU_ELASTIC_VERSION", "0")
+    t0 = Timeline(path)
+    t0.start()
+    t0.marker("cohort0-event")
+    t0.stop()
+    assert t0.shard_path == str(tmp_path / "trace.v0.json")
+
+    # The elastic reset: a NEW Timeline on the SAME configured path
+    # (basics.init reads one env knob), at the bumped version.
+    monkeypatch.setenv("HVDTPU_ELASTIC_VERSION", "1")
+    t1 = Timeline(path)
+    t1.start()
+    t1.marker("cohort1-event")
+    t1.stop()
+    assert t1.shard_path == str(tmp_path / "trace.v1.json")
+
+    v0 = json.loads((tmp_path / "trace.v0.json").read_text())
+    v1 = json.loads((tmp_path / "trace.v1.json").read_text())
+    assert any(e.get("name") == "cohort0-event" for e in v0)
+    assert any(e.get("name") == "cohort1-event" for e in v1)
+
+
+def test_timeline_plain_path_without_elastic(tmp_path, monkeypatch):
+    from horovod_tpu.timeline import Timeline
+    monkeypatch.delenv("HVDTPU_ELASTIC_VERSION", raising=False)
+    path = str(tmp_path / "trace.json")
+    t = Timeline(path)
+    t.start()
+    t.stop()
+    assert t.shard_path == path
+    assert (tmp_path / "trace.json").exists()
+
+
+# -- HVD207: raw timing pairs (satellite lint rule) -------------------------
+
+def test_hvd207_fixture_corpus():
+    from horovod_tpu.analysis import ast_lint
+    diags = ast_lint.lint_file(
+        os.path.join(HERE, "lint_fixtures", "bad_raw_timing.py"))
+    found = [d for d in diags if d.rule == "HVD207"]
+    assert len(found) == 3, [(d.rule, d.line) for d in diags]
+    assert all(d.severity == "warning" for d in found)
+
+
+def test_hvd207_negatives():
+    from horovod_tpu.analysis import ast_lint
+    src = """
+import time
+
+class Span:
+    def __enter__(self):
+        self._t0 = time.perf_counter()      # attribute begin: exempt
+
+    def __exit__(self, *a):
+        self._h.observe(time.perf_counter() - self._t0)
+
+def bookkeeping(hist):
+    t0 = time.monotonic()
+    hist.observe(time.monotonic() - t0)      # monotonic: exempt
+
+def logged(log):
+    t0 = time.time()
+    log.info("%s", time.time() - t0)         # no metric: exempt
+"""
+    assert not [d for d in ast_lint.lint_source(src)
+                if d.rule == "HVD207"]
+
+
+def test_hvd207_suppression_and_conditional_begin():
+    from horovod_tpu.analysis import ast_lint
+    src = """
+import time
+
+def conditional(hist, on):
+    t0 = time.perf_counter() if on else 0.0
+    hist.observe(time.perf_counter() - t0)
+"""
+    assert [d for d in ast_lint.lint_source(src)
+            if d.rule == "HVD207"]  # the IfExp spelling is caught
+    suppressed = src.replace(
+        "hist.observe(time.perf_counter() - t0)",
+        "hist.observe(time.perf_counter() - t0)  "
+        "# hvd-lint: disable=HVD207")
+    assert not [d for d in ast_lint.lint_source(suppressed)
+                if d.rule == "HVD207"]
+
+
+def test_hvd207_in_catalog_and_cli():
+    from horovod_tpu.analysis.diagnostics import RULES, WARNING
+    assert RULES["HVD207"][0] == WARNING
