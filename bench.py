@@ -90,7 +90,8 @@ def _bench_resnet(hvd, hvd_jax, on_tpu):
 
 
 def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
-                       metric=None, compression=None, overlap=None):
+                       metric=None, compression=None, overlap=None,
+                       zero=None):
     import os
 
     import jax
@@ -133,9 +134,13 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
     # at optimizer construction, so flip the knob before building it.
     if overlap is not None:
         os.environ["HVDTPU_OVERLAP"] = "1" if overlap else "0"
+    # --zero sweep: the ZeRO-1 sharded weight update (HVDTPU_ZERO,
+    # docs/performance.md "ZeRO-1") — the A/B records per-replica
+    # optimizer-state bytes next to throughput.
     opt = hvd_jax.DistributedOptimizer(
         optax.adamw(1e-4),
-        **({"compression": comp} if comp is not None else {}))
+        **({"compression": comp} if comp is not None else {}),
+        **({"zero": bool(zero)} if zero is not None else {}))
 
     def loss_fn(p, b):
         x, y = b
@@ -145,6 +150,19 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
 
     step = hvd_jax.make_train_step(loss_fn, opt)
     opt_state = opt.init(params)
+    opt_state_bytes = None
+    if zero is not None:
+        # Per-replica optimizer-state footprint: the A/B's second
+        # axis. Sharded mode reads the runtime's measure (what the
+        # hvd_zero_state_bytes gauge reports); replicated sums the
+        # whole state tree every chip holds.
+        if zero:
+            opt_state_bytes = opt._zero_rt.state_bytes(opt_state)
+        else:
+            opt_state_bytes = sum(
+                int(np.prod(x.shape)) * x.dtype.itemsize
+                for x in jax.tree.leaves(opt_state)
+                if hasattr(x, "dtype"))
     rng = np.random.RandomState(0)
     data = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(batch, seq)))
     target = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(batch, seq)))
@@ -193,6 +211,11 @@ def _bench_transformer(hvd, hvd_jax, on_tpu, seq_tpu=512, batch_tpu=24,
         out["compression"] = compression
         out["compression_ratio"] = round(wire_bytes / grad_bytes, 4)
         out["grad_bytes_saved_per_step"] = int(grad_bytes - wire_bytes)
+    if zero is not None:
+        out["zero"] = int(bool(zero))
+        out["opt_state_bytes_per_replica"] = int(opt_state_bytes)
+        if zero:
+            out["zero_buckets"] = len(opt._zero_rt.plan.buckets)
     if overlap is not None:
         from horovod_tpu.ops import bucketing as _bucketing
         from horovod_tpu.utils import envparse as _envparse
@@ -673,6 +696,52 @@ def main():
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    # --zero: A/B the replicated vs ZeRO-1 sharded weight update on the
+    # transformer-LM stand-in (throughput + per-replica optimizer-state
+    # bytes) and archive BENCH_r08.json (docs/performance.md "ZeRO-1").
+    if "--zero" in sys.argv:
+        rows = []
+        for z in (0, 1):
+            for codec in ((None,) if z == 0 else (None, "int8")):
+                tag = (f"zero_{'on' if z else 'off'}"
+                       + (f"_comp_{codec}" if codec else ""))
+                try:
+                    row = _bench_transformer(
+                        hvd, hvd_jax, on_tpu, zero=z, compression=codec,
+                        metric=f"transformer_lm_365m_seq512_{tag}"
+                               "_train_samples_per_sec_per_chip")
+                except Exception as e:  # noqa: BLE001 — best-effort row
+                    print(f"# bench: zero row {tag} failed: {e!r}",
+                          file=sys.stderr, flush=True)
+                    continue
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+        try:
+            n = hvd.size() if hvd.size() > 1 else len(jax.devices())
+            by_zero = {r["zero"]: r for r in rows
+                       if "compression" not in r}
+            summary = {}
+            if 0 in by_zero and 1 in by_zero:
+                summary = {
+                    "replicated_state_bytes":
+                        by_zero[0]["opt_state_bytes_per_replica"],
+                    "sharded_state_bytes":
+                        by_zero[1]["opt_state_bytes_per_replica"],
+                    "state_fraction": round(
+                        by_zero[1]["opt_state_bytes_per_replica"]
+                        / max(by_zero[0]["opt_state_bytes_per_replica"],
+                              1), 4),
+                    "world_size": n,
+                }
+            with open("BENCH_r08.json", "w") as f:
+                json.dump({"cmd": "python bench.py --zero",
+                           "rows": rows, "summary": summary}, f,
+                          indent=1)
+            print("# bench: zero A/B archived to BENCH_r08.json",
+                  file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            print(f"# bench: BENCH_r08.json write failed: {e}",
+                  file=sys.stderr, flush=True)
     # --trace: smoke the cross-rank trace plane on the transformer-LM
     # gradient set (eager plane), archive the analyzer summary to
     # BENCH_r07.json and hold tracing-on to the <3% overhead budget

@@ -131,6 +131,12 @@ BROADCAST_STATE_CALLS = frozenset({
 DIST_OPT_CALLS = frozenset({
     "DistributedOptimizer", "DistributedAdasumOptimizer",
 })
+# Accepted env spellings of the ZeRO knob (rule HVD208): a script that
+# exports any of these and builds an Adasum / sub-cohort optimizer
+# will crash at DistributedOptimizer.__init__.
+_ZERO_ENV_NAMES = frozenset({
+    "HVDTPU_ZERO", "HOROVOD_TPU_ZERO", "HOROVOD_ZERO",
+})
 # horovod_tpu.checkpoint helpers that coordinate internally (rank-0
 # write + barrier, or restore + broadcast): calling them under a rank
 # guard deadlocks the unguarded ranks (HVD204).
@@ -212,6 +218,7 @@ class _Analyzer(ast.NodeVisitor):
         self.has_broadcast = False
         self.uses_elastic = False
         self.int_names = set()      # names assigned integer-looking values
+        self.zero_env_set = False   # script set HVDTPU_ZERO-family env
         self._flagged = set()       # id(call) already reported
 
     # -- imports -----------------------------------------------------------
@@ -528,11 +535,77 @@ class _Analyzer(ast.NodeVisitor):
         return any(isinstance(n, ast.Name) and n.id in self.int_names
                    for n in ast.walk(expr))
 
+    # -- HVD208: ZeRO × Adasum / non-global process set --------------------
+    def _note_zero_env(self, node):
+        """Record ``os.environ["HVDTPU_ZERO"] = "1"`` (any accepted
+        prefix spelling, any truthy value)."""
+        for target in node.targets:
+            if not isinstance(target, ast.Subscript):
+                continue
+            base = target.value
+            is_env = ((isinstance(base, ast.Attribute)
+                       and base.attr == "environ")
+                      or (isinstance(base, ast.Name)
+                          and base.id == "environ"))
+            key = target.slice
+            if (is_env and isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value in _ZERO_ENV_NAMES
+                    and isinstance(node.value, ast.Constant)
+                    and str(node.value.value).strip().lower()
+                    in ("1", "true", "yes", "on")):
+                self.zero_env_set = True
+
+    def _report_208(self, call, why):
+        self._flagged.add(id(call))
+        self.diags.append(Diagnostic.make(
+            "HVD208",
+            f"ZeRO sharded update combined with {why}: Adasum's "
+            "per-tensor scale-invariant combination does not "
+            "reduce-scatter, and a non-global process set derives a "
+            "shard plan over the wrong replica axis — "
+            "DistributedOptimizer raises at __init__ either way",
+            file=self.filename, line=call.lineno,
+            hint="drop zero=/HVDTPU_ZERO for this optimizer (or switch "
+                 "to op=Average/Sum on the global process set); "
+                 + _DOC_HINT))
+
+    def _check_208(self, node):
+        term = _terminal_name(node.func)
+        if term not in DIST_OPT_CALLS or id(node) in self._flagged:
+            return
+        zero_on = self.zero_env_set
+        for kw in node.keywords:
+            if kw.arg == "zero":
+                if isinstance(kw.value, ast.Constant):
+                    # An explicit constant wins over the env knob —
+                    # mirror __init__, where zero=False opts this
+                    # optimizer out even under HVDTPU_ZERO=1.
+                    zero_on = bool(kw.value.value)
+                else:
+                    # zero=<flag>: statically unknown — treat as
+                    # reachable-on (the combination is never valid).
+                    zero_on = True
+        if not zero_on:
+            return
+        reasons = []
+        if term == "DistributedAdasumOptimizer":
+            reasons.append("Adasum (DistributedAdasumOptimizer)")
+        for kw in node.keywords:
+            if kw.arg == "op" and _terminal_name(kw.value) == "Adasum":
+                reasons.append("op=Adasum")
+            elif (kw.arg == "process_set"
+                    and _terminal_name(kw.value) != "global_process_set"):
+                reasons.append("a non-global process_set")
+        if reasons:
+            self._report_208(node, " and ".join(reasons))
+
     def visit_Assign(self, node):
         # One-hop dataflow for HVD205: `labels = ...int32...` marks the
         # NAME, so a later `allreduce(labels, compression=...)` is
         # recognizable. Reassignment from a float-looking value clears
         # the mark (last write wins, like the interpreter).
+        self._note_zero_env(node)
         names = [t.id for t in node.targets if isinstance(t, ast.Name)]
         if names:
             inty = self._expr_is_inty(node.value)
@@ -582,6 +655,7 @@ class _Analyzer(ast.NodeVisitor):
         elif term in DIST_OPT_CALLS:
             if self.dist_opt_node is None:
                 self.dist_opt_node = node
+            self._check_208(node)
         elif term in BROADCAST_STATE_CALLS:
             self.has_broadcast = True
         self._check_205(node)

@@ -40,6 +40,12 @@ RULES = {
                         "feeding a metric observe() — use the "
                         "telemetry.spans.span API (one instrument for "
                         "histogram + timeline + trace plane)"),
+    "HVD208": (ERROR, "ZeRO sharded update (zero=/HVDTPU_ZERO) combined "
+                      "with Adasum or a non-global process set "
+                      "(per-tensor Adasum semantics don't "
+                      "reduce-scatter; a sub-cohort derives a wrong "
+                      "shard plan — DistributedOptimizer rejects both "
+                      "at __init__)"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "

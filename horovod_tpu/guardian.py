@@ -183,8 +183,14 @@ def make_cross_process_board():
 # disagreeing on it would run DIFFERENT wire pipelines for the same
 # named collective — int8 payloads reduced against raw floats — so a
 # mismatch must fail fast naming the field, not corrupt numerics.
+# "shard_index"/"shard_shape" cover the scatter/gather collective kinds
+# (reducescatter, allgather, and the ZeRO plane's zero_reduce_scatter/
+# zero_allgather): every rank must hold the shard its index owns, and
+# even-split shard shapes must agree — a rank holding the wrong slice
+# reassembles a permuted buffer with no arithmetic error to catch it.
 _DIGEST_FIELDS = ("kind", "op", "dtype", "shapes", "process_set",
-                  "prescale", "postscale", "root_rank", "codec")
+                  "prescale", "postscale", "root_rank", "codec",
+                  "shard_index", "shard_shape")
 
 
 def _codec_digest(entry):
@@ -197,6 +203,40 @@ def _codec_digest(entry):
     return str(codec)
 
 
+def _shard_fields(entry, shapes):
+    """(shard_index, shard_shape) for the scatter/gather kinds; (None,
+    None) otherwise. shard_index is this rank's slot in the process
+    set (verified against the publisher's rank — see compare_digests);
+    shard_shape is the per-rank shard for EVEN splits only (uneven
+    splits legitimately differ per rank, and entries whose process set
+    cannot answer rank() yet are skipped rather than guessed)."""
+    if entry.kind not in ("reducescatter", "allgather"):
+        return None, None
+    if entry.process_set.process_set_id not in (None, 0):
+        # Sub-cohort sets: process_set.rank() is the rank WITHIN the
+        # set, but verify() keys peers by GLOBAL rank — publishing the
+        # set-relative index would false-abort healthy jobs. The shard
+        # fields cover the global cohort (and the ZeRO plane, which is
+        # global-only by construction).
+        return None, None
+    try:
+        rank = entry.process_set.rank()
+    except Exception:  # noqa: BLE001 — pre-init / test stub process set
+        return None, None
+    if getattr(entry, "uneven", False) or not shapes:
+        return rank, None
+    if entry.kind == "reducescatter":
+        # Stacked (n, s0, ...) input → the reduction's dim 0 is split
+        # across ranks; even only when every s0 divides by n.
+        n = shapes[0][0] if shapes[0] else 0
+        if n <= 0 or any(len(s) < 2 or s[1] % n for s in shapes):
+            return rank, None
+        return rank, [[s[1] // n] + s[2:] for s in shapes]
+    # allgather: each rank contributes its local shard as-is; shapes
+    # must agree across ranks for the even (non-`uneven`) form.
+    return rank, [list(s) for s in shapes]
+
+
 def entry_digest(entry):
     """Compact metadata digest of a TensorEntry — everything that must
     agree across ranks for the collective to be well-formed (the analog
@@ -207,6 +247,7 @@ def entry_digest(entry):
         if dtype is None and hasattr(a, "dtype"):
             dtype = str(a.dtype)
         shapes.append([int(s) for s in getattr(a, "shape", ())])
+    shard_index, shard_shape = _shard_fields(entry, shapes)
     return {
         "kind": entry.kind,
         "op": reduce_ops.op_name(entry.op) if entry.op is not None
@@ -220,6 +261,8 @@ def entry_digest(entry):
         else float(entry.postscale),
         "root_rank": entry.root_rank,
         "codec": _codec_digest(entry),
+        "shard_index": shard_index,
+        "shard_shape": shard_shape,
     }
 
 
@@ -229,11 +272,21 @@ def render_digest(digest):
 
 def compare_digests(mine, theirs_by_rank):
     """Diff the local digest against each rank's published one. Returns
-    ``[(rank, field, theirs, mine), ...]`` — empty when consistent."""
+    ``[(rank, field, theirs, mine), ...]`` — empty when consistent.
+
+    ``shard_index`` is the one per-rank-varying field: a peer's value
+    must equal its OWN rank (rank r claiming shard q would reassemble a
+    permuted buffer), so it is checked against the publishing rank, not
+    against the local value."""
     divergences = []
     for rank in sorted(theirs_by_rank):
         theirs = theirs_by_rank[rank]
         for field in _DIGEST_FIELDS:
+            if field == "shard_index":
+                peer_index = theirs.get(field)
+                if peer_index is not None and peer_index != rank:
+                    divergences.append((rank, field, peer_index, rank))
+                continue
             if theirs.get(field) != mine.get(field):
                 divergences.append((rank, field, theirs.get(field),
                                     mine.get(field)))

@@ -86,13 +86,19 @@ class DistributedOptimizer:
       backward_passes_per_step: local gradient-aggregation factor.
       compression: Compression.none / fp16 / bf16 applied to reduced grads.
       process_set: eager-mode process set.
+      zero: ZeRO-1 sharded weight update (``ops/zero.py``): gradients
+        reduce-scatter instead of allreduce, each replica steps only
+        its 1/n slice of a sharded optimizer state, and updated shards
+        allgather back. None reads ``HVDTPU_ZERO``. Axis (shard_map)
+        path only; Average/Sum; rejects Adasum and non-global process
+        sets at construction (docs/performance.md "ZeRO-1").
     """
 
     def __init__(self, optimizer, op=reduce_ops.Average, axis_name=None,
                  backward_passes_per_step=1, compression=Compression.none,
                  prescale_factor=None, postscale_factor=None,
                  average_aggregated_gradients=True,
-                 process_set=global_process_set):
+                 process_set=global_process_set, zero=None):
         self.inner = optimizer
         self.op = op
         self.axis_name = axis_name
@@ -133,9 +139,93 @@ class DistributedOptimizer:
             from ..utils import envparse as _envparse
             self._wire_block = _envparse.get_int(
                 _envparse.COMPRESSION_BLOCK, _codecs.DEFAULT_BLOCK)
+        # ZeRO-1 sharded weight update (HVDTPU_ZERO; ops/zero.py,
+        # docs/performance.md). Resolved at construction like the
+        # overlap knobs; the incompatible combinations are rejected
+        # HERE — loudly, not at the first traced step (hvd-lint HVD208
+        # flags the same combinations statically).
+        self.zero = _ep.get_bool(_ep.ZERO) if zero is None else bool(zero)
+        self._zero_rt = None
+        if self.zero:
+            if op == reduce_ops.Adasum:
+                raise ValueError(
+                    "zero=True (HVDTPU_ZERO) is incompatible with "
+                    "op=Adasum: Adasum's per-tensor scale-invariant "
+                    "combination does not reduce-scatter "
+                    "(docs/performance.md \"ZeRO-1\"; hvd-lint HVD208)")
+            if process_set is not global_process_set:
+                raise ValueError(
+                    "zero=True (HVDTPU_ZERO) requires the global "
+                    "process set: the shard plan partitions state over "
+                    "the whole replica axis, and a sub-cohort would "
+                    "compute a different (wrong) plan (hvd-lint HVD208)")
+            if self.k != 1:
+                raise ValueError(
+                    "zero=True (HVDTPU_ZERO) does not compose with "
+                    "backward_passes_per_step > 1 (accumulate micro-"
+                    "batch gradients before the step instead)")
+            self._zero_bucket_bytes = _ep.get_int(
+                _ep.ZERO_BUCKET_BYTES, _bucketing.DEFAULT_BUCKET_BYTES)
+
+    # -- ZeRO-1 mode -------------------------------------------------------
+    def _zero_codec(self):
+        """Codec name the ZeRO legs carry: the wire marker, or the
+        cast compressors translated to their codec spelling (the legs
+        ride the narrow dtype directly — reference cast semantics)."""
+        if self._wire_codec is not None:
+            return self._wire_codec, self._wire_block
+        if self.compression is Compression.fp16:
+            return "fp16", 0
+        if self.compression is Compression.bf16:
+            return "bf16", 0
+        return None, 0
+
+    def _zero_runtime(self, mesh=None, axis_name=None):
+        """Build (once) the ZeroRuntime binding inner optimizer × mesh
+        × codec. ``init`` resolves the default runtime mesh; the zero
+        train step passes its own so both agree — a mismatch is a
+        loud error, not a silently different shard plan."""
+        from ..ops import zero as _zero
+        if self._zero_rt is None:
+            if mesh is None:
+                rt = basics.runtime()
+                if rt.mode == basics.MODE_SPMD and rt.topology.size > 1:
+                    raise RuntimeError(
+                        "HVDTPU_ZERO has no per-process host-plane "
+                        "variant: without an explicit global mesh the "
+                        "default mesh holds one local device and ranks "
+                        "would not sync. Use a jax.distributed global "
+                        "mesh, or drop the knob for the host-plane "
+                        "step.")
+                mesh = rt.mesh
+            codec, block = self._zero_codec()
+            self._zero_rt = _zero.ZeroRuntime(
+                self.inner, mesh, axis_name or self.axis_name or HVD_AXIS,
+                op=self.op, bucket_bytes=self._zero_bucket_bytes,
+                codec=codec, block=block, prescale=self.prescale,
+                postscale=self.postscale)
+        elif mesh is not None and self._zero_rt.mesh != mesh:
+            raise ValueError(
+                "DistributedOptimizer's ZeRO state was initialized for "
+                "a different mesh than the train step's; pass the same "
+                "mesh to make_train_step and init (or let both default "
+                "to the runtime mesh)")
+        return self._zero_rt
+
+    def _zero_rebuild(self, params, opt_state, mesh=None, axis_name=None):
+        """Elastic membership changed under us: derive the new plan for
+        the current world size and deterministically reshard the
+        optimizer state onto it (ops/zero.reshard_state)."""
+        from ..ops import zero as _zero
+        old = self._zero_rt
+        self._zero_rt = None
+        new = self._zero_runtime(mesh=mesh, axis_name=axis_name)
+        return new, _zero.reshard_state(opt_state, old, new, params)
 
     # -- optax interface ---------------------------------------------------
     def init(self, params):
+        if self.zero:
+            return self._zero_runtime().init_state(params)
         inner = self.inner.init(params)
         if self.k == 1:
             return (inner, None, jnp.zeros((), jnp.int32))
@@ -240,6 +330,17 @@ class DistributedOptimizer:
         return grads
 
     def update(self, grads, state, params=None):
+        if self.zero:
+            if self._zero_rt is None:
+                raise RuntimeError(
+                    "ZeRO mode: call init(params) (or run through "
+                    "make_train_step) before update — the sharded "
+                    "state and shard plan are built there")
+            if params is None:
+                raise ValueError(
+                    "ZeRO mode needs params in update(): the sharded "
+                    "optimizer step reads the local parameter shard")
+            return self._zero_rt.update_in_axis(grads, state, params)
         inner_state, acc, count = state
         if self.k == 1:
             reduced = self._reduce(grads)
@@ -348,9 +449,27 @@ def make_train_step(loss_fn, dist_opt, mesh=None, axis_name=HVD_AXIS,
             # per-process plan instead: jitted local compute, gradients
             # reduced eagerly through the process-level data plane (the
             # reference's execution model).
+            if getattr(dist_opt, "zero", False):
+                raise RuntimeError(
+                    "HVDTPU_ZERO has no per-process host-plane "
+                    "variant: pass a jax.distributed global mesh, or "
+                    "drop the knob for the host-plane step")
             return _make_hostplane_train_step(loss_fn, dist_opt,
                                               has_aux=has_aux)
         mesh = rt.mesh
+    if getattr(dist_opt, "zero", False):
+        # ZeRO-1: the state layout (sharded along the axis) and the
+        # reduction (reduce-scatter → sharded step → allgather) both
+        # change, so the step is built by the dedicated path. The
+        # shard plan needs concrete leaf shapes — built lazily on the
+        # first call (or by dist_opt.init, whichever runs first).
+        if dist_opt.axis_name not in (None, axis_name):
+            raise ValueError(
+                f"DistributedOptimizer was built for axis "
+                f"{dist_opt.axis_name!r} but the train step uses "
+                f"{axis_name!r}")
+        return _make_zero_step(loss_fn, dist_opt, mesh, axis_name,
+                               donate, has_aux)
     if dist_opt.axis_name is None:
         # Clone rather than mutate: the caller's optimizer object keeps its
         # eager behavior outside this train step.
@@ -462,29 +581,109 @@ def _make_hostplane_train_step(loss_fn, dist_opt, has_aux=False):
     return step
 
 
+def _make_zero_step(loss_fn, dist_opt, mesh, axis_name, donate, has_aux):
+    """ZeRO-1 train step (HVDTPU_ZERO; ops/zero.py): the optimizer
+    state rides SHARDED through the step (in/out specs from the shard
+    plan), gradients reduce-scatter per fusion bucket, the inner
+    optimizer steps the local 1/n shard, and updated shards allgather
+    back. Built lazily on the first call — the plan needs concrete
+    leaf shapes. The outer wrapper also watches the elastic membership
+    version: a bump triggers a deterministic reshard of the state to
+    the new world size before the re-traced step runs."""
+    from jax.sharding import PartitionSpec as P
+
+    # closure state: the jitted fn + the mesh override (dropped after an
+    # elastic rebuild so the runtime re-resolves the CURRENT mesh).
+    cache = {"fn": None, "mesh": mesh}
+    # Bind the runtime NOW (the plan stays lazy): a later
+    # dist_opt.init(params) must shard the state over THIS step's mesh,
+    # not re-resolve a default that may differ.
+    dist_opt._zero_runtime(mesh=mesh, axis_name=axis_name)
+
+    def build(zrt):
+        state_spec = zrt.state_specs()
+
+        def _grads(params, batch, aux=None):
+            params_v = jax.tree.map(lambda p: _pvary(p, axis_name),
+                                    params)
+            if has_aux:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params_v, aux, batch)
+                new_aux = jax.tree.map(
+                    lambda a: lax.pmean(a, axis_name), new_aux)
+                return loss, grads, new_aux
+            loss, grads = jax.value_and_grad(loss_fn)(params_v, batch)
+            return loss, grads, None
+
+        # apply_in_axis (not update + optax.apply_updates): the update
+        # is applied to the parameter shard BEFORE the allgather, so
+        # the optimizer multiply and parameter add compile to the same
+        # fused form as the replicated step — bit-identical fp32
+        # (ops/zero.py _run docstring).
+        def body_plain(params, opt_state, batch):
+            loss, grads, _ = _grads(params, batch)
+            new_params, new_state = zrt.apply_in_axis(
+                grads, opt_state, params)
+            return new_params, new_state, lax.pmean(loss, axis_name)
+
+        def body_aux(params, aux, opt_state, batch):
+            loss, grads, new_aux = _grads(params, batch, aux)
+            new_params, new_state = zrt.apply_in_axis(
+                grads, opt_state, params)
+            return (new_params, new_aux, new_state,
+                    lax.pmean(loss, axis_name))
+
+        # check_vma off: the allgather'd updates are replicated by
+        # construction (every rank contributes its shard and receives
+        # all others) but the varying-axes type system cannot prove it.
+        if has_aux:
+            sharded = _shard_map(
+                body_aux, mesh=zrt.mesh,
+                in_specs=(P(), P(), state_spec, P(axis_name)),
+                out_specs=(P(), P(), state_spec, P()), check_vma=False)
+            dn = (0, 1, 2) if donate else ()
+        else:
+            sharded = _shard_map(
+                body_plain, mesh=zrt.mesh,
+                in_specs=(P(), state_spec, P(axis_name)),
+                out_specs=(P(), state_spec, P()), check_vma=False)
+            dn = (0, 1) if donate else ()
+        return jax.jit(sharded, donate_argnums=dn)
+
+    def step(*args):
+        params, opt_state = args[0], args[-2]
+        zrt = dist_opt._zero_runtime(mesh=cache["mesh"],
+                                     axis_name=axis_name)
+        if zrt.stale_version():
+            zrt, opt_state = dist_opt._zero_rebuild(
+                params, opt_state, axis_name=axis_name)
+            args = args[:-2] + (opt_state,) + args[-1:]
+            cache["fn"] = None
+            cache["mesh"] = None
+        zrt.ensure_plan(params)
+        if cache["fn"] is None:
+            cache["fn"] = build(zrt)
+        return cache["fn"](*args)
+
+    return step
+
+
 def make_zero_train_step(loss_fn, dist_opt, mesh=None,
                          axis_name=HVD_AXIS, donate=True):
-    """ZeRO-1 variant of :func:`make_train_step`: optimizer state lives
-    SHARDED along ``axis_name`` — each replica holds 1/N of the flat
-    parameter vector's moments, gradients arrive via reduce-scatter
-    instead of allreduce, and updated parameter shards all_gather back
-    to the replicated copy. Memory per chip for Adam-family state drops
-    from 2x params to 2x params / N (value-add beyond the reference,
-    whose data plane always replicates optimizer state).
-
-    Works with elementwise optax transforms (sgd/adam/adamw/...); the
-    optimizer sees a flat 1-D shard, so transforms that need the
-    parameter tree structure (per-layer masks, clipping by global
-    norm) are out of scope — use make_train_step for those.
+    """Legacy explicit entry for the ZeRO-1 step (predates the
+    ``HVDTPU_ZERO`` mode; kept for its ``(step, init_state)`` return
+    shape). The implementation is the ops/zero.py sharded-update plane
+    with a single whole-tree bucket, so the sharded state leaves are
+    the flat parameter vector's moments padded to N × shard_len —
+    exactly the original contract. New code should set ``zero=True``
+    (or ``HVDTPU_ZERO=1``) on ``DistributedOptimizer`` and use
+    :func:`make_train_step`, which additionally buckets the legs for
+    comm/compute overlap and composes with wire compression.
 
     Returns ``(step, init_state)``:
       init_state(params) -> sharded opt_state (run once, jitted)
       step(params, opt_state, batch) -> (params, opt_state, loss)
     """
-    import optax
-    from jax.flatten_util import ravel_pytree
-    from jax.sharding import PartitionSpec as P
-
     if mesh is None:
         rt = basics.runtime()
         if rt.mode == basics.MODE_SPMD and rt.topology.size > 1:
@@ -502,7 +701,8 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
             f"{axis_name!r}")
     # The ZeRO step owns the gradient reduction (reduce-scatter) and the
     # inner update; DistributedOptimizer features that change either are
-    # rejected rather than silently ignored.
+    # rejected rather than silently ignored (the HVDTPU_ZERO mode is
+    # less restrictive: Sum and wire compression compose there).
     unsupported = []
     if dist_opt.op != reduce_ops.Average:
         unsupported.append(f"op={dist_opt.op!r}")
@@ -518,77 +718,20 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
             "only; unsupported DistributedOptimizer settings: "
             + ", ".join(unsupported)
             + " (use make_train_step for these)")
-    inner = dist_opt.inner
-    n = int(mesh.shape[axis_name])
 
-    # Optimizer-state leaves that carry per-parameter moments are 1-D
-    # (they mirror the flat shard); scalars (e.g. adam's count) stay
-    # replicated. The tree structure is known from a dummy shard.
-    state_shape = jax.eval_shape(
-        inner.init, jax.ShapeDtypeStruct((n,), jnp.float32))
-    state_spec = jax.tree.map(
-        lambda s: P(axis_name) if s.ndim >= 1 else P(), state_shape)
+    import copy
+    zopt = copy.copy(dist_opt)
+    zopt.zero = True
+    zopt._zero_rt = None
+    # One bucket per dtype: the legacy contract exposes the whole flat
+    # vector as a single sharded state leaf per moment.
+    zopt._zero_bucket_bytes = 1 << 62
+
+    step = _make_zero_step(loss_fn, zopt, mesh, axis_name, donate,
+                           has_aux=False)
 
     def init_state(params):
-        flat, _ = ravel_pytree(params)
-        shard_len = (flat.size + (-flat.size) % n) // n
-        dtype = flat.dtype
+        return zopt._zero_runtime(
+            mesh=mesh, axis_name=axis_name).init_state(params)
 
-        # Every leaf we mark P(axis_name) must actually mirror the flat
-        # parameter shard: an optax transform carrying a non-per-parameter
-        # 1-D leaf (e.g. a schedule table) would otherwise be silently
-        # sharded along the replica axis and corrupt its layout.
-        local_shape = jax.eval_shape(
-            inner.init, jax.ShapeDtypeStruct((shard_len,), dtype))
-        for path, leaf in jax.tree_util.tree_flatten_with_path(
-                local_shape)[0]:
-            if leaf.ndim >= 1 and leaf.shape != (shard_len,):
-                raise ValueError(
-                    "make_zero_train_step requires elementwise optimizer "
-                    "state; leaf "
-                    + jax.tree_util.keystr(path)
-                    + f" has shape {leaf.shape} != ({shard_len},) (the "
-                    "per-device parameter shard). Use make_train_step "
-                    "for transforms with non-per-parameter state.")
-
-        def body(p):
-            del p
-            return inner.init(jnp.zeros((shard_len,), dtype))
-
-        return jax.jit(_shard_map(
-            body, mesh=mesh, in_specs=(P(),),
-            out_specs=state_spec))(params)
-
-    def body(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            jax.tree.map(lambda p: _pvary(p, axis_name), params), batch)
-        flat_g, _ = ravel_pytree(grads)
-        flat_p, unravel = ravel_pytree(params)
-        pad = (-flat_p.size) % n
-        if pad:
-            flat_g = jnp.pad(flat_g, (0, pad))
-            flat_p = jnp.pad(flat_p, (0, pad))
-        # The gradient average lands directly in the owning shard: one
-        # reduce-scatter replaces the allreduce.
-        g_shard = lax.psum_scatter(flat_g, axis_name, tiled=True) / n
-        p_shard = flat_p.reshape(n, -1)[lax.axis_index(axis_name)]
-        updates, new_opt_state = inner.update(
-            g_shard, opt_state, p_shard)
-        new_p_shard = optax.apply_updates(p_shard, updates)
-        flat_new = lax.all_gather(new_p_shard, axis_name, tiled=True)
-        if pad:
-            flat_new = flat_new[:flat_new.size - pad]
-        return (unravel(flat_new), new_opt_state,
-                lax.pmean(loss, axis_name))
-
-    # check_vma off: all_gather'd params are replicated by construction
-    # (every rank contributes its shard and receives all others), but the
-    # varying-axes type system cannot prove it and would reject the P()
-    # out_spec.
-    sharded = _shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), state_spec, P(axis_name)),
-        out_specs=(P(), state_spec, P()),
-        check_vma=False)
-    donate_argnums = (0, 1) if donate else ()
-    return jax.jit(sharded, donate_argnums=donate_argnums), init_state
+    return step, init_state

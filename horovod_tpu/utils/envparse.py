@@ -248,6 +248,17 @@ BUCKET_BYTES = register(
     "BUCKET_BYTES", "16 MiB",
     "Payload bytes per gradient bucket on the overlap path")
 
+# -- ZeRO-1 sharded weight update (docs/performance.md) ---------------------
+ZERO = register(
+    "ZERO", "0",
+    "ZeRO-1 cross-replica sharded weight update: gradients "
+    "reduce-scatter per bucket, each replica steps 1/n of a sharded "
+    "optimizer state, updated shards allgather back (ops/zero.py)")
+ZERO_BUCKET_BYTES = register(
+    "ZERO_BUCKET_BYTES", "16 MiB",
+    "Payload bytes per ZeRO fusion bucket (reduce-scatter/allgather "
+    "legs); defaults to the overlap plane's bucket budget")
+
 # -- cross-rank tracing (docs/tracing.md) ----------------------------------
 TRACE = register(
     "TRACE", "0",

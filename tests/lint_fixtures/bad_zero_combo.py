@@ -1,0 +1,29 @@
+"""Fixture: ZeRO sharded update misuse (HVD208 x3, docs/lint.md)."""
+import os
+
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+
+hvd.init()
+cohort = hvd.add_process_set([0, 1])
+params = {}
+hvd_jax.broadcast_parameters(params, root_rank=0)
+
+# HVD208: explicit zero= with Adasum — per-tensor Adasum semantics
+# don't reduce-scatter; __init__ raises at runtime too.
+opt_a = hvd_jax.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                     zero=True)
+
+# HVD208: zero= with a non-global process set — the shard plan would
+# partition over the wrong replica axis.
+opt_b = hvd_jax.DistributedOptimizer(optax.adam(1e-3), zero=True,
+                                     process_set=cohort)
+
+# HVD208: the env spelling of the knob reaches the Adasum flavor.
+os.environ["HVDTPU_ZERO"] = "1"
+opt_c = hvd_jax.DistributedAdasumOptimizer(optax.sgd(0.1))
+
+# Fine: ZeRO with plain averaged gradients on the global cohort.
+opt_ok = hvd_jax.DistributedOptimizer(optax.adamw(1e-4), zero=True)

@@ -170,6 +170,43 @@ class TestAstRules:
         assert rules_of(self.lint("bad_per_tensor_allreduce.py")) == \
             ["HVD206", "HVD206", "HVD206"]
 
+    def test_zero_combo_fixture(self):
+        assert rules_of(self.lint("bad_zero_combo.py")) == \
+            ["HVD208", "HVD208", "HVD208"]
+
+    def test_zero_plain_is_clean(self):
+        src = ("import horovod_tpu.jax as hvd_jax\n"
+               "opt = hvd_jax.DistributedOptimizer(inner, zero=True)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_adasum_without_zero_is_clean(self):
+        src = ("import horovod_tpu.jax as hvd_jax\n"
+               "opt = hvd_jax.DistributedAdasumOptimizer(inner)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_zero_env_then_adasum_flagged(self):
+        src = ("import os\n"
+               "import horovod_tpu.jax as hvd_jax\n"
+               "os.environ['HVDTPU_ZERO'] = '1'\n"
+               "opt = hvd_jax.DistributedAdasumOptimizer(inner)\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD208"]
+
+    def test_explicit_zero_false_overrides_env_knob(self):
+        # zero=False opts this optimizer out at runtime even under
+        # HVDTPU_ZERO=1 (__init__ honors the explicit arg) — no finding.
+        src = ("import os\n"
+               "import horovod_tpu.jax as hvd_jax\n"
+               "os.environ['HVDTPU_ZERO'] = '1'\n"
+               "opt = hvd_jax.DistributedOptimizer(inner, zero=False,\n"
+               "                                   op=hvd.Adasum)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_zero_combo_suppressible(self):
+        src = ("import horovod_tpu.jax as hvd_jax\n"
+               "opt = hvd_jax.DistributedOptimizer(inner, zero=True, "
+               "op=hvd.Adasum)  # hvd-lint: disable=HVD208\n")
+        assert ast_lint.lint_source(src) == []
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"

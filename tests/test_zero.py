@@ -1,0 +1,539 @@
+"""ZeRO-1 sharded weight update (HVDTPU_ZERO; ops/zero.py,
+docs/performance.md "ZeRO-1").
+
+Pins the ISSUE 9 contracts: the sharded update is BIT-IDENTICAL to the
+replicated update for plain fp32 Sum/Average at n=1/2/4 (including the
+uneven-leaf padding path), optimizer state is born sharded at ~1/n of
+the replicated footprint (asserted through the hvd_zero_state_bytes
+gauge), wire codecs quantize both collective legs per bucket with
+error-feedback state, elastic version bumps trigger a deterministic
+reshard that preserves the moments, and the knob-off path does zero new
+work.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu import guardian
+from horovod_tpu.exceptions import CollectiveMismatchError
+from horovod_tpu.ops import reduce_ops, zero as zmod
+from horovod_tpu.utils import envparse
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    pass
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("hvd",))
+
+
+def _params(seed=0):
+    """Deliberately uneven leaf sizes (37 + 65 + 5 = 107 elements): no
+    world size in {2, 4, 8} divides them, so every plan exercises the
+    pad-and-split path."""
+    rng = np.random.RandomState(seed)
+    return {"a": jnp.asarray(rng.randn(37), jnp.float32),
+            "w": jnp.asarray(rng.randn(13, 5), jnp.float32),
+            "b": jnp.asarray(rng.randn(5), jnp.float32)}
+
+
+def _loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] + p["b"] - y) ** 2) + jnp.mean(p["a"] ** 2)
+
+
+def _batch(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(2 * n, 13), jnp.float32),
+            jnp.asarray(rng.randn(2 * n, 5), jnp.float32))
+
+
+# ==========================================================================
+# Shard plan
+# ==========================================================================
+
+def test_plan_zero_pads_uneven_leaves():
+    leaves = [jnp.zeros(37), jnp.zeros((13, 5)), jnp.zeros(5)]
+    plan = zmod.plan_zero(leaves, n=4, bucket_bytes=1 << 30)
+    assert len(plan.buckets) == 1
+    (s,) = plan.shards
+    assert s.size == 107
+    assert s.padded == 108 and s.padded % 4 == 0
+    assert s.shard_len * 4 == s.padded
+
+
+def test_plan_zero_block_granule():
+    # A wire codec's block size coarsens the pad granule: every rank
+    # must own a whole number of quantization blocks.
+    leaves = [jnp.zeros(107)]
+    plan = zmod.plan_zero(leaves, n=2, bucket_bytes=1 << 30, block=32)
+    (s,) = plan.shards
+    assert s.padded % (2 * 32) == 0
+    assert s.padded == 128
+
+
+def test_plan_zero_reuses_overlap_bucket_order():
+    # plan_buckets walks leaves in REVERSE so the first bucket holds
+    # the last (earliest-available) gradients — the overlap priority
+    # order the ZeRO legs inherit.
+    leaves = [jnp.zeros(64), jnp.zeros(64), jnp.zeros(64), jnp.zeros(64)]
+    plan = zmod.plan_zero(leaves, n=2, bucket_bytes=512)
+    assert plan.buckets[0].indices == [2, 3]
+    assert plan.buckets[1].indices == [0, 1]
+
+
+def test_plan_zero_signature_deterministic_and_world_size_keyed():
+    leaves = [jnp.zeros(37), jnp.zeros(70)]
+    a = zmod.plan_zero(leaves, n=4, bucket_bytes=4096)
+    b = zmod.plan_zero(leaves, n=4, bucket_bytes=4096)
+    assert a.signature() == b.signature()
+    c = zmod.plan_zero(leaves, n=2, bucket_bytes=4096)
+    assert c.signature() != a.signature()
+    assert c.signature()["n"] == 2
+
+
+# ==========================================================================
+# Bit-exactness vs the replicated update (the headline contract)
+# ==========================================================================
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+@pytest.mark.parametrize("op", [reduce_ops.Average, reduce_ops.Sum])
+def test_zero_step_bit_identical_to_replicated(hvd, n, op):
+    mesh = _mesh(n)
+    params = _params()
+    batch = _batch(n)
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), op=op)
+    step = hvd_jax.make_train_step(_loss_fn, opt, mesh=mesh, donate=False)
+    s = opt.init(params)
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), op=op,
+                                        zero=True)
+    zstep = hvd_jax.make_train_step(_loss_fn, zopt, mesh=mesh,
+                                    donate=False)
+    zs = zopt.init(params)
+    pp, zpp = params, params
+    for i in range(3):
+        pp, s, loss = step(pp, s, batch)
+        zpp, zs, zloss = zstep(zpp, zs, batch)
+        assert float(loss) == float(zloss), (i, float(loss), float(zloss))
+        for k in pp:
+            assert (np.asarray(pp[k]) == np.asarray(zpp[k])).all(), \
+                f"step {i}, leaf {k}: sharded update != replicated"
+
+
+def test_zero_multi_bucket_bit_identical(hvd, monkeypatch):
+    # A tiny bucket budget forces several buckets (uneven leaf sizes,
+    # leaves spanning bucket boundaries) — still bit-exact.
+    monkeypatch.setenv("HVDTPU_ZERO_BUCKET_BYTES", "256")
+    n, mesh = 4, _mesh(4)
+    params, batch = _params(), _batch(4)
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2))
+    step = hvd_jax.make_train_step(_loss_fn, opt, mesh=mesh, donate=False)
+    s = opt.init(params)
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    zstep = hvd_jax.make_train_step(_loss_fn, zopt, mesh=mesh,
+                                    donate=False)
+    zs = zopt.init(params)
+    assert len(zopt._zero_rt.plan.buckets) > 1
+    pp, zpp = params, params
+    for _ in range(3):
+        pp, s, _ = step(pp, s, batch)
+        zpp, zs, _ = zstep(zpp, zs, batch)
+    for k in pp:
+        assert (np.asarray(pp[k]) == np.asarray(zpp[k])).all()
+
+
+def test_zero_env_knob_selects_mode(hvd, monkeypatch):
+    monkeypatch.setenv("HVDTPU_ZERO", "1")
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    assert opt.zero
+    monkeypatch.delenv("HVDTPU_ZERO")
+    assert not hvd_jax.DistributedOptimizer(optax.sgd(0.1)).zero
+
+
+# ==========================================================================
+# Sharded state: born sharded, ~1/n footprint
+# ==========================================================================
+
+def test_zero_state_born_sharded(hvd):
+    n, mesh = 4, _mesh(4)
+    params = _params()
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    hvd_jax.make_train_step(_loss_fn, zopt, mesh=mesh)
+    zs = zopt.init(params)
+    (s,) = zopt._zero_rt.plan.shards
+    vec_leaves = [l for l in jax.tree.leaves(zs[0]) if np.ndim(l) >= 1]
+    assert vec_leaves, "adam must carry mu/nu vectors"
+    for leaf in vec_leaves:
+        assert leaf.shape == (s.padded,)
+        shards = leaf.addressable_shards
+        assert len(shards) == n
+        assert all(sh.data.shape == (s.shard_len,) for sh in shards)
+
+
+def test_zero_state_bytes_gauge_is_fraction_of_replicated(
+        hvd, monkeypatch):
+    from horovod_tpu.telemetry import core as telemetry
+    monkeypatch.setenv("HVDTPU_METRICS", "1")
+    telemetry.reset()
+    try:
+        n, mesh = 4, _mesh(4)
+        # Big-ish params so per-bucket padding is noise next to payload.
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(64, 33), jnp.float32),
+                  "b": jnp.asarray(rng.randn(33), jnp.float32)}
+        opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2))
+        replicated = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree.leaves(opt.init(params)[0]))
+        zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+        hvd_jax.make_train_step(_loss_fn, zopt, mesh=mesh)
+        zopt.init(params)
+        measured = telemetry.gauge("hvd_zero_state_bytes").value
+        assert measured > 0
+        # ~1/n of the replicated footprint: padding adds at most one
+        # granule per bucket, scalars (adam count) stay replicated.
+        assert measured < replicated / n * 1.10, (measured, replicated)
+        assert measured > replicated / n * 0.90, (measured, replicated)
+    finally:
+        telemetry.reset()
+
+
+# ==========================================================================
+# Compression-composed legs
+# ==========================================================================
+
+def test_zero_int8_legs_converge_close_to_uncompressed(hvd):
+    n, mesh = 4, _mesh(4)
+    params, batch = _params(), _batch(4)
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    zstep = hvd_jax.make_train_step(_loss_fn, zopt, mesh=mesh,
+                                    donate=False)
+    zs = zopt.init(params)
+    q = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True,
+                                     compression=hvd_mod.Compression.int8)
+    qstep = hvd_jax.make_train_step(_loss_fn, q, mesh=mesh, donate=False)
+    qs = q.init(params)
+    # Both collective legs carry int8: the runtime must hold a wire
+    # codec and per-bucket EF residual state.
+    assert q._zero_rt.codec is not None and q._zero_rt.codec.wire
+    assert q._zero_rt.error_feedback
+    assert len(qs[1]) == len(q._zero_rt.plan.buckets)  # scatter residuals
+    assert len(qs[2]) == len(q._zero_rt.plan.buckets)  # gather residuals
+    pp, qq = params, params
+    losses, qlosses = [], []
+    for _ in range(30):
+        pp, zs, l = zstep(pp, zs, batch)
+        qq, qs, ql = qstep(qq, qs, batch)
+        losses.append(float(l))
+        qlosses.append(float(ql))
+    assert qlosses[-1] < qlosses[0] * 0.7, qlosses
+    # Quantized trajectory tracks the exact one (error feedback keeps
+    # the bias bounded; loose tolerance — int8 wire is lossy).
+    assert abs(qlosses[-1] - losses[-1]) < 0.15 * abs(losses[-1])
+
+
+def test_zero_fp8_legs_run_when_supported(hvd):
+    from horovod_tpu.compression import codecs
+    if not codecs.fp8_supported():
+        pytest.skip("jax build has no float8_e4m3fn")
+    mesh = _mesh(2)
+    params, batch = _params(), _batch(2)
+    q = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True,
+                                     compression=hvd_mod.Compression.fp8)
+    qstep = hvd_jax.make_train_step(_loss_fn, q, mesh=mesh, donate=False)
+    qs = q.init(params)
+    pp = params
+    for _ in range(3):
+        pp, qs, loss = qstep(pp, qs, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_zero_wire_error_feedback_disabled_by_knob(hvd, monkeypatch):
+    monkeypatch.setenv("HVDTPU_COMPRESSION_ERROR_FEEDBACK", "0")
+    mesh = _mesh(2)
+    q = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True,
+                                     compression=hvd_mod.Compression.int8)
+    hvd_jax.make_train_step(_loss_fn, q, mesh=mesh)
+    qs = q.init(_params())
+    assert not q._zero_rt.error_feedback
+    assert qs[1] == () and qs[2] == ()
+
+
+def test_zero_cast_codec_rides_the_legs(hvd):
+    mesh = _mesh(2)
+    params, batch = _params(), _batch(2)
+    c = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True,
+                                     compression=hvd_mod.Compression.bf16)
+    cstep = hvd_jax.make_train_step(_loss_fn, c, mesh=mesh, donate=False)
+    cs = c.init(params)
+    assert c._zero_rt.codec is not None and not c._zero_rt.codec.wire
+    assert cs[1] == () and cs[2] == ()  # EF is wire-codec state
+    pp = params
+    for _ in range(3):
+        pp, cs, loss = cstep(pp, cs, batch)
+    assert np.isfinite(float(loss))
+
+
+# ==========================================================================
+# Elastic reshard
+# ==========================================================================
+
+def test_reshard_preserves_moments_across_world_sizes(hvd):
+    params, batch = _params(), _batch(4)
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    zstep = hvd_jax.make_train_step(_loss_fn, zopt, mesh=_mesh(4),
+                                    donate=False)
+    zs = zopt.init(params)
+    pp = params
+    for _ in range(3):
+        pp, zs, _ = zstep(pp, zs, batch)
+    old_rt = zopt._zero_rt
+    new_opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    new_rt = new_opt._zero_runtime(mesh=_mesh(2), axis_name="hvd")
+    zs2 = zmod.reshard_state(zs, old_rt, new_rt, pp)
+    # Moments survive the redistribution EXACTLY (pure data movement).
+    old_leafwise, old_scalars, _ = zmod.unshard_moments(zs, old_rt)
+    new_leafwise, new_scalars, _ = zmod.unshard_moments(zs2, new_rt)
+    for j in range(len(old_leafwise)):
+        if old_scalars[j] is not None:
+            assert np.asarray(new_scalars[j]) == np.asarray(old_scalars[j])
+            continue
+        for i in range(len(old_leafwise[j])):
+            np.testing.assert_array_equal(old_leafwise[j][i],
+                                          new_leafwise[j][i])
+    # ...and training continues on the new cohort.
+    new_step = hvd_jax.make_train_step(_loss_fn, new_opt, mesh=_mesh(2),
+                                       donate=False)
+    pp2 = jax.device_put(pp, NamedSharding(_mesh(2), P()))
+    pp2, zs2, loss = new_step(pp2, zs2, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_reshard_zeroes_error_feedback_residuals(hvd):
+    params, batch = _params(), _batch(2)
+    q = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True,
+                                     compression=hvd_mod.Compression.int8)
+    qstep = hvd_jax.make_train_step(_loss_fn, q, mesh=_mesh(2),
+                                    donate=False)
+    qs = q.init(params)
+    pp = params
+    for _ in range(2):
+        pp, qs, _ = qstep(pp, qs, batch)
+    assert any(float(jnp.abs(r).max()) > 0 for r in qs[1]), \
+        "EF residuals should be nonzero after quantized steps"
+    new_opt = hvd_jax.DistributedOptimizer(
+        optax.adam(1e-2), zero=True,
+        compression=hvd_mod.Compression.int8)
+    new_rt = new_opt._zero_runtime(mesh=_mesh(4), axis_name="hvd")
+    qs2 = zmod.reshard_state(qs, q._zero_rt, new_rt, pp)
+    assert all(float(jnp.abs(r).max()) == 0 for r in qs2[1])
+    assert all(float(jnp.abs(r).max()) == 0 for r in qs2[2])
+
+
+def test_step_wrapper_reshards_on_elastic_version_bump(
+        hvd, monkeypatch):
+    monkeypatch.delenv("HVDTPU_ELASTIC_VERSION", raising=False)
+    params = _params()
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    zstep = hvd_jax.make_train_step(_loss_fn, zopt, mesh=_mesh(2),
+                                    donate=False)
+    zs = zopt.init(params)
+    pp = params
+    pp, zs, _ = zstep(pp, zs, _batch(2))
+    assert zopt._zero_rt.n == 2
+    # Membership change: the next step call must reshard to the new
+    # (default-runtime) world size before running.
+    monkeypatch.setenv("HVDTPU_ELASTIC_VERSION", "7")
+    n_new = len(jax.devices())
+    # A restore-style hand-off: params come back as host arrays.
+    pp = jax.tree.map(lambda a: np.asarray(a), pp)
+    pp, zs, loss = zstep(pp, zs, _batch(n_new))
+    assert np.isfinite(float(loss))
+    assert zopt._zero_rt.n == n_new
+    assert zopt._zero_rt.version == "7"
+    vec = [l for l in jax.tree.leaves(zs[0]) if np.ndim(l) >= 1][0]
+    assert len(vec.addressable_shards) == n_new
+
+
+# ==========================================================================
+# Rejections + guardian digests
+# ==========================================================================
+
+def test_init_rejects_adasum_with_zero(hvd):
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd_jax.DistributedOptimizer(optax.sgd(0.1),
+                                     op=reduce_ops.Adasum, zero=True)
+
+
+def test_init_rejects_nonglobal_process_set_with_zero(hvd):
+    class _PS:
+        process_set_id = 7
+    with pytest.raises(ValueError, match="process set"):
+        hvd_jax.DistributedOptimizer(optax.sgd(0.1), zero=True,
+                                     process_set=_PS())
+
+
+def test_init_rejects_aggregation_with_zero(hvd):
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hvd_jax.DistributedOptimizer(optax.sgd(0.1), zero=True,
+                                     backward_passes_per_step=2)
+
+
+def test_zero_rejects_non_elementwise_optimizer_state(hvd):
+    # clip-by-norm-style transforms with per-tree state shapes cannot
+    # shard along the flat axis — loud error, not silent corruption.
+    import optax
+    inner = optax.chain(optax.adam(1e-2),
+                        optax.masked(optax.set_to_zero(),
+                                     {"a": True, "w": False, "b": False}))
+    zopt = hvd_jax.DistributedOptimizer(inner, zero=True)
+    hvd_jax.make_train_step(_loss_fn, zopt, mesh=_mesh(2))
+    with pytest.raises(Exception):
+        zopt.init(_params())
+
+
+def test_update_before_init_raises(hvd):
+    zopt = hvd_jax.DistributedOptimizer(optax.sgd(0.1), zero=True)
+    with pytest.raises(RuntimeError, match="init"):
+        zopt.update(_params(), None, _params())
+
+
+def test_leg_digests_carry_shard_geometry(hvd):
+    zopt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    rt = zopt._zero_runtime(mesh=_mesh(4), axis_name="hvd")
+    rt.ensure_plan(_params())
+    digests = rt.leg_digests(rank=2)
+    assert set(digests) == {"zero_reduce_scatter", "zero_allgather"}
+    for leg, d in digests.items():
+        assert d["kind"] == leg
+        assert d["shard_index"] == 2
+        (s,) = rt.plan.shards
+        assert d["shard_shape"] == [[s.shard_len]]
+        assert d["shapes"] == [[s.padded]]
+
+
+def test_plan_mismatch_fails_fast_naming_field(hvd, monkeypatch):
+    board = guardian.InProcBoard("zero-test")
+    params = _params()
+    # rank 1 derives a DIFFERENT plan (divergent bucket budget).
+    opt1 = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    rt1 = opt1._zero_runtime(mesh=_mesh(2), axis_name="hvd")
+    rt1.bucket_bytes = 64
+    rt1.ensure_plan(params)
+    rt1.verify_plan_consistency(board=board, rank=1, size=2,
+                                timeout_s=0.1)
+    opt0 = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+    rt0 = opt0._zero_runtime(mesh=_mesh(2), axis_name="hvd")
+    rt0.ensure_plan(params)
+    with pytest.raises(CollectiveMismatchError) as ei:
+        rt0.verify_plan_consistency(board=board, rank=0, size=2,
+                                    timeout_s=0.1)
+    msg = str(ei.value)
+    assert "rank 1" in msg
+    assert "shard_shape" in msg or "shapes" in msg
+
+
+def test_plan_consistent_ranks_verify_clean(hvd):
+    board = guardian.InProcBoard("zero-clean")
+    params = _params()
+    rts = []
+    for rank in (0, 1):
+        opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2), zero=True)
+        rt = opt._zero_runtime(mesh=_mesh(2), axis_name="hvd")
+        rt.ensure_plan(params)
+        rts.append(rt)
+    rts[1].verify_plan_consistency(board=board, rank=1, size=2,
+                                   timeout_s=0.1)
+    rts[0].verify_plan_consistency(board=board, rank=0, size=2,
+                                   timeout_s=0.1)  # no raise
+
+
+def test_entry_digest_shard_fields_for_scatter_kinds(hvd):
+    from horovod_tpu.coordinator import TensorEntry
+
+    class _PS:
+        process_set_id = 0
+        ranks = [0, 1]
+
+        @staticmethod
+        def rank():
+            return 1
+
+    e = TensorEntry("rs", "reducescatter",
+                    [np.ones((2, 6, 3), np.float32)], _PS(),
+                    op=reduce_ops.Sum)
+    d = guardian.entry_digest(e)
+    assert d["shard_index"] == 1
+    assert d["shard_shape"] == [[3, 3]]
+    # allreduce entries keep None — no behavior change.
+    e2 = TensorEntry("ar", "allreduce", [np.ones((4,), np.float32)],
+                     _PS(), op=reduce_ops.Sum)
+    d2 = guardian.entry_digest(e2)
+    assert d2["shard_index"] is None and d2["shard_shape"] is None
+    # a peer claiming the wrong shard index is named precisely.
+    wrong = dict(d, shard_index=0)
+    divs = guardian.compare_digests(d, {1: wrong})
+    assert divs == [(1, "shard_index", 0, 1)]
+
+
+def test_entry_digest_skips_shard_fields_for_sub_cohorts(hvd):
+    # process_set.rank() is set-relative but verify() keys peers by
+    # GLOBAL rank — stamping the relative index would false-abort
+    # healthy sub-cohort collectives, so non-global sets carry None.
+    from horovod_tpu.coordinator import TensorEntry
+
+    class _SubPS:
+        process_set_id = 3
+        ranks = [2, 3]
+
+        @staticmethod
+        def rank():
+            return 0  # global rank 2's index WITHIN the set
+
+    e = TensorEntry("rs", "reducescatter",
+                    [np.ones((2, 6, 3), np.float32)], _SubPS(),
+                    op=reduce_ops.Sum)
+    d = guardian.entry_digest(e)
+    assert d["shard_index"] is None and d["shard_shape"] is None
+
+
+# ==========================================================================
+# Disabled-mode guard
+# ==========================================================================
+
+def test_zero_off_does_zero_new_work(hvd, monkeypatch):
+    monkeypatch.delenv("HVDTPU_ZERO", raising=False)
+
+    def _boom(*a, **k):
+        raise AssertionError("zero plane engaged with the knob off")
+
+    monkeypatch.setattr(zmod, "ZeroRuntime", _boom)
+    monkeypatch.setattr(zmod, "plan_zero", _boom)
+    monkeypatch.setattr(zmod, "reshard_state", _boom)
+    params, batch = _params(), _batch(2)
+    opt = hvd_jax.DistributedOptimizer(optax.adam(1e-2))
+    assert not opt.zero
+    step = hvd_jax.make_train_step(_loss_fn, opt, mesh=_mesh(2),
+                                   donate=False)
+    s = opt.init(params)
+    pp, s, loss = step(params, s, batch)
+    assert np.isfinite(float(loss))
+
+
+# ==========================================================================
+# Knob registry
+# ==========================================================================
+
+def test_zero_knobs_registered():
+    assert "ZERO" in envparse.KNOBS
+    assert "ZERO_BUCKET_BYTES" in envparse.KNOBS
+    assert envparse.KNOBS["ZERO"]["default"] == "0"
