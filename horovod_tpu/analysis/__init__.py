@@ -11,9 +11,16 @@ Three layers, one finding type (:class:`Diagnostic`):
    scripts for rank-guarded collectives, missing initial broadcasts, and
    auto-named collectives under rank-dependent control flow. The
    ``hvd-lint`` CLI (analysis/cli.py) fronts this layer.
-3. **runtime order guard** (:class:`SubmissionOrderGuard`) — the opt-in
+3. **interprocedural schedule verifier** (:func:`verify_paths` /
+   :func:`verify_source` / :func:`extract_schedule`) — ``hvd-lint
+   verify``: call graph + rank-dependence taint lattice + symbolic
+   per-rank collective schedules, behind the HVD4xx rule family
+   (analysis/schedule.py). SARIF 2.1.0 output (analysis/sarif.py) and
+   the content-hash baseline workflow (analysis/baseline.py) ride on
+   the same Diagnostic stream.
+4. **runtime order guard** (:class:`SubmissionOrderGuard`) — the opt-in
    ``HOROVOD_TPU_ORDER_CHECK=1`` dynamic backstop in the coordinator.
-4. **runtime concurrency sanitizer** (``sanitizer``) — the opt-in
+5. **runtime concurrency sanitizer** (``sanitizer``) — the opt-in
    ``HVDTPU_SANITIZE=1`` lock-order/liveness instrumentation behind the
    HVD3xx thread-safety rules (``hvd-lint --self`` runs the static
    side over this package itself).
@@ -26,7 +33,15 @@ from .diagnostics import (  # noqa: F401
 )
 from .jaxpr_lint import check_fn, check_jaxpr  # noqa: F401
 from .ast_lint import (  # noqa: F401
-    lint_source, lint_file, lint_paths, iter_python_files,
+    AliasResolver, lint_source, lint_file, lint_paths,
+    iter_python_files,
+)
+from .schedule import (  # noqa: F401
+    extract_schedule, verify_paths, verify_source,
+)
+from .sarif import to_sarif  # noqa: F401
+from .baseline import (  # noqa: F401
+    filter_new, load_baseline, write_baseline,
 )
 from .order_guard import SubmissionOrderGuard  # noqa: F401
 from . import sanitizer  # noqa: F401

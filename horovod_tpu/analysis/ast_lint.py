@@ -204,25 +204,42 @@ def _scan_statements(stmts):
         stack.extend(ast.iter_child_nodes(node))
 
 
-class _Analyzer(ast.NodeVisitor):
-    def __init__(self, filename):
-        self.filename = filename
-        self.diags = []
+# Names importable directly from the package that are MODULES, not
+# functions: `from horovod_tpu import basics` binds a module alias, so
+# `basics.allreduce(...)` must resolve like `hvd.allreduce(...)`, not
+# like a bare imported function.
+_HVD_SUBMODULES = frozenset({
+    "basics", "jax", "torch", "tensorflow", "keras", "elastic",
+    "checkpoint", "ops", "functions", "native", "spark", "ray",
+    "runner", "compression", "tracing", "telemetry", "chaos",
+    "guardian", "analysis", "process_sets", "autotune", "coordinator",
+    "backend", "utils", "models", "callbacks", "mpi_ops",
+})
+
+
+class AliasResolver:
+    """Import-alias bookkeeping shared by every AST rule layer.
+
+    Every spelling of a collective call — ``hvd.allreduce(...)``,
+    ``from horovod_tpu import allreduce``, ``basics.allreduce(...)``,
+    ``from horovod_tpu.basics import allreduce as ar`` — resolves here,
+    in exactly one place, for the HVD2xx single-hop rules and the
+    interprocedural schedule extractor (analysis/schedule.py) alike.
+    Feed it every Import/ImportFrom node, then classify calls with
+    :meth:`is_collective` / :meth:`is_rank_call` /
+    :meth:`is_checkpoint_call` / :meth:`collective_kind`.
+    """
+
+    def __init__(self):
         self.hvd_aliases = set()    # names bound to horovod_tpu modules
         self.hvd_names = set()      # functions imported from horovod_tpu
         self.ckpt_aliases = set()   # names bound to horovod_tpu.checkpoint
         self.ckpt_names = set()     # functions imported from .checkpoint
         self.lax_aliases = {"lax"}  # `jax.lax` / `from jax import lax`
-        self.has_init = False
-        self.dist_opt_node = None
-        self.has_broadcast = False
         self.uses_elastic = False
-        self.int_names = set()      # names assigned integer-looking values
-        self.zero_env_set = False   # script set HVDTPU_ZERO-family env
-        self._flagged = set()       # id(call) already reported
 
     # -- imports -----------------------------------------------------------
-    def visit_Import(self, node):
+    def visit_import(self, node):
         for alias in node.names:
             target = alias.asname or alias.name.split(".")[0]
             if alias.name.split(".")[0] in ("horovod_tpu", "horovod"):
@@ -235,9 +252,8 @@ class _Analyzer(ast.NodeVisitor):
                     self.ckpt_aliases.add(alias.asname)
             if alias.name in ("jax.lax",):
                 self.lax_aliases.add(target)
-        self.generic_visit(node)
 
-    def visit_ImportFrom(self, node):
+    def visit_import_from(self, node):
         mod = node.module or ""
         if mod.split(".")[0] in ("horovod_tpu", "horovod"):
             if "elastic" in mod:
@@ -259,16 +275,19 @@ class _Analyzer(ast.NodeVisitor):
                 elif alias.name == "*":
                     self.hvd_names |= (COLLECTIVE_CALLS | RANK_CALLS
                                        | DIST_OPT_CALLS | {"init"})
+                elif alias.name in _HVD_SUBMODULES:
+                    # `from horovod_tpu import basics` — a MODULE alias:
+                    # `basics.allreduce(...)` resolves through it.
+                    self.hvd_aliases.add(name)
                 else:
                     self.hvd_names.add(name)
         if mod == "jax":
             for alias in node.names:
                 if alias.name == "lax":
                     self.lax_aliases.add(alias.asname or "lax")
-        self.generic_visit(node)
 
     # -- call classification ----------------------------------------------
-    def _is_hvd_call(self, call, names):
+    def is_hvd_call(self, call, names):
         term = _terminal_name(call.func)
         if term not in names:
             return False
@@ -281,21 +300,21 @@ class _Analyzer(ast.NodeVisitor):
         root = _root_name(call.func)
         return root in self.hvd_aliases
 
-    def _is_collective(self, call):
+    def is_collective(self, call):
         term = _terminal_name(call.func)
         if term in LAX_COLLECTIVE_CALLS:
             root = _root_name(call.func)
             return root in self.lax_aliases or root == "jax"
-        return self._is_hvd_call(call, COLLECTIVE_CALLS)
+        return self.is_hvd_call(call, COLLECTIVE_CALLS)
 
-    def _is_rank_call(self, call):
+    def is_rank_call(self, call):
         term = _terminal_name(call.func)
         if term == "axis_index":
             root = _root_name(call.func)
             return root in self.lax_aliases or root == "jax"
-        return self._is_hvd_call(call, RANK_CALLS)
+        return self.is_hvd_call(call, RANK_CALLS)
 
-    def _is_checkpoint_call(self, call):
+    def is_checkpoint_call(self, call):
         term = _terminal_name(call.func)
         if term not in CHECKPOINT_CALLS:
             return False
@@ -314,6 +333,47 @@ class _Analyzer(ast.NodeVisitor):
                 node = node.value
             return "checkpoint" in chain[1:]
         return False
+
+    def collective_kind(self, call):
+        """Terminal collective name (``allreduce``, ``psum``, ...) when
+        ``call`` is a collective, else None."""
+        return _terminal_name(call.func) if self.is_collective(call) \
+            else None
+
+
+class _Analyzer(ast.NodeVisitor):
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        self.res = AliasResolver()  # shared import-alias bookkeeping
+        self.has_init = False
+        self.dist_opt_node = None
+        self.has_broadcast = False
+        self.int_names = set()      # names assigned integer-looking values
+        self.zero_env_set = False   # script set HVDTPU_ZERO-family env
+        self._flagged = set()       # id(call) already reported
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node):
+        self.res.visit_import(node)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        self.res.visit_import_from(node)
+        self.generic_visit(node)
+
+    # -- call classification (delegated to the shared resolver) ------------
+    def _is_hvd_call(self, call, names):
+        return self.res.is_hvd_call(call, names)
+
+    def _is_collective(self, call):
+        return self.res.is_collective(call)
+
+    def _is_rank_call(self, call):
+        return self.res.is_rank_call(call)
+
+    def _is_checkpoint_call(self, call):
+        return self.res.is_checkpoint_call(call)
 
     def _is_rank_dependent(self, expr):
         return any(isinstance(n, ast.Call) and self._is_rank_call(n)
@@ -426,6 +486,14 @@ class _Analyzer(ast.NodeVisitor):
                  + _DOC_HINT))
 
     @staticmethod
+    def _is_adasum_call(call):
+        """op=...Adasum — per-tensor reduction IS Adasum's semantics
+        (bucketing it would change the math: rule HVD405), so HVD206's
+        use-the-grouped-API advice must not fire."""
+        return any(kw.arg == "op" and _terminal_name(kw.value) == "Adasum"
+                   for kw in call.keywords)
+
+    @staticmethod
     def _tensor_is_loop_var(expr, names):
         """True when the reduced tensor IS the loop variable or a
         subscript/attribute/arithmetic view of it. Values that reach
@@ -456,6 +524,7 @@ class _Analyzer(ast.NodeVisitor):
                         and id(sub) not in self._flagged
                         and self._is_hvd_call(
                             sub, PER_TENSOR_ALLREDUCE_CALLS)
+                        and not self._is_adasum_call(sub)
                         and sub.args
                         and self._tensor_is_loop_var(sub.args[0], names)):
                     self._report_206(sub)
@@ -478,6 +547,7 @@ class _Analyzer(ast.NodeVisitor):
                         and id(sub) not in self._flagged
                         and self._is_hvd_call(
                             sub, PER_TENSOR_ALLREDUCE_CALLS)
+                        and not self._is_adasum_call(sub)
                         and sub.args
                         and self._tensor_is_loop_var(sub.args[0], names)):
                     self._report_206(sub)
@@ -664,8 +734,9 @@ class _Analyzer(ast.NodeVisitor):
     def visit_Attribute(self, node):
         if node.attr in _SYNC_MARKERS:
             self.has_broadcast = True
-        elif node.attr == "elastic" and _root_name(node) in self.hvd_aliases:
-            self.uses_elastic = True
+        elif (node.attr == "elastic"
+                and _root_name(node) in self.res.hvd_aliases):
+            self.res.uses_elastic = True
         self.generic_visit(node)
 
     def visit_Name(self, node):
@@ -675,7 +746,7 @@ class _Analyzer(ast.NodeVisitor):
 
     def finish(self):
         if (self.has_init and self.dist_opt_node is not None
-                and not self.has_broadcast and not self.uses_elastic):
+                and not self.has_broadcast and not self.res.uses_elastic):
             self.diags.append(Diagnostic.make(
                 "HVD202",
                 "script calls init() and builds a DistributedOptimizer "

@@ -4,21 +4,42 @@ Runs the AST layer over scripts/directories and prints structured
 findings with ``file:line`` + fix hints:
 
     hvd-lint train.py examples/
+    hvd-lint verify train.py             # + interprocedural HVD4xx
     hvd-lint --format json --fail-on warning src/
+    hvd-lint --format sarif src/ > lint.sarif
+    hvd-lint --write-baseline lint-baseline.json src/
+    hvd-lint --baseline lint-baseline.json src/   # fail on NEW only
     hvd-lint --self                 # sweep horovod_tpu/ itself (CI)
     hvd-lint --check-knobs          # knob registry vs docs/knobs.md
     hvd-lint --list-rules
 
-``--self`` is the hvd-sanitize self-analysis: every rule (collective
-HVD2xx + concurrency HVD3xx) over the installed ``horovod_tpu``
-package, plus the knob-docs cross-check (HVD306) when the repo's
-docs/knobs.md is present, failing on warnings — the framework must
-hold itself to the rules it enforces on user scripts.
+``verify`` is the interprocedural mode (analysis/schedule.py): on top
+of the single-hop rules it builds a call graph over each script plus
+the ``horovod_tpu`` modules it imports, propagates a rank-dependence
+taint lattice, extracts the symbolic per-rank collective schedule, and
+applies the HVD4xx family (rank-tainted reachability at any call
+depth, divergent loop bounds, early exits skipping collectives,
+cross-process-set interleavings, Adasum through bucketing paths).
 
-Exit codes: 0 no findings at/above ``--fail-on``; 1 findings; 2 usage
-or internal error. The jaxpr layer needs traced inputs, so it is an API
-(``horovod_tpu.analysis.check_fn``) and a bridge flag (``verify=``)
-rather than a CLI mode — see docs/lint.md.
+``--self`` is the hvd-sanitize self-analysis: every rule — collective
+HVD2xx + concurrency HVD3xx + the interprocedural HVD4xx — over the
+installed ``horovod_tpu`` package, plus the knob-docs cross-check
+(HVD306) when the repo's docs/knobs.md is present, failing on
+warnings — the framework must hold itself to the rules it enforces on
+user scripts.
+
+Baselines (analysis/baseline.py): ``--write-baseline FILE`` records
+current findings keyed by rule x file x content-hash;
+``--baseline FILE`` (default: the ``HVDTPU_LINT_BASELINE`` knob) then
+fails only on findings NOT in the record — the supported way to turn
+a new warning-strength rule on in CI without fixing the world first.
+SARIF output (analysis/sarif.py) marks baseline-suppressed results
+with ``suppressions`` instead of dropping them.
+
+Exit codes: 0 no NEW findings at/above ``--fail-on``; 1 findings; 2
+usage or internal error. The jaxpr layer needs traced inputs, so it is
+an API (``horovod_tpu.analysis.check_fn``) and a bridge flag
+(``verify=``) rather than a CLI mode — see docs/lint.md.
 """
 
 import argparse
@@ -26,8 +47,8 @@ import json
 import os
 import sys
 
-from . import ast_lint
-from .diagnostics import ERROR, RULES
+from . import ast_lint, baseline as baseline_mod, schedule, sarif
+from .diagnostics import ERROR, RULES, dedupe, Diagnostic
 
 
 def _package_dir():
@@ -47,11 +68,13 @@ def _build_parser():
         prog="hvd-lint",
         description="Static collective-correctness and concurrency "
                     "linter for horovod_tpu training scripts (and, "
-                    "via --self, for horovod_tpu itself).")
+                    "via --self, for horovod_tpu itself). Prepend the "
+                    "`verify` subcommand for the interprocedural "
+                    "schedule verifier (HVD4xx).")
     parser.add_argument("paths", nargs="*", default=[],
                         help="python files or directories (default: . "
                              "unless only --check-knobs is requested)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--rules", default="",
                         help="comma-separated rule ids to enable "
@@ -62,9 +85,10 @@ def _build_parser():
                              "(default: error; --self implies warning)")
     parser.add_argument("--self", dest="self_sweep", action="store_true",
                         help="sweep the horovod_tpu package itself with "
-                             "every rule + the knob-docs cross-check, "
-                             "failing on warnings (the hvd-sanitize "
-                             "self-analysis)")
+                             "every rule (incl. the interprocedural "
+                             "HVD4xx family) + the knob-docs "
+                             "cross-check, failing on warnings (the "
+                             "hvd-sanitize self-analysis)")
     parser.add_argument("--check-knobs", action="store_true",
                         help="cross-check the envparse knob registry "
                              "against docs/knobs.md (HVD306); with no "
@@ -72,12 +96,43 @@ def _build_parser():
     parser.add_argument("--knobs-md", default="", metavar="PATH",
                         help="knob docs to cross-check against "
                              "(default: the repo's docs/knobs.md)")
+    parser.add_argument("--baseline", default="", metavar="FILE",
+                        help="fail only on findings NOT recorded in "
+                             "FILE (default: the HVDTPU_LINT_BASELINE "
+                             "knob); recorded ones are reported as "
+                             "suppressed")
+    parser.add_argument("--write-baseline", default="", metavar="FILE",
+                        help="record the current findings as the "
+                             "accepted baseline and exit 0")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     return parser
 
 
+def _collect(paths, verify):
+    diags = ast_lint.lint_paths(paths)
+    if verify:
+        diags.extend(schedule.verify_paths(paths))
+    return dedupe(sorted(diags, key=Diagnostic.sort_key))
+
+
+def _baseline_path(args):
+    if args.baseline:
+        return args.baseline, True
+    from ..utils import envparse
+    path = envparse.get_str(envparse.LINT_BASELINE)
+    # the env-default baseline is best-effort: a job exported the knob
+    # but the file is gone -> run unfiltered rather than die in CI
+    return (path, False) if path else (None, False)
+
+
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    argv = list(argv)
+    verify = bool(argv) and argv[0] == "verify"
+    if verify:
+        argv = argv[1:]
     parser = _build_parser()
     args = parser.parse_args(argv)
 
@@ -94,6 +149,7 @@ def main(argv=None):
     paths = list(args.paths)
     if args.self_sweep:
         paths = [_package_dir()]
+        verify = True
         if fail_on == "error":
             fail_on = "warning"
     elif not paths and not check_knobs:
@@ -103,7 +159,7 @@ def main(argv=None):
     diags = []
     try:
         if paths:
-            diags = ast_lint.lint_paths(paths)
+            diags = _collect(paths, verify)
     except OSError as exc:
         print(f"hvd-lint: {exc}", file=sys.stderr)
         return 2
@@ -128,14 +184,45 @@ def main(argv=None):
         diags = [d for d in diags if d.rule in only]
     diags.sort(key=lambda d: d.sort_key())
 
+    if args.write_baseline:
+        try:
+            baseline_mod.write_baseline(diags, args.write_baseline)
+        except OSError as exc:
+            print(f"hvd-lint: cannot write baseline: {exc}",
+                  file=sys.stderr)
+            return 2
+        print(f"hvd-lint: baseline recorded ({len(diags)} finding(s) "
+              f"-> {args.write_baseline})")
+        return 0
+
+    suppressed = []
+    base_path, explicit = _baseline_path(args)
+    if base_path:
+        try:
+            doc = baseline_mod.load_baseline(base_path)
+        except (OSError, ValueError) as exc:
+            if explicit:
+                print(f"hvd-lint: cannot read baseline: {exc}",
+                      file=sys.stderr)
+                return 2
+            doc = None
+        if doc is not None:
+            diags, suppressed = baseline_mod.filter_new(diags, doc)
+
     if args.format == "json":
         print(json.dumps([d.to_dict() for d in diags], indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(sarif.to_sarif(diags, suppressed=suppressed),
+                         indent=1, sort_keys=True))
     else:
         for d in diags:
             print(d.format())
         errors = sum(d.severity == ERROR for d in diags)
+        tail = (f", {len(suppressed)} baseline-suppressed"
+                if suppressed else "")
         print(f"hvd-lint: {len(diags)} finding(s) "
-              f"({errors} error(s), {len(diags) - errors} warning(s))")
+              f"({errors} error(s), {len(diags) - errors} warning(s)"
+              f"{tail})")
 
     if fail_on == "never":
         return 0

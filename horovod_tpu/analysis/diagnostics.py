@@ -7,6 +7,7 @@ and severities (full prose catalog: docs/lint.md).
 """
 
 import dataclasses
+import os
 
 ERROR = "error"
 WARNING = "warning"
@@ -46,6 +47,24 @@ RULES = {
                       "reduce-scatter; a sub-cohort derives a wrong "
                       "shard plan — DistributedOptimizer rejects both "
                       "at __init__)"),
+    # -- interprocedural schedule verifier (hvd-lint verify) ---------------
+    "HVD401": (ERROR, "collective reachable under rank-tainted control "
+                      "flow through any call depth (the whole-program "
+                      "generalization of HVD102/HVD201)"),
+    "HVD402": (ERROR, "loop containing a collective whose trip count is "
+                      "rank-tainted or data-dependent (schedule-length "
+                      "divergence: ranks submit different collective "
+                      "counts and the job stalls)"),
+    "HVD403": (ERROR, "early return/raise/continue under a rank-tainted "
+                      "condition skips a collective other ranks "
+                      "execute"),
+    "HVD404": (ERROR, "collectives on distinct process sets interleaved "
+                      "where relative order can differ per rank "
+                      "(deadlock by cross-set wait cycle)"),
+    "HVD405": (ERROR, "per-tensor-semantics reduction (Adasum) routed "
+                      "through a bucketing/concatenating path (its "
+                      "scale-invariant combination is defined per whole "
+                      "tensor; bucketing silently changes the math)"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "
@@ -99,6 +118,20 @@ class Diagnostic:
     def sort_key(self):
         return (self.file, self.line, _SEV_ORDER.get(self.severity, 9),
                 self.rule)
+
+
+def relative_to_cwd(path, posix=False):
+    """``path`` relative to cwd when it sits under it (stable across
+    checkouts — what baseline keys, SARIF uris, and rendered locations
+    all want to agree on), unchanged otherwise. ``posix=True`` forces
+    forward slashes for serialized forms."""
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:
+        rel = path
+    if not rel.startswith(".."):
+        path = rel
+    return path.replace(os.sep, "/") if posix else path
 
 
 def dedupe(diags):

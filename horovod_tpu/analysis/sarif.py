@@ -1,0 +1,105 @@
+"""SARIF 2.1.0 emitter for hvd-lint findings (``--format sarif``).
+
+One run object, one driver (``hvd-lint``), rule metadata pulled from
+the shared catalog (diagnostics.RULES) for every rule that appears in
+the output, one result per finding with a physical location and the
+content-addressed baseline key as a partial fingerprint. Findings
+suppressed by a ``--baseline`` file are still emitted — with a
+``suppressions`` entry of kind ``external`` — so CI code-scanning UIs
+show them as suppressed instead of silently losing them (that is the
+SARIF-blessed way to ship warning-strength rules without a flag-day).
+
+Spec: SARIF 2.1.0 (OASIS). The emitted document restricts itself to
+required properties plus the widely-consumed optional ones
+(``rules``, ``partialFingerprints``, ``suppressions``), so it loads in
+GitHub code scanning and the VS Code SARIF viewer.
+"""
+
+from .baseline import finding_keys
+from .diagnostics import ERROR, RULES, relative_to_cwd
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+_INFO_URI = "https://example.invalid/horovod_tpu/docs/lint.md"
+
+
+def _tool_version():
+    try:
+        from .. import __version__
+        return str(__version__)
+    except Exception:  # noqa: BLE001 — metadata only
+        return "0.0.0"
+
+
+def _level(severity):
+    # SARIF level vocabulary: "error" | "warning" | "note" | "none"
+    return "error" if severity == ERROR else "warning"
+
+
+def _uri(path):
+    """Relative forward-slash URI when the file sits under cwd (stable
+    across checkouts — what baselines and CI artifacts want), the
+    original path otherwise."""
+    return relative_to_cwd(path, posix=True)
+
+
+def to_sarif(diags, suppressed=()):
+    """Build the SARIF 2.1.0 document for ``diags`` (new findings) plus
+    ``suppressed`` (baseline-suppressed findings, emitted with a
+    ``suppressions`` entry). Returns a plain dict — ``json.dump`` it."""
+    diags = list(diags)
+    suppressed = list(suppressed)
+    every = diags + suppressed
+    rule_ids = sorted({d.rule for d in every})
+    rule_index = {rule: i for i, rule in enumerate(rule_ids)}
+    rules = []
+    for rule in rule_ids:
+        severity, title = RULES.get(rule, (ERROR, rule))
+        rules.append({
+            "id": rule,
+            "name": rule,
+            "shortDescription": {"text": title or rule},
+            "helpUri": _INFO_URI,
+            "defaultConfiguration": {"level": _level(severity)},
+        })
+    keys = finding_keys(every)
+    results = []
+    for d, key in zip(every, keys):
+        message = d.message + (f" (hint: {d.hint})" if d.hint else "")
+        result = {
+            "ruleId": d.rule,
+            "ruleIndex": rule_index[d.rule],
+            "level": _level(d.severity),
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(d.file)},
+                    "region": {"startLine": max(1, int(d.line or 0))},
+                },
+            }],
+            "partialFingerprints": {"hvdLintKey/v1": key},
+        }
+        if len(results) >= len(diags):
+            result["suppressions"] = [{
+                "kind": "external",
+                "justification": "recorded in the hvd-lint baseline "
+                                 "(--baseline); fails only when new "
+                                 "findings appear",
+            }]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "hvd-lint",
+                    "informationUri": _INFO_URI,
+                    "version": _tool_version(),
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }

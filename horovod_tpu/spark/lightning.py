@@ -200,6 +200,11 @@ def fit_on_parquet_lightning(store_prefix, run_id, module_bytes,
                         vl_sum += float(vloss) * rows
                         vl_n += rows
                 module.train()
+                # `val_batch is not None` is replica-invariant: decided
+                # by the `validation` argument (same on every rank),
+                # with val_rows = max(1, ...) guaranteeing a non-None
+                # val_batch on EVERY rank whenever validation is set.
+                # hvd-lint: disable=HVD401
                 history["val_loss"].append(float(hvd.allreduce(
                     torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
             if hasattr(module, "on_train_epoch_end"):

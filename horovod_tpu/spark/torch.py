@@ -195,6 +195,12 @@ def fit_on_parquet_torch(store_prefix, run_id, model_bytes, opt_spec,
                         vl_sum += float(loss_fn(model(vx), vy)) * rows
                         vl_n += rows
                 model.train()
+                # `val_batch is not None` is replica-invariant: it is
+                # decided by the `validation` argument (same on every
+                # rank) — val_rows = max(1, ...) guarantees a non-None
+                # val_batch on EVERY rank whenever validation is set,
+                # even for ranks whose shard taint suggests otherwise.
+                # hvd-lint: disable=HVD401
                 history["val_loss"].append(float(hvd.allreduce(
                     torch.tensor([vl_sum / vl_n]), name=f"ep{epoch}.vloss")))
             if verbose and rank == 0:

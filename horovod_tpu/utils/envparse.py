@@ -148,6 +148,11 @@ SANITIZE = register(
     "hvd-sanitize runtime layer: lock-order deadlock detection, "
     "blocking-call tripwire on collective-critical threads, shutdown "
     "thread-leak audit (analysis/sanitizer.py)")
+LINT_BASELINE = register(
+    "LINT_BASELINE", "",
+    "Default --baseline file for hvd-lint: runs fail only on findings "
+    "not recorded there (analysis/baseline.py; keys are rule x file x "
+    "content-hash, so rebases don't resurface accepted findings)")
 
 # -- autotune ---------------------------------------------------------------
 AUTOTUNE = register(
@@ -173,6 +178,9 @@ METRICS = register(
 METRICS_PUSH_INTERVAL = register(
     "METRICS_PUSH_INTERVAL", "5",
     "Seconds between per-rank snapshot pushes to the driver KV store")
+METRICS_SNAPSHOT = register(
+    "METRICS_SNAPSHOT", "BENCH_metrics.json",
+    "Path where bench.py archives the run's telemetry snapshot")
 METRICS_DUMP = register(
     "METRICS_DUMP", "", "Final JSON snapshot path written at shutdown")
 

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# CI lint gate (tier-1: tests/test_lint.py::test_ci_lint_script).
+#
+# Three legs, all of which must hold or the gate fails:
+#   1. self-analysis  — hvd-lint --self --check-knobs: every rule
+#      (HVD2xx + HVD3xx + the interprocedural HVD4xx) over horovod_tpu/
+#      itself plus the knob-registry/docs cross-check, failing on
+#      warnings.
+#   2. dogfood sweep  — hvd-lint verify over examples/ and bench.py,
+#      failing on warnings: the shipped entry points stay clean.
+#   3. canary corpus  — the fixture corpus must still TRIP every rule
+#      family (a gate that stopped seeing its fixtures has rotted), and
+#      its findings are emitted as lint.sarif (SARIF 2.1.0) for the CI
+#      artifact/code-scanning upload.
+#
+# Env: LINT_SARIF_OUT overrides the artifact path (default: lint.sarif
+# in the repo root). HVDTPU_LINT_BASELINE is honored by hvd-lint itself
+# (see docs/lint.md "Baselines").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+sarif_out="${LINT_SARIF_OUT:-lint.sarif}"
+python="${PYTHON:-python3}"
+command -v "${python}" >/dev/null 2>&1 || python=python
+run_lint() { "${python}" -m horovod_tpu.analysis.cli "$@"; }
+
+echo "== hvd-lint: self-analysis (HVD2xx/3xx/4xx + knob docs) =="
+run_lint --self --check-knobs
+
+echo "== hvd-lint verify: examples/ + bench.py (fail on warnings) =="
+run_lint verify examples bench.py --fail-on warning
+
+echo "== hvd-lint verify: fixture corpus -> ${sarif_out} =="
+# --fail-on never: the corpus is SUPPOSED to be full of findings; the
+# canary below asserts they are all still being caught.
+run_lint verify tests/lint_fixtures --format sarif --fail-on never \
+    > "${sarif_out}"
+
+"${python}" - "${sarif_out}" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["version"] == "2.1.0", doc["version"]
+results = doc["runs"][0]["results"]
+rules = {r["ruleId"] for r in results}
+families = {rule[:4] for rule in rules if rule.startswith("HVD")}
+missing = {"HVD2", "HVD3", "HVD4"} - families
+assert not missing, f"fixture corpus no longer trips {sorted(missing)}xx"
+for tag in ("HVD401", "HVD402", "HVD403", "HVD404", "HVD405"):
+    assert tag in rules, f"fixture corpus no longer trips {tag}"
+print(f"canary ok: {len(results)} finding(s), "
+      f"{len(rules)} rule(s), families {sorted(families)}")
+EOF
+
+echo "ci_lint: all gates green (artifact: ${sarif_out})"

@@ -1,0 +1,52 @@
+"""Lint fixture (never executed): Adasum — whose scale-invariant
+combination is defined per WHOLE tensor — routed through bucketing or
+concatenating paths that silently change its math.
+
+Expected findings (hvd-lint verify): HVD405 x3 —
+- grouped_allreduce with op=Adasum,
+- allreduce of a concatenated payload with op=Adasum,
+- Adasum passed as an argument into a helper that feeds a grouped
+  collective.
+"""
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+def grouped_adasum(grads):
+    return hvd.grouped_allreduce(grads, op=hvd.Adasum)  # HVD405
+
+
+def concatenated_adasum(grads):
+    flat = jnp.concatenate([g.ravel() for g in grads])
+    return hvd.allreduce(flat, op=hvd.Adasum, name="bucket")  # HVD405
+
+
+def bucketed_reduce(tensors, op):
+    return hvd.grouped_allreduce(tensors, op=op)
+
+
+def adasum_through_helper(grads):
+    return bucketed_reduce(grads, hvd.Adasum)  # HVD405 (op threads in)
+
+
+# -- negatives -------------------------------------------------------------
+def grouped_average_is_clean(grads):
+    return hvd.grouped_allreduce(grads, op=hvd.Average)
+
+
+def per_tensor_adasum_is_clean(grads):
+    # One whole tensor per call IS Adasum's semantics — clean.
+    return [hvd.allreduce(g, op=hvd.Adasum, name=f"adasum.{i}")
+            for i, g in enumerate(grads)]
+
+
+def average_through_helper_is_clean(grads):
+    return bucketed_reduce(grads, hvd.Average)
+
+
+def suppressed_with_rationale(grads):
+    # fixture: single-tensor group — bucketing is a no-op here
+    # hvd-lint: disable=HVD405
+    return hvd.grouped_allreduce(grads, op=hvd.Adasum)

@@ -1,0 +1,69 @@
+"""Lint fixture (never executed): collectives on distinct process sets
+interleaved so their relative order can differ per rank — the
+cross-set wait cycle.
+
+Expected findings (hvd-lint verify): HVD404 x3 —
+- branches issuing [evens, odds] vs [odds, evens] (order divergence),
+- branches issuing on entirely different sets,
+- a rank-gated collective on one set racing an unconditional
+  collective on another.
+"""
+
+import horovod_tpu as hvd
+
+
+def divergent_order(x):
+    evens = hvd.add_process_set([0, 2, 4, 6])
+    odds = hvd.add_process_set([1, 3, 5, 7])
+    if hvd.rank() < 4:
+        hvd.allreduce(x, name="a", process_set=evens)  # HVD404
+        hvd.allreduce(x, name="b", process_set=odds)
+    else:
+        hvd.allreduce(x, name="b", process_set=odds)
+        hvd.allreduce(x, name="a", process_set=evens)
+
+
+def disjoint_sets_per_branch(x):
+    evens = hvd.add_process_set([0, 2, 4, 6])
+    odds = hvd.add_process_set([1, 3, 5, 7])
+    if hvd.rank() % 2 == 0:
+        hvd.allreduce(x, name="mine", process_set=evens)  # HVD404
+    else:
+        hvd.allreduce(x, name="mine", process_set=odds)
+
+
+def gated_set_races_global(x):
+    half = hvd.add_process_set([0, 1, 2, 3])
+    if hvd.rank() < 4:
+        hvd.allreduce(x, name="sub", process_set=half)  # HVD404
+    hvd.allreduce(x, name="everyone")
+
+
+# -- negatives -------------------------------------------------------------
+def same_order_both_branches(x):
+    evens = hvd.add_process_set([0, 2, 4, 6])
+    odds = hvd.add_process_set([1, 3, 5, 7])
+    if hvd.rank() < 4:
+        hvd.allreduce(x, name="a1", process_set=evens)
+        hvd.allreduce(x, name="b1", process_set=odds)
+    else:
+        hvd.allreduce(x, name="a1", process_set=evens)
+        hvd.allreduce(x, name="b1", process_set=odds)
+
+
+def member_only_collective(x):
+    # The documented sub-cohort pattern: only members of the set call
+    # its collective, guarded by the SAME set's membership — clean.
+    half = hvd.add_process_set([0, 1, 2, 3])
+    if half.included():
+        hvd.allreduce(x, name="members", process_set=half)
+
+
+def suppressed_with_rationale(x):
+    first = hvd.add_process_set([0, 1])
+    second = hvd.add_process_set([2, 3])
+    if hvd.rank() < 2:
+        # fixture: sets are disjoint AND drained by a barrier upstream
+        # hvd-lint: disable=HVD404,HVD201
+        hvd.allreduce(x, name="w1", process_set=first)
+    hvd.allreduce(x, name="w2", process_set=second)

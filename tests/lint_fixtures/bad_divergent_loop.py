@@ -1,0 +1,60 @@
+"""Lint fixture (never executed): loops whose trip counts differ per
+rank while the body submits collectives — schedule-LENGTH divergence.
+
+Expected findings (hvd-lint verify): HVD402 x3 —
+- the `for` over a rank-tainted range,
+- the `while` whose bound carries taint through a variable,
+- the convergence `while` whose condition is updated from rank-local
+  compute inside the body.
+"""
+
+import horovod_tpu as hvd
+
+
+def tainted_for_bound(x):
+    for _ in range(hvd.rank() + 1):  # HVD402: rank-tainted trip count
+        x = hvd.allgather(x, name="ragged.gather")
+    return x
+
+
+def tainted_while_bound(x):
+    limit = hvd.rank() * 2
+    steps = 0
+    while steps < limit:  # HVD402: bound tainted through `limit`
+        x = hvd.allreduce(x, name="ragged.reduce")
+        steps += 1
+    return x
+
+
+def data_dependent_convergence(x, train_step):
+    converged = False
+    while not converged:  # HVD402: each rank's loss picks its own count
+        loss = train_step(x)
+        x = hvd.allreduce(x, name="converge.grads")
+        converged = loss < 0.1
+    return x
+
+
+# -- negatives -------------------------------------------------------------
+def fixed_bound_is_clean(x):
+    for _ in range(100):
+        x = hvd.allreduce(x, name="fixed.reduce")
+    return x
+
+
+def reduced_flag_is_clean(x, train_step):
+    converged = False
+    while not converged:
+        loss = train_step(x)
+        x = hvd.allreduce(x, name="agreed.grads")
+        # the stop flag is allreduced: every rank agrees when to stop
+        converged = hvd.allreduce(loss, name="agreed.stop") < 0.1
+    return x
+
+
+def suppressed_with_rationale(x):
+    # fixture: every rank's shard is padded to the same length upstream
+    # hvd-lint: disable=HVD402
+    for _ in range(hvd.rank() + 1):
+        x = hvd.allgather(x, name="padded.gather")
+    return x

@@ -1,0 +1,55 @@
+"""Lint fixture (never executed): early exits under rank-dependent
+conditions that skip collectives the other ranks execute.
+
+Expected findings (hvd-lint verify): HVD403 x3 —
+- an early `return` on non-root ranks before an allreduce,
+- a rank-guarded `continue` skipping the in-loop collective,
+- a rank-guarded `raise` before a barrier.
+"""
+
+import horovod_tpu as hvd
+
+
+def early_return_skips(x):
+    if hvd.rank() != 0:
+        return x  # HVD403: ranks 1..n-1 never reach the allreduce
+    return hvd.allreduce(x, name="root.only.oops")
+
+
+def continue_skips_in_loop(batches, is_warmup, grads_of):
+    for batch in batches:
+        if hvd.rank() == 0 and is_warmup(batch):
+            continue  # HVD403: rank 0 skips this iteration's reduce
+        hvd.allreduce(grads_of(batch), name="per.batch")
+
+
+def raise_skips_barrier(x):
+    only_here = hvd.local_rank() == 0
+    if only_here:
+        raise RuntimeError("validation failed")  # HVD403
+    hvd.barrier()
+
+
+# -- negatives -------------------------------------------------------------
+def exit_with_no_collective_after(x):
+    if hvd.rank() != 0:
+        return None  # nothing collective follows: plain rank-local work
+    print("root summary:", x)
+    return x
+
+
+def membership_exit_is_clean(x):
+    # Non-members returning before a member-only collective is the
+    # documented sub-cohort pattern — clean.
+    half = hvd.add_process_set([0, 1, 2, 3])
+    if not half.included():
+        return x
+    return hvd.allreduce(x, name="members", process_set=half)
+
+
+def suppressed_with_rationale(x):
+    if hvd.rank() != 0:
+        # fixture: non-root ranks re-enter through the elastic driver
+        # hvd-lint: disable=HVD403
+        return x
+    return hvd.allreduce(x, name="waived.reduce")

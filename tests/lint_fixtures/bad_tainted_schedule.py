@@ -1,0 +1,64 @@
+"""Lint fixture (never executed): rank taint reaching collectives
+through data flow and call chains — shapes the one-hop HVD201 cannot
+see.
+
+Expected findings (hvd-lint verify): HVD401 x3 —
+- the allreduce under an if whose condition carries taint through a
+  variable,
+- the allreduce inside a helper called under a rank guard one call
+  away,
+- the collective guarded by a parameter the caller binds to
+  hvd.rank().
+"""
+
+import horovod_tpu as hvd
+
+
+def indirect_variable(x):
+    is_root = hvd.rank() == 0
+    if is_root:
+        hvd.allreduce(x, name="tainted.var")  # HVD401 (indirect taint)
+
+
+def sync_helper(x):
+    return hvd.allreduce(x, name="tainted.chain")  # HVD401 (call chain)
+
+
+def call_under_guard(x):
+    if hvd.rank() == 0:
+        sync_helper(x)
+
+
+def guarded_by_param(who, x):
+    if who == 0:
+        hvd.barrier()  # HVD401 (param bound to rank() at the call site)
+
+
+def taints_the_param(x):
+    guarded_by_param(hvd.rank(), x)
+
+
+# -- negatives -------------------------------------------------------------
+def balanced_branches(x):
+    # Both arms submit the collective: every rank arrives — clean.
+    if hvd.rank() == 0:
+        x = hvd.allreduce(x, name="balanced")
+    else:
+        x = hvd.allreduce(x, name="balanced")
+    return x
+
+
+def laundered_flag(x, local_count):
+    # Collective results are replica-invariant: the allreduced flag is
+    # identical on every rank, so the guard is NOT divergent — clean.
+    total = hvd.allreduce(local_count, name="launder.count")
+    if total > 0:
+        x = hvd.allreduce(x, name="launder.payload")
+    return x
+
+
+def suppressed_with_rationale(x):
+    maybe = hvd.rank() == 0
+    if maybe:
+        # fixture: pinned suppression-comment case for the HVD4xx family
+        hvd.allreduce(x, name="waived")  # hvd-lint: disable=HVD401

@@ -1,0 +1,52 @@
+"""Lint fixture (never executed): the interprocedural shapes the
+HVD4xx family must stay SILENT on — taint laundering, enumerate
+counters, membership-guarded sub-cohorts, balanced schedules.
+
+Expected findings (hvd-lint verify): none.
+"""
+
+import horovod_tpu as hvd
+
+
+def lockstep_steps(shard, batches):
+    # The canonical lockstep idiom: a rank-dependent count laundered
+    # through a collective becomes replica-invariant.
+    n_rows = shard.num_rows
+    steps = hvd.allreduce(n_rows, op=hvd.Min, name="steps.min")
+    for _ in range(steps):
+        hvd.allreduce(next(batches), name="grad.step")
+    return steps
+
+
+def enumerate_counter_is_invariant(batches, params, train_step):
+    # Every rank's enumerate counts 0,1,2,... — a `step == 0` guard is
+    # replica-invariant, so the broadcast inside it is clean.
+    for step, batch in enumerate(batches):
+        loss = hvd.allreduce(train_step(batch), name="loss.step")
+        if step == 0:
+            hvd.broadcast_parameters(params, root_rank=0)
+    return loss
+
+
+def member_only_subcohort(x):
+    workers = hvd.add_process_set([0, 1, 2, 3])
+    if workers.included():
+        x = hvd.allreduce(x, name="cohort", process_set=workers)
+    return x
+
+
+def balanced_object_exchange(cfg):
+    # Both arms reach the same collective: rank selection INSIDE a
+    # balanced if is the documented send/receive shape.
+    if hvd.rank() == 0:
+        out = hvd.broadcast_object(cfg)
+    else:
+        out = hvd.broadcast_object(None)
+    return out
+
+
+def rank_local_work_only(stats):
+    # Guarded logging/checkpoint-free work with no collective at all.
+    if hvd.rank() == 0:
+        print("stats:", stats)
+    return stats

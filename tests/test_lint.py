@@ -21,7 +21,9 @@ from jax import lax
 
 from conftest import clean_spawn_env
 from horovod_tpu import analysis
-from horovod_tpu.analysis import ast_lint
+from horovod_tpu.analysis import (ast_lint, baseline as baseline_mod,
+                                  sarif as sarif_mod, schedule)
+from horovod_tpu.analysis.diagnostics import Diagnostic
 from horovod_tpu.analysis.order_guard import SubmissionOrderGuard
 from horovod_tpu.exceptions import (CollectiveLintError,
                                     SubmissionOrderError)
@@ -312,6 +314,397 @@ def test_clean_sweep_examples_and_models():
                                  os.path.join(REPO, "horovod_tpu",
                                               "chaos")])
     assert diags == [], "\n".join(d.format() for d in diags)
+
+
+# ==========================================================================
+# Layer 2.5: interprocedural schedule verifier (hvd-lint verify, HVD4xx)
+# ==========================================================================
+class TestScheduleRules:
+    def verify(self, name):
+        return schedule.verify_paths([os.path.join(FIXTURES, name)])
+
+    def test_tainted_schedule_fixture(self):
+        diags = self.verify("bad_tainted_schedule.py")
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD401", 20), ("HVD401", 24), ("HVD401", 34)]
+        assert all(os.path.basename(d.file)
+                   == "bad_tainted_schedule.py" for d in diags)
+
+    def test_divergent_loop_fixture(self):
+        diags = self.verify("bad_divergent_loop.py")
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD402", 15), ("HVD402", 23), ("HVD402", 31)]
+
+    def test_cross_set_interleave_fixture(self):
+        diags = self.verify("bad_cross_set_interleave.py")
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD404", 19), ("HVD404", 30), ("HVD404", 38)]
+
+    def test_skipped_collective_fixture(self):
+        diags = self.verify("bad_skipped_collective.py")
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD403", 15), ("HVD403", 22), ("HVD403", 29)]
+
+    def test_adasum_bucketed_fixture(self):
+        diags = self.verify("bad_adasum_bucketed.py")
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD405", 18), ("HVD405", 23), ("HVD405", 31)]
+
+    def test_clean_fixture_silent_on_both_layers(self):
+        path = os.path.join(FIXTURES, "good_verify_clean.py")
+        assert schedule.verify_paths([path]) == []
+        assert ast_lint.lint_file(path) == []
+
+    def test_interprocedural_chain_named_in_message(self):
+        src = ("import horovod_tpu as hvd\n"
+               "def sync(x):\n"
+               "    return hvd.allreduce(x, name='s')\n"
+               "def main(x):\n"
+               "    if hvd.rank() == 0:\n"
+               "        sync(x)\n")
+        diags = schedule.verify_source(src, "chain.py")
+        assert rules_of(diags) == ["HVD401"]
+        assert diags[0].line == 3          # the collective, not the call
+        assert "called from main" in diags[0].message
+
+    def test_direct_one_hop_guard_stays_hvd201(self):
+        """The exact single-hop shape stays HVD201's finding: verify
+        adds no duplicate HVD401 on top of it."""
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    if hvd.rank() == 0:\n"
+               "        hvd.allreduce(x, name='m')\n")
+        assert schedule.verify_source(src, "direct.py") == []
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD201"]
+
+    def test_collective_result_launders_taint(self):
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x, n):\n"
+               "    steps = hvd.allreduce(n, op=hvd.Min, name='n')\n"
+               "    if steps > 0:\n"
+               "        hvd.allreduce(x, name='m')\n")
+        assert schedule.verify_source(src, "launder.py") == []
+
+    def test_tuple_unpack_taints_elementwise(self):
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    rank, size = hvd.rank(), hvd.size()\n"
+               "    if size > 1:\n"
+               "        hvd.allreduce(x, name='m')\n")
+        assert schedule.verify_source(src, "tuple.py") == []
+
+    def test_enumerate_counter_is_replica_invariant(self):
+        """A rank-sharded iterable is one HVD402 for the loop — NOT a
+        cascade of HVD401 for every step-guarded collective inside
+        (enumerate counters run 0,1,2,... on every rank)."""
+        src = ("import horovod_tpu as hvd\n"
+               "def main(dataset, params):\n"
+               "    shard = dataset.shard(hvd.size(), hvd.rank())\n"
+               "    for step, b in enumerate(shard):\n"
+               "        hvd.allreduce(b, name='grad')\n"
+               "        if step == 0:\n"
+               "            hvd.broadcast_parameters(params,"
+               " root_rank=0)\n")
+        assert rules_of(schedule.verify_source(src, "enum.py")) == \
+            ["HVD402"]
+
+    def test_sibling_module_import_resolves(self, tmp_path):
+        (tmp_path / "helpers.py").write_text(
+            "import horovod_tpu as hvd\n"
+            "def sync(x):\n"
+            "    return hvd.allreduce(x, name='h')\n")
+        train = tmp_path / "train.py"
+        train.write_text(
+            "import horovod_tpu as hvd\n"
+            "from helpers import sync\n"
+            "def main(x):\n"
+            "    if hvd.rank() == 0:\n"
+            "        sync(x)\n")
+        diags = schedule.verify_paths([str(train)])
+        assert rules_of(diags) == ["HVD401"]
+        assert os.path.basename(diags[0].file) == "helpers.py"
+
+    def test_extract_schedule(self):
+        src = ("import horovod_tpu as hvd\n"
+               "def step(x, ps):\n"
+               "    if hvd.rank() == 0:\n"
+               "        hvd.allreduce(x, name='a', process_set=ps)\n"
+               "    hvd.allgather(x, name='b')\n")
+        events = schedule.extract_schedule(src, "sched.py")
+        assert [(e["kind"], e["name"], e["process_set"])
+                for e in events] == \
+            [("allreduce", "a", "ps"), ("allgather", "b", "global")]
+        assert events[0]["context"] == ["if rank-tainted@3"]
+        assert events[1]["context"] == []
+
+    def test_syntax_error_reported(self):
+        assert rules_of(schedule.verify_source("def broken(:\n")) == \
+            ["HVD001"]
+
+
+# ==========================================================================
+# SARIF 2.1.0 emitter
+# ==========================================================================
+
+# Structural subset of the OASIS SARIF 2.1.0 schema: the required
+# properties plus the constraints on every field hvd-lint emits. The
+# full 330 KB schema is not vendored; this subset rejects exactly the
+# malformations a consumer (GitHub code scanning, VS Code) would.
+_SARIF_21_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string"},
+        "runs": {"type": "array", "minItems": 1, "items": {
+            "type": "object", "required": ["tool"],
+            "properties": {
+                "tool": {
+                    "type": "object", "required": ["driver"],
+                    "properties": {"driver": {
+                        "type": "object", "required": ["name"],
+                        "properties": {
+                            "name": {"type": "string"},
+                            "version": {"type": "string"},
+                            "informationUri": {"type": "string"},
+                            "rules": {"type": "array", "items": {
+                                "type": "object", "required": ["id"],
+                                "properties": {
+                                    "id": {"type": "string"},
+                                    "shortDescription": {
+                                        "type": "object",
+                                        "required": ["text"]},
+                                    "defaultConfiguration": {
+                                        "type": "object",
+                                        "properties": {"level": {
+                                            "enum": ["none", "note",
+                                                     "warning",
+                                                     "error"]}}},
+                                }}},
+                        }}},
+                },
+                "results": {"type": "array", "items": {
+                    "type": "object", "required": ["message"],
+                    "properties": {
+                        "ruleId": {"type": "string"},
+                        "ruleIndex": {"type": "integer",
+                                      "minimum": 0},
+                        "level": {"enum": ["none", "note", "warning",
+                                           "error"]},
+                        "message": {"type": "object",
+                                    "required": ["text"]},
+                        "locations": {"type": "array", "items": {
+                            "type": "object",
+                            "properties": {"physicalLocation": {
+                                "type": "object",
+                                "properties": {
+                                    "artifactLocation": {
+                                        "type": "object",
+                                        "properties": {"uri": {
+                                            "type": "string"}}},
+                                    "region": {
+                                        "type": "object",
+                                        "properties": {"startLine": {
+                                            "type": "integer",
+                                            "minimum": 1}}},
+                                }}}}},
+                        "partialFingerprints": {"type": "object"},
+                        "suppressions": {"type": "array", "items": {
+                            "type": "object", "required": ["kind"],
+                            "properties": {"kind": {
+                                "enum": ["inSource", "external"]}}}},
+                    }}},
+            }}},
+    },
+}
+
+
+class TestSarifOutput:
+    def test_golden_file(self):
+        """Pin the exact emitted document (key layout, fingerprints,
+        suppression shape) against the checked-in golden."""
+        d1 = Diagnostic.make(
+            "HVD401", "collective `allreduce` runs only on ranks that "
+            "take a rank-dependent path", file="golden/train.py",
+            line=12,
+            hint="hoist the collective out of the rank-dependent path")
+        d2 = Diagnostic.make(
+            "HVD304", "raw os.environ read of 'HVDTPU_DEMO' bypasses "
+            "utils/envparse.py", file="golden/train.py", line=40)
+        doc = sarif_mod.to_sarif([d1], suppressed=[d2])
+        doc["runs"][0]["tool"]["driver"]["version"] = "GOLDEN"
+        with open(os.path.join(FIXTURES, "golden_lint.sarif")) as f:
+            golden = json.load(f)
+        assert doc == golden
+
+    def test_corpus_sarif_validates_against_schema(self):
+        import jsonschema
+        proc = _run_cli("verify", FIXTURES, "--format", "sarif",
+                        "--fail-on", "never")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        jsonschema.validate(doc, _SARIF_21_SCHEMA)
+        run = doc["runs"][0]
+        rules = [r["id"] for r in run["tool"]["driver"]["rules"]]
+        assert {"HVD401", "HVD402", "HVD403", "HVD404",
+                "HVD405"} <= set(rules)
+        for result in run["results"]:
+            # ruleIndex must actually point at its rule
+            assert rules[result["ruleIndex"]] == result["ruleId"]
+            assert "hvdLintKey/v1" in result["partialFingerprints"]
+
+    def test_suppressed_results_are_marked_not_dropped(self):
+        d = Diagnostic.make("HVD402", "divergent loop",
+                            file="x.py", line=3)
+        doc = sarif_mod.to_sarif([], suppressed=[d])
+        results = doc["runs"][0]["results"]
+        assert len(results) == 1
+        assert results[0]["suppressions"][0]["kind"] == "external"
+        # a NEW finding carries no suppressions key at all
+        doc = sarif_mod.to_sarif([d])
+        assert "suppressions" not in doc["runs"][0]["results"][0]
+
+
+# ==========================================================================
+# Baseline workflow (--write-baseline / --baseline)
+# ==========================================================================
+class TestBaseline:
+    def _fixture_diags(self):
+        return schedule.verify_paths(
+            [os.path.join(FIXTURES, "bad_divergent_loop.py")])
+
+    def test_round_trip_write_then_clean(self, tmp_path):
+        diags = self._fixture_diags()
+        assert diags
+        path = str(tmp_path / "base.json")
+        baseline_mod.write_baseline(diags, path)
+        doc = baseline_mod.load_baseline(path)
+        new, suppressed = baseline_mod.filter_new(diags, doc)
+        assert new == [] and len(suppressed) == len(diags)
+
+    def test_new_finding_fails_after_baseline(self, tmp_path):
+        diags = self._fixture_diags()
+        path = str(tmp_path / "base.json")
+        baseline_mod.write_baseline(diags, path)
+        doc = baseline_mod.load_baseline(path)
+        injected = Diagnostic.make("HVD401", "fresh regression",
+                                   file="new_code.py", line=7)
+        new, suppressed = baseline_mod.filter_new(
+            diags + [injected], doc)
+        assert new == [injected]
+        assert len(suppressed) == len(diags)
+
+    def test_keys_survive_line_shifts(self, tmp_path):
+        """Baseline keys are content-addressed: prepending lines moves
+        every finding's line number but resurfaces nothing."""
+        src = open(os.path.join(FIXTURES,
+                                "bad_divergent_loop.py")).read()
+        target = tmp_path / "shifty.py"
+        target.write_text(src)
+        before = schedule.verify_paths([str(target)])
+        path = str(tmp_path / "base.json")
+        baseline_mod.write_baseline(before, path)
+        target.write_text("# a\n# b\n# c\n" + src)
+        after = schedule.verify_paths([str(target)])
+        assert [d.line for d in after] == \
+            [d.line + 3 for d in before]
+        new, suppressed = baseline_mod.filter_new(
+            after, baseline_mod.load_baseline(path))
+        assert new == [] and len(suppressed) == len(after)
+
+    def test_editing_flagged_line_resurfaces(self, tmp_path):
+        src = ("import horovod_tpu as hvd\n"
+               "def f(x):\n"
+               "    for i in range(hvd.rank() + 1):\n"
+               "        hvd.allgather(x, name='g')\n")
+        target = tmp_path / "edit.py"
+        target.write_text(src)
+        diags = schedule.verify_paths([str(target)])
+        assert rules_of(diags) == ["HVD402"]
+        path = str(tmp_path / "base.json")
+        baseline_mod.write_baseline(diags, path)
+        # touching the flagged line invalidates its content hash
+        target.write_text(src.replace("hvd.rank() + 1",
+                                      "hvd.rank() + 2"))
+        diags = schedule.verify_paths([str(target)])
+        new, suppressed = baseline_mod.filter_new(
+            diags, baseline_mod.load_baseline(path))
+        assert rules_of(new) == ["HVD402"] and suppressed == []
+
+    def test_corrupt_baseline_rejected(self, tmp_path):
+        path = tmp_path / "bad.json"
+        path.write_text('{"not": "a baseline"}')
+        with pytest.raises(ValueError):
+            baseline_mod.load_baseline(str(path))
+        path.write_text('{"version": 99, "findings": {}}')
+        with pytest.raises(ValueError):
+            baseline_mod.load_baseline(str(path))
+
+    def test_cli_round_trip(self, tmp_path):
+        """write -> re-run clean -> inject finding -> fails: the full
+        no-flag-day workflow through the CLI."""
+        fixture = os.path.join(FIXTURES, "bad_divergent_loop.py")
+        base = str(tmp_path / "lint-baseline.json")
+        proc = _run_cli("verify", fixture, "--write-baseline", base)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "baseline recorded" in proc.stdout
+        proc = _run_cli("verify", fixture, "--baseline", base)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "baseline-suppressed" in proc.stdout
+        extra = tmp_path / "regression.py"
+        extra.write_text(
+            "import horovod_tpu as hvd\n"
+            "def f(x):\n"
+            "    gate = hvd.rank() == 0\n"
+            "    if gate:\n"
+            "        hvd.allreduce(x, name='r')\n")
+        proc = _run_cli("verify", fixture, str(extra),
+                        "--baseline", base)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "HVD401" in proc.stdout
+        assert "regression.py" in proc.stdout
+
+    def test_env_knob_default_baseline(self, tmp_path):
+        """HVDTPU_LINT_BASELINE supplies the default --baseline."""
+        fixture = os.path.join(FIXTURES, "bad_divergent_loop.py")
+        base = str(tmp_path / "env-base.json")
+        proc = _run_cli("verify", fixture, "--write-baseline", base)
+        assert proc.returncode == 0
+        env = clean_spawn_env(
+            PYTHONPATH=REPO + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+            HVDTPU_LINT_BASELINE=base)
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis.cli",
+             "verify", fixture],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "baseline-suppressed" in proc.stdout
+
+    def test_explicit_missing_baseline_is_an_error(self):
+        proc = _run_cli("verify", os.path.join(FIXTURES,
+                                               "good_clean.py"),
+                        "--baseline", "/nonexistent/base.json")
+        assert proc.returncode == 2
+        assert "cannot read baseline" in proc.stderr
+
+
+def test_ci_lint_script(tmp_path):
+    """Tier-1 gate: scripts/ci_lint.sh — self-analysis + dogfood sweep
+    + fixture-corpus canary emitting a valid lint.sarif artifact."""
+    out = str(tmp_path / "lint.sarif")
+    env = clean_spawn_env(
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        LINT_SARIF_OUT=out)
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "ci_lint.sh")],
+        env=env, capture_output=True, text=True, timeout=500)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all gates green" in proc.stdout
+    doc = json.load(open(out))
+    assert doc["version"] == "2.1.0"
+    rules = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert {"HVD401", "HVD402", "HVD403", "HVD404", "HVD405"} <= rules
 
 
 # ==========================================================================
