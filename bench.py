@@ -359,6 +359,167 @@ def _bench_trace_lane(hvd, on_tpu):
                 os.environ[k] = v
 
 
+def _bench_sparse(hvd, on_tpu):
+    """`--sparse` lane (ISSUE 11; docs/sparse.md): a DLRM/NMT stand-in
+    — one large embedding table whose gradient touches a density
+    fraction of rows per step, next to a small dense MLP — swept over
+    density × {gather, dense, auto} × {none, int8} on the eager
+    gradient plane, with the densified pre-plane baseline
+    (HVDTPU_SPARSE unset) as the reference row.
+
+    METHODOLOGY (CPU stand-in): wire bytes are the docs/sparse.md MODEL
+    bytes — dense ring ~ 2·R·W·b_v per rank, gather ~
+    (n−1)·nnz·(W·b_v + b_i)(/n per rank) — because the in-process
+    loopback transport has no real fabric to meter; both sides use the
+    same model, so the RATIO (the pinned ≥4× number at ≤5% density) is
+    transport-independent. samples/s uses a nominal batch of 256
+    lookups/step. int8 applies to gathered VALUES only (indices exact);
+    on the dense path the existing compression plane owns the wire, so
+    dense+int8 rows record the dense model bytes unchanged."""
+    import os
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu import basics
+    from horovod_tpu.ops import sparse as sparse_mod
+
+    n = hvd.size() if hvd.size() > 1 else len(jax.devices())
+    rows_total, width = (32768, 32) if not on_tpu else (1 << 20, 64)
+    batch, steps = 256, 6
+    from horovod_tpu import compression as compression_mod
+
+    coord = basics.runtime().coordinator
+    saved_plane = coord._sparse
+    saved_compression = coord._compression
+    saved_env = {k: os.environ.get(k)
+                 for k in ("HVDTPU_SPARSE", "HVDTPU_COMPRESSION")}
+    rng = np.random.RandomState(0)
+    mlp = [jnp.asarray(rng.randn(n, width, 64).astype(np.float32)),
+           jnp.asarray(rng.randn(n, 64, 1).astype(np.float32))]
+
+    def make_slices(density, seed):
+        nnz = max(1, int(density * rows_total))
+        out = []
+        for r in range(n):
+            rr = np.random.RandomState(seed * 1000 + r)
+            idx = rr.choice(rows_total, size=nnz,
+                            replace=False).astype(np.int32)
+            out.append(sparse_mod.SparseGradient(
+                idx, rr.randn(nnz, width).astype(np.float32),
+                (rows_total, width)))
+        return out, nnz
+
+    def run_config(density, mode, codec):
+        if mode is None:
+            os.environ.pop("HVDTPU_SPARSE", None)
+        else:
+            os.environ["HVDTPU_SPARSE"] = mode
+        if codec == "int8":
+            os.environ["HVDTPU_COMPRESSION"] = "int8"
+        else:
+            os.environ.pop("HVDTPU_COMPRESSION", None)
+        coord._sparse = sparse_mod.make_plane()
+        # Rebuild the COMPRESSION plane too: it was constructed at
+        # hvd.init() with the env as it was then — leaving it stale
+        # would run every dense-path "int8" row uncompressed while
+        # archiving codec=int8 (the sparse plane owns only the gather
+        # path's row codec; on the dense path the compression plane
+        # owns the wire).
+        coord._compression = compression_mod.make_plane(basics.runtime())
+        slices, nnz = make_slices(density, int(density * 1e4) + 7)
+        tag = (f"d{density}_{mode or 'baseline'}_"
+               f"{codec or 'none'}")
+        before = (dict(coord._sparse.path_counts)
+                  if coord._sparse else None)
+        # SPMD mode takes this rank's slices; the single-controller
+        # plane takes the whole per-rank list (size() counts VIRTUAL
+        # ranks there too, so the mode — not the size — decides).
+        arg = (slices[hvd.rank()]
+               if basics.runtime().mode == basics.MODE_SPMD else slices)
+        t0 = time.perf_counter()
+        for s in range(steps):
+            out = hvd.sparse_allreduce(arg, op=hvd.Sum,
+                                       name=f"emb_table.{tag}.{s}")
+            for i, g in enumerate(mlp):
+                hvd.allreduce(g, op=hvd.Average,
+                              name=f"mlp.{tag}.{i}.{s}")
+            jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if coord._sparse is None:
+            path = "dense"
+        else:
+            after = coord._sparse.path_counts
+            path = ("gather" if after["gather"] > before["gather"]
+                    else "dense")
+        dense_bytes = sparse_mod.dense_wire_bytes((rows_total, width), 4)
+        if path == "gather":
+            wire = sparse_mod.gather_wire_bytes(
+                nnz * n, width, 4, 4, n,
+                codec=(codec if codec == "int8" else None))
+        else:
+            wire = dense_bytes
+        return {
+            "metric": f"sparse_embedding_{tag}",
+            "value": round(batch * steps / dt, 2),
+            "unit": "samples/s",
+            "density": density,
+            "mode": mode or "baseline-unset",
+            "codec": codec or "none",
+            "path_taken": path,
+            "emb_wire_bytes_per_rank_per_step": int(wire),
+            "dense_wire_bytes_per_rank_per_step": int(dense_bytes),
+            "wire_reduction_vs_dense": round(dense_bytes / max(wire, 1),
+                                             2),
+            "nnz_rows_per_rank": int(nnz),
+            "table": [rows_total, width],
+            "world": n,
+        }
+
+    out_rows = []
+    try:
+        # The pre-plane reference: knob unset, sparse grads densify.
+        out_rows.append(run_config(0.05, None, None))
+        for density in (0.01, 0.05, 0.25):
+            for mode in ("gather", "dense", "auto"):
+                for codec in (None, "int8"):
+                    out_rows.append(run_config(density, mode, codec))
+    finally:
+        coord._sparse = saved_plane
+        coord._compression = saved_compression
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    by = {(r["density"], r["mode"], r["codec"]): r for r in out_rows}
+    summary = {}
+    base = by.get((0.05, "baseline-unset", "none"))
+    g5 = by.get((0.05, "gather", "none"))
+    q5 = by.get((0.05, "gather", "int8"))
+    if base and g5:
+        summary = {
+            "wire_reduction_at_5pct_density": round(
+                base["emb_wire_bytes_per_rank_per_step"]
+                / max(g5["emb_wire_bytes_per_rank_per_step"], 1), 2),
+            "wire_reduction_at_5pct_density_int8": round(
+                base["emb_wire_bytes_per_rank_per_step"]
+                / max(q5["emb_wire_bytes_per_rank_per_step"], 1), 2)
+            if q5 else None,
+            "auto_path_by_density": {
+                str(d): by[(d, "auto", "none")]["path_taken"]
+                for d in (0.01, 0.05, 0.25)
+                if (d, "auto", "none") in by},
+            "world": n,
+            "methodology": ("model wire bytes (docs/sparse.md): CPU "
+                            "stand-in loopback has no fabric to meter; "
+                            "ratio is transport-independent"),
+        }
+    return out_rows, summary
+
+
 def _bench_keras(hvd, on_tpu):
     """Keras-3 frontend with model math compiled onto the chip
     (set_data_parallel: one XLA program per train step, batch sharded over
@@ -744,6 +905,31 @@ def main():
                   file=sys.stderr, flush=True)
         except Exception as e:  # noqa: BLE001 — evidence is best-effort
             print(f"# bench: BENCH_r08.json write failed: {e}",
+                  file=sys.stderr, flush=True)
+    # --sparse: the sparse/embedding gradient plane lane (ISSUE 11,
+    # docs/sparse.md): density × path × codec sweep on a DLRM/NMT
+    # stand-in, archived as BENCH_r09.json with wire bytes next to
+    # samples/s against the densified baseline.
+    if "--sparse" in sys.argv:
+        try:
+            rows, summary = _bench_sparse(hvd, on_tpu)
+            for row in rows:
+                print(json.dumps(row), flush=True)
+            with open("BENCH_r09.json", "w") as f:
+                json.dump({"cmd": "python bench.py --sparse",
+                           "rows": rows, "summary": summary}, f,
+                          indent=1)
+            print("# bench: sparse sweep archived to BENCH_r09.json",
+                  file=sys.stderr, flush=True)
+            red = summary.get("wire_reduction_at_5pct_density", 0)
+            assert red >= 4.0, (
+                f"embedding wire reduction {red}x at 5% density is "
+                "under the 4x acceptance bar (BENCH_r09.json has the "
+                "sweep)")
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — best-effort lane
+            print(f"# bench: sparse lane failed: {e!r}",
                   file=sys.stderr, flush=True)
     # --trace: smoke the cross-rank trace plane on the transformer-LM
     # gradient set (eager plane), archive the analyzer summary to

@@ -39,6 +39,9 @@ from .ops.collectives import (  # noqa: F401
     grouped_reducescatter_async,
     barrier, join, poll, synchronize,
 )
+from .ops.sparse import (  # noqa: F401
+    SparseGradient, sparse_allreduce, sparse_allreduce_async,
+)
 from .process_sets import (  # noqa: F401
     ProcessSet, global_process_set, add_process_set, remove_process_set,
 )

@@ -45,6 +45,10 @@ def size():
 # One bucket-split algorithm for every frontend's sync plane.
 from ..ops.collectives import fusion_buckets as _buckets  # noqa: E402
 
+# One-time note for the explicit IndexedSlices densification (the
+# sparse plane routes them instead when HVDTPU_SPARSE is set).
+_warned_sparse = [False]
+
 
 def _reduce_numpy_grads(grads, op, prescale, postscale, name,
                         compression=None, num_groups=0):
@@ -166,10 +170,36 @@ def create_distributed_optimizer(keras, optimizer, name=None,
             # py_function bridge. None grads (unused variables) pass
             # through untouched.
             from .. import tensorflow as hvd_tf
-            dense_idx = [i for i, g in enumerate(grads) if g is not None]
-            if not dense_idx:
-                return grads
+            from ..ops import sparse as sparse_ops
+            tf_mod = hvd_tf._tf()
             result = list(grads)
+            grads = list(grads)
+            routed = set()
+            for i, g in enumerate(grads):
+                if not isinstance(g, tf_mod.IndexedSlices):
+                    continue
+                # Explicit sparse handling (never the old implicit
+                # densify inside the numpy marshal): with the sparse
+                # plane on, embedding grads ride it; otherwise densify
+                # HERE, visibly, with a one-time note.
+                if sparse_ops.enabled():
+                    result[i] = hvd_tf._sparse_allreduce_tf(
+                        g, op, f"keras_grads.sp{i}",
+                        hvd_tf.global_process_set)
+                    routed.add(i)
+                else:
+                    if not _warned_sparse[0]:
+                        _warned_sparse[0] = True
+                        log.info(
+                            "keras DistributedOptimizer: IndexedSlices "
+                            "gradients densify before the sync; set "
+                            "HVDTPU_SPARSE for the sparse gather plane "
+                            "(docs/sparse.md)")
+                    grads[i] = tf_mod.convert_to_tensor(g)
+            dense_idx = [i for i, g in enumerate(grads)
+                         if g is not None and i not in routed]
+            if not dense_idx:
+                return result
             for b, bucket in enumerate(_buckets(len(dense_idx),
                                                 num_groups)):
                 outs = hvd_tf.grouped_allreduce(

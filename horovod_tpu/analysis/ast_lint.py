@@ -160,6 +160,17 @@ _INTY_DTYPE_ATTRS = frozenset({
     "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
     "uint64", "bool_", "bool", "long",
 })
+# Rule HVD209 (extends HVD205's integer-tensor walk): expressions that
+# visibly produce INDEX tensors even without a spelled-out int dtype —
+# the indices half of a sparse gradient (`grad.indices`, torch
+# `t.indices()`, `t._indices()`) and the index-producing constructions.
+# Indices must be exact: a lossy wire format rounds row ids into the
+# WRONG rows with no arithmetic error to catch it (docs/sparse.md).
+_INDEX_ATTRS = frozenset({"indices", "_indices"})
+_INDEX_PRODUCING_CALLS = frozenset({
+    "indices", "_indices", "argsort", "argmax", "argmin", "nonzero",
+    "flatnonzero", "searchsorted",
+})
 # Presence of any of these identifiers means initial-state sync happens
 # through a channel HVD202 should not second-guess.
 _SYNC_MARKERS = frozenset({
@@ -350,6 +361,7 @@ class _Analyzer(ast.NodeVisitor):
         self.dist_opt_node = None
         self.has_broadcast = False
         self.int_names = set()      # names assigned integer-looking values
+        self.index_names = set()    # names assigned index-producing exprs
         self.zero_env_set = False   # script set HVDTPU_ZERO-family env
         self._flagged = set()       # id(call) already reported
 
@@ -605,6 +617,29 @@ class _Analyzer(ast.NodeVisitor):
         return any(isinstance(n, ast.Name) and n.id in self.int_names
                    for n in ast.walk(expr))
 
+    @staticmethod
+    def _expr_is_indexy(expr):
+        """Index-tensor evidence inside one expression (rule HVD209):
+        a ``.indices`` access (attr or call — the sparse-gradient
+        halves) or an index-producing construction (argsort/argmax/
+        nonzero/searchsorted)."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr in _INDEX_ATTRS:
+                return True
+            if (isinstance(n, ast.Call)
+                    and _terminal_name(n.func)
+                    in _INDEX_PRODUCING_CALLS):
+                return True
+        return False
+
+    def _looks_index_tensor(self, expr):
+        """HVD209's walk: visibly index-producing, or a name one-hop
+        assigned from an index-producing expression."""
+        if self._expr_is_indexy(expr):
+            return True
+        return any(isinstance(n, ast.Name) and n.id in self.index_names
+                   for n in ast.walk(expr))
+
     # -- HVD208: ZeRO × Adasum / non-global process set --------------------
     def _note_zero_env(self, node):
         """Record ``os.environ["HVDTPU_ZERO"] = "1"`` (any accepted
@@ -679,11 +714,16 @@ class _Analyzer(ast.NodeVisitor):
         names = [t.id for t in node.targets if isinstance(t, ast.Name)]
         if names:
             inty = self._expr_is_inty(node.value)
+            indexy = self._expr_is_indexy(node.value)
             for name in names:
                 if inty:
                     self.int_names.add(name)
                 else:
                     self.int_names.discard(name)
+                if indexy:
+                    self.index_names.add(name)
+                else:
+                    self.index_names.discard(name)
         self.generic_visit(node)
 
     def _report_205(self, call, comp, why):
@@ -718,6 +758,35 @@ class _Analyzer(ast.NodeVisitor):
                 "lossy representation (counts and masks corrupt "
                 "silently)")
 
+    def _report_209(self, call, comp, why):
+        self._flagged.add(id(call))
+        fn = _terminal_name(call.func)
+        self.diags.append(Diagnostic.make(
+            "HVD209",
+            f"lossy compressor `Compression.{comp}` on `{fn}`: {why}",
+            file=self.filename, line=call.lineno,
+            hint="only the VALUES half of a sparse gradient may ride a "
+                 "wire codec (the sparse plane's row-wise int8 does "
+                 "this; docs/sparse.md) — drop the compression= "
+                 "argument here; " + _DOC_HINT))
+
+    def _check_209(self, node):
+        """HVD209: lossy codec on an index tensor / the indices half of
+        a sparse gradient. Runs after HVD205 (the _flagged set dedups:
+        an index tensor with a visible int dtype stays an HVD205
+        finding; this rule catches the sparse spellings HVD205's
+        dtype walk cannot see)."""
+        comp = self._lossy_compression_kw(node)
+        if comp is None or id(node) in self._flagged:
+            return
+        if (self._is_collective(node) and node.args
+                and self._looks_index_tensor(node.args[0])):
+            self._report_209(
+                node, comp,
+                "the tensor is (or derives from) an index tensor — "
+                "indices must cross the wire exactly, or rows "
+                "scatter-add into the wrong slots")
+
     def visit_Call(self, node):
         term = _terminal_name(node.func)
         if term == "init" and self._is_hvd_call(node, {"init"}):
@@ -729,6 +798,7 @@ class _Analyzer(ast.NodeVisitor):
         elif term in BROADCAST_STATE_CALLS:
             self.has_broadcast = True
         self._check_205(node)
+        self._check_209(node)
         self.generic_visit(node)
 
     def visit_Attribute(self, node):

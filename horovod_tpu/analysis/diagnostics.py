@@ -47,6 +47,11 @@ RULES = {
                       "reduce-scatter; a sub-cohort derives a wrong "
                       "shard plan — DistributedOptimizer rejects both "
                       "at __init__)"),
+    "HVD209": (WARNING, "lossy compressor applied to an index tensor "
+                        "or to the indices half of a sparse gradient "
+                        "(indices must be exact — a rounded row id "
+                        "scatter-adds into the wrong row with no "
+                        "arithmetic error to catch it)"),
     # -- interprocedural schedule verifier (hvd-lint verify) ---------------
     "HVD401": (ERROR, "collective reachable under rank-tainted control "
                       "flow through any call depth (the whole-program "

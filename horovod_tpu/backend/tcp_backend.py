@@ -330,6 +330,9 @@ class TcpBackend(Backend):
                     postscale=post * post_extra))
             return _Pending(entry, handles, _unpack_list(arrays))
 
+        if kind == "sparse_allreduce":
+            return self._enqueue_sparse_allgather(entry, ps, n)
+
         if kind == "barrier":
             h = self._native_enqueue(ps, entry.name, native.REQ_BARRIER)
             return _Pending(entry, [h], lambda core, hs: None)
@@ -425,6 +428,67 @@ class TcpBackend(Backend):
         return _Pending(entry, [hq, hs],
                         _unpack_quantized(codec, block, n, padded,
                                           arrays, post_total))
+
+    def _enqueue_sparse_allgather(self, entry, ps, n):
+        """Gather-path sparse allreduce on the host data plane
+        (ops/sparse.py; docs/sparse.md): this rank's deduplicated
+        (indices, values) slices ride TWO negotiated allgathers — the
+        native allgather-v already negotiates per-rank first-dim sizes
+        (csrc/collectives.cc RingAllgatherv), so ragged nnz needs no
+        extra protocol — and the completion sweep scatter-adds the
+        gathered slices into the dense shape. Wire bytes per rank are
+        ~(n-1)*nnz*(row + index) instead of the fp32 ring's 2*table.
+        With the int8 row codec the VALUES travel quantized (one f32
+        scale per slice row, a third allgather); indices are exact
+        always. The delegated xla-global plane has no uneven
+        negotiation — entries densify into a plain allreduce there
+        (lossless, warned once)."""
+        from ..ops import sparse as sparse_mod
+
+        m = entry.sparse
+        idx = np.ascontiguousarray(np.asarray(entry.arrays[0]))
+        vals = np.ascontiguousarray(np.asarray(entry.arrays[1]))
+        if self.delegate_data_ops:
+            if not getattr(self, "_warned_sparse_delegated", False):
+                self._warned_sparse_delegated = True
+                self._log.warning(
+                    "sparse: the delegated xla-global data plane has no "
+                    "uneven-allgather transport; gather-path entries "
+                    "densify into a plain allreduce (lossless, no wire "
+                    "win — docs/sparse.md)")
+            dense = np.asarray(sparse_mod.scatter_add_dense(
+                idx, vals, m.dense_shape, 1, reduce_ops.Sum))
+            entry.arrays = [dense]
+            entry.kind = "allreduce"
+            entry.sparse = None
+            return self._enqueue_entry(entry)
+        row_elems = sparse_mod.row_elems(m.dense_shape)
+        hi = self._native_enqueue(ps, f"{entry.name}.idx",
+                                  native.REQ_ALLGATHER, idx)
+        handles = [hi]
+        if m.codec == "int8":
+            q, s = sparse_mod.encode_rows(vals)
+            handles.append(self._native_enqueue(
+                ps, f"{entry.name}.q", native.REQ_ALLGATHER,
+                np.ascontiguousarray(np.asarray(q))))
+            handles.append(self._native_enqueue(
+                ps, f"{entry.name}.s", native.REQ_ALLGATHER,
+                np.ascontiguousarray(np.asarray(s, np.float32))))
+        else:
+            handles.append(self._native_enqueue(
+                ps, f"{entry.name}.val", native.REQ_ALLGATHER, vals))
+        # Accounting happens at completion (_unpack_sparse) where the
+        # EXACT gathered total is known — approximating it here as
+        # local-nnz x n mis-reports hvd_sparse_bytes_saved_total both
+        # ways under per-rank nnz skew (the common sparse shape), and
+        # diverges from the single-controller path's exact sums.
+        # n <= 1: no fabric, nothing is "saved" (mirrors the
+        # coordinator's guard).
+        plane = getattr(self, "sparse_plane", None)
+        return _Pending(entry, handles,
+                        _unpack_sparse(m, n, row_elems, entry.op,
+                                       vals.dtype,
+                                       plane=(plane if n > 1 else None)))
 
     # -- the cycle --------------------------------------------------------
     def run_cycle(self):
@@ -731,6 +795,41 @@ def _unpack_quantized(codec, block, n, padded, arrays, post):
                                 .astype(dtype)))
             off += size
         return outs if len(outs) > 1 else outs[0]
+    return unpack
+
+
+def _unpack_sparse(meta, n, row_elems, op, val_dtype, plane=None):
+    """Completion half of the sparse gather path: the concat-gathered
+    indices and (possibly row-quantized) values scatter-add into the
+    dense shape — order-invariant, so no per-rank boundary metadata is
+    needed on the wire. With ``plane``, bytes-saved accounting runs
+    here too (the sweep thread — the plane's accounting contract),
+    using the EXACT gathered nnz total rather than a local estimate."""
+    from ..ops import sparse as sparse_mod
+    idx_dtype = np.dtype(meta.index_dtype)
+    tail = tuple(meta.dense_shape[1:])
+    codec = meta.codec
+    dense_shape = meta.dense_shape
+
+    def unpack(core, handles):
+        idx = core.output(handles[0], idx_dtype).reshape(-1)
+        if plane is not None:
+            val_isize = np.dtype(val_dtype).itemsize
+            plane.record_gather(
+                sparse_mod.dense_wire_bytes(dense_shape, val_isize),
+                sparse_mod.gather_wire_bytes(int(idx.shape[0]),
+                                             row_elems, val_isize,
+                                             idx_dtype.itemsize, n,
+                                             codec=codec))
+        if codec == "int8":
+            q = core.output(handles[1], np.int8).reshape((-1,) + tail)
+            s = core.output(handles[2], np.float32).reshape(-1)
+            vals = np.asarray(sparse_mod.decode_rows(q, s, val_dtype))
+        else:
+            vals = core.output(handles[1],
+                               val_dtype).reshape((-1,) + tail)
+        return _to_jax(np.asarray(sparse_mod.scatter_add_dense(
+            idx, vals, dense_shape, n, op, dtype=val_dtype)))
     return unpack
 
 

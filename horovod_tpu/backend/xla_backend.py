@@ -382,6 +382,19 @@ class XlaSingleBackend(Backend):
                 (n,) + full.shape, sharding, lambda idx, b=block: b))
         return outs
 
+    def replicate_stacked(self, array, process_set):
+        """Stacked (n, ...) result with every slice == ``array``, built
+        shard-by-shard like :meth:`allgather_uneven`: each mesh device
+        receives one (1, ...) block directly — never materializing the
+        n-fold copy ``broadcast_to`` would allocate before sharding
+        (at bench geometry, GBs of identical replicas on one device)."""
+        mesh = self._mesh(process_set)
+        n = mesh.devices.size
+        sharding = NamedSharding(mesh, P(AXIS))
+        block = np.asarray(array)[None]
+        return jax.make_array_from_callback(
+            (n,) + block.shape[1:], sharding, lambda idx: block)
+
     # -- broadcast ---------------------------------------------------------
     @_timed("broadcast")
     def broadcast(self, arrays, root_rank, process_set):

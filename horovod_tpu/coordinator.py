@@ -98,7 +98,7 @@ class TensorEntry:
     __slots__ = ("name", "kind", "op", "root_rank", "arrays", "splits",
                  "prescale", "postscale", "process_set", "handle",
                  "enqueue_time", "shapes", "uneven", "guard_token",
-                 "chaos_mismatch", "codec", "corr")
+                 "chaos_mismatch", "codec", "corr", "sparse")
 
     def __init__(self, name, kind, arrays, process_set, op=None,
                  root_rank=None, splits=None, prescale=None, postscale=None,
@@ -130,6 +130,11 @@ class TensorEntry:
         # tracing.Tracer.on_submit (identical across ranks for a correct
         # program); None when the trace plane is off.
         self.corr = None
+        # Sparse gradient plane (ops/sparse.py): a SparseMeta for
+        # kind == "sparse_allreduce" entries (dense_shape/index_dtype/
+        # nranks/codec); None on every dense entry — the digest and
+        # dispatch planes key off it.
+        self.sparse = None
 
 
 def _nbytes(a):
@@ -187,6 +192,13 @@ class Coordinator:
         # with the env unset.
         from . import compression as compression_mod
         self._compression = compression_mod.make_plane(runtime)
+        # Sparse/embedding gradient plane (ops/sparse.py;
+        # docs/sparse.md). None when HVDTPU_SPARSE is unset: the dense
+        # hot path never sees a sparse entry (sparse_allreduce then
+        # densifies at the user layer into TODAY's allreduce path) and
+        # no per-name EMA state exists — guard-tested.
+        from .ops import sparse as sparse_mod
+        self._sparse = sparse_mod.make_plane()
         # Cross-rank trace plane (tracing/; docs/tracing.md). None when
         # HVDTPU_TRACE is off AND the flight recorder is disabled: the
         # submit/complete paths pay one attribute check. With only the
@@ -516,6 +528,9 @@ class Coordinator:
         # (quantized allgather + f32 reduce) and threads error-feedback
         # residuals through this plane (None when compression is off).
         backend.compression_plane = self._compression
+        # Sparse gather-path entries record their wire accounting
+        # through the plane (None when HVDTPU_SPARSE is off).
+        backend.sparse_plane = self._sparse
         while self._running:
             time.sleep(self.cycle_time_s)
             with self._lock:
@@ -788,9 +803,16 @@ class Coordinator:
         if timeline is not None and timeline.mark_cycles:
             timeline.marker("CYCLE_START")
         backend = self.runtime.backend
-        # Group allreduces for fusion; run everything else in order.
-        fusible = [e for e in batch if e.kind == "allreduce"]
-        others = [e for e in batch if e.kind != "allreduce"]
+        # Group allreduces for fusion, sparse entries for the gather
+        # transport; run everything else in order.
+        fusible, sparse, others = [], [], []
+        for e in batch:
+            if e.kind == "allreduce":
+                fusible.append(e)
+            elif e.kind == "sparse_allreduce":
+                sparse.append(e)
+            else:
+                others.append(e)
         # Cycle timing through the span API (rule HVD207): batch is
         # non-empty here, so every observation is a cycle that moved
         # tensors; with metrics off the histogram is NULL and the span
@@ -800,6 +822,8 @@ class Coordinator:
                 if fusible:
                     self._run_fused_allreduces(backend, fusible,
                                                timeline)
+                if sparse:
+                    self._run_sparse_groups(backend, sparse, timeline)
                 for e in others:
                     self._run_single(backend, e, timeline)
             finally:
@@ -964,6 +988,184 @@ class Coordinator:
             plane.store_residuals(bucket, new_residuals)
         plane.record(codec_name, bucket, flat, new_residuals)
         return results
+
+    # -- sparse gather path (ops/sparse.py; docs/sparse.md) ---------------
+    def _run_sparse_groups(self, backend, entries, timeline):
+        """Gather-path sparse allreduces: entries fuse by (process set,
+        values dtype, index dtype, codec) and each group moves ONE
+        uneven-allgather transport of concatenated (indices, values
+        [, scales]) buffers — reusing the allgather_uneven plane — then
+        scatter-adds per entry. Failures are isolated per group."""
+        groups = {}
+        for e in entries:
+            m = e.sparse
+            key = (e.process_set.process_set_id, m.values_dtype,
+                   m.index_dtype, m.codec)
+            groups.setdefault(key, []).append(e)
+        for group in groups.values():
+            self._execute_sparse_group(backend, group, timeline)
+
+    def _execute_sparse_group(self, backend, group, timeline):
+        import jax.numpy as jnp
+        from .ops import sparse as sparse_mod
+        e0 = group[0]
+        names = [e.name for e in group]
+        codec = e0.sparse.codec
+        span_kind = ("sparse_allgather" if codec is None
+                     else "sparse_allgather_compressed")
+        try:
+            with tele_span(names, "SPARSE_ALLGATHER", timeline=timeline,
+                           histogram=self._m_dispatch_s.labels(
+                               kind=span_kind)):
+                if e0.sparse.nranks is None:
+                    # Loopback (world-size-1 SPMD): this process holds
+                    # the only slices — scatter-add locally, no wire.
+                    for e in group:
+                        dense = sparse_mod.scatter_add_dense(
+                            e.arrays[0], e.arrays[1],
+                            e.sparse.dense_shape, 1, e.op)
+                        self._complete_sparse(e, dense)
+                    return
+                n = e0.sparse.nranks
+                replicate = getattr(backend, "replicate_stacked", None)
+                for e, dense in zip(group, self._sparse_gather_single(
+                        backend, group, n, codec)):
+                    if replicate is not None:
+                        # Shard-by-shard: one (1, ...) block per mesh
+                        # device, never the n-fold broadcast_to copy.
+                        stacked = replicate(dense, e.process_set)
+                    else:
+                        stacked = jnp.broadcast_to(
+                            dense[None], (n,) + e.sparse.dense_shape)
+                    self._complete_sparse(e, stacked)
+        except Exception as exc:  # noqa: BLE001 - propagate to handles
+            self._log.error("sparse allgather failed: %s", exc)
+            for e in group:
+                e.handle._fail(_wrap_error(exc))
+                if self._tracer is not None:
+                    self._tracer.on_complete(e, ok=False)
+
+    def _sparse_gather_single(self, backend, group, n, codec):
+        """Single-controller transport for one sparse fusion group:
+        per-rank concatenated (indices, flattened values[, row scales])
+        buffers through ``backend.allgather_uneven`` (the ragged-shape
+        plane the list-input allgather rides), boundaries kept locally.
+        Yields each entry's dense reduction."""
+        from .ops import sparse as sparse_mod
+        row_elems = [sparse_mod.row_elems(e.sparse.dense_shape)
+                     for e in group]
+        counts = [[int(np.asarray(e.arrays[r]).shape[0]) for e in group]
+                  for r in range(n)]
+        idx_parts, val_parts, scale_parts = [], [], []
+        idx_dtype = np.dtype(group[0].sparse.index_dtype)
+        val_dtype = np.dtype(group[0].sparse.values_dtype)
+        wire_dtype = np.int8 if codec == "int8" else val_dtype
+        for r in range(n):
+            idx_parts.append(np.concatenate(
+                [np.asarray(e.arrays[r]).reshape(-1) for e in group]
+            ).astype(idx_dtype, copy=False))
+            vals, scales = [], []
+            for e in group:
+                v = np.asarray(e.arrays[e.sparse.nranks + r])
+                if codec == "int8":
+                    q, s = sparse_mod.encode_rows(v)
+                    vals.append(np.asarray(q).reshape(-1))
+                    scales.append(np.asarray(s).reshape(-1))
+                else:
+                    vals.append(v.reshape(-1))
+            val_parts.append(
+                np.concatenate(vals).astype(wire_dtype, copy=False)
+                if vals else np.zeros(0, wire_dtype))
+            if codec == "int8":
+                scale_parts.append(
+                    np.concatenate(scales).astype(np.float32,
+                                                  copy=False))
+        per_rank_lists = [idx_parts, val_parts]
+        if codec == "int8":
+            per_rank_lists.append(scale_parts)
+        gathered = backend.allgather_uneven(per_rank_lists,
+                                            group[0].process_set)
+        # Every stacked slice is identical — slice 0 is the full
+        # rank-major concatenation.
+        full_idx = np.asarray(gathered[0])[0]
+        full_val = np.asarray(gathered[1])[0]
+        full_scale = (np.asarray(gathered[2])[0] if codec == "int8"
+                      else None)
+        # Per-rank cumulative entry offsets, computed ONCE: segment
+        # extraction below is O(E*n) lookups, not O(E^2*n) re-summing
+        # on the dispatch cycle thread.
+        idx_cum, val_cum, idx_base, val_base = [], [], [], []
+        idx_off = val_off = 0
+        for r in range(n):
+            ci = np.concatenate(([0], np.cumsum(counts[r])))
+            cv = np.concatenate(([0], np.cumsum(
+                [c * w for c, w in zip(counts[r], row_elems)])))
+            idx_cum.append(ci)
+            val_cum.append(cv)
+            idx_base.append(idx_off)
+            val_base.append(val_off)
+            idx_off += int(ci[-1])
+            val_off += int(cv[-1])
+        results = []
+        for ei, e in enumerate(group):
+            tail = e.sparse.dense_shape[1:]
+            idx_segs, val_segs, scale_segs = [], [], []
+            for r in range(n):
+                lo_i = idx_base[r] + int(idx_cum[r][ei])
+                hi_i = idx_base[r] + int(idx_cum[r][ei + 1])
+                lo_v = val_base[r] + int(val_cum[r][ei])
+                hi_v = val_base[r] + int(val_cum[r][ei + 1])
+                idx_segs.append(full_idx[lo_i:hi_i])
+                val_segs.append(full_val[lo_v:hi_v])
+                if codec == "int8":
+                    scale_segs.append(full_scale[lo_i:hi_i])
+            idx = np.concatenate(idx_segs)
+            raw = np.concatenate(val_segs).reshape((-1,) + tuple(tail))
+            if codec == "int8":
+                vals = sparse_mod.decode_rows(
+                    raw, np.concatenate(scale_segs), val_dtype)
+            else:
+                vals = raw
+            results.append(sparse_mod.scatter_add_dense(
+                idx, vals, e.sparse.dense_shape,
+                len(e.process_set.ranks), e.op, dtype=val_dtype))
+        return results
+
+    def _complete_sparse(self, e, result):
+        self._release_name(e)
+        e.handle._complete(result)
+        if self._tracer is not None:
+            self._tracer.on_complete(e)
+        self.tensors_processed += 1
+        self.bytes_processed += sum(
+            _nbytes(np.asarray(a)) for a in e.arrays)
+        self._record_sparse_wire(e)
+
+    def _record_sparse_wire(self, e):
+        """Bytes-saved accounting vs the densified baseline (model
+        bytes — docs/sparse.md methodology); no-op without a plane."""
+        plane = self._sparse
+        if plane is None:
+            return
+        from .ops import sparse as sparse_mod
+        m = e.sparse
+        world = len(e.process_set.ranks)
+        if world <= 1:
+            # Loopback / world-1: no fabric, nothing is "saved" — the
+            # densified baseline would not have paid wire either.
+            return
+        k = m.nranks or 1
+        nnz_total = sum(int(np.asarray(a).shape[0])
+                        for a in e.arrays[:k])
+        val_isize = np.dtype(m.values_dtype).itemsize
+        idx_isize = np.dtype(m.index_dtype).itemsize
+        plane.record_gather(
+            sparse_mod.dense_wire_bytes(m.dense_shape, val_isize),
+            sparse_mod.gather_wire_bytes(nnz_total,
+                                         sparse_mod.row_elems(
+                                             m.dense_shape),
+                                         val_isize, idx_isize, world,
+                                         codec=m.codec))
 
     def _observe_overlap(self, issued):
         """Metrics-on only: walk the overlap buckets in issue order and

@@ -188,14 +188,26 @@ def make_cross_process_board():
 # zero_allgather): every rank must hold the shard its index owns, and
 # even-split shard shapes must agree — a rank holding the wrong slice
 # reassembles a permuted buffer with no arithmetic error to catch it.
+# "index_dtype"/"dense_shape" cover the sparse gather plane
+# (ops/sparse.py): ranks must agree on the index width and the table
+# geometry they scatter-add into — but nnz is per-rank-varying BY
+# CONSTRUCTION (each rank touches its own rows), so sparse entries
+# publish shapes=None; a naive shape digest would false-abort every
+# healthy sparse step.
 _DIGEST_FIELDS = ("kind", "op", "dtype", "shapes", "process_set",
                   "prescale", "postscale", "root_rank", "codec",
-                  "shard_index", "shard_shape")
+                  "shard_index", "shard_shape", "index_dtype",
+                  "dense_shape")
 
 
 def _codec_digest(entry):
     codec = getattr(entry, "codec", None)
     if codec is None:
+        sparse = getattr(entry, "sparse", None)
+        if sparse is not None and sparse.codec:
+            # Row-quantized values on the sparse gather path: a rank
+            # disagreeing would gather raw floats against int8 payloads.
+            return f"{sparse.codec}@rows"
         return None
     if isinstance(codec, tuple):
         name, block = codec
@@ -243,11 +255,23 @@ def entry_digest(entry):
     of the reference message table's per-rank request record)."""
     dtype = None
     shapes = []
-    for a in entry.arrays:
-        if dtype is None and hasattr(a, "dtype"):
-            dtype = str(a.dtype)
-        shapes.append([int(s) for s in getattr(a, "shape", ())])
-    shard_index, shard_shape = _shard_fields(entry, shapes)
+    index_dtype = dense_shape = None
+    sparse = getattr(entry, "sparse", None)
+    if sparse is not None:
+        # Sparse gather entries: per-rank nnz legitimately differs, so
+        # the array shapes are excluded from the digest; what MUST
+        # agree is the value dtype, the index dtype, and the dense
+        # table shape every rank scatter-adds into.
+        dtype = sparse.values_dtype
+        shapes = None
+        index_dtype = sparse.index_dtype
+        dense_shape = [int(s) for s in sparse.dense_shape]
+    else:
+        for a in entry.arrays:
+            if dtype is None and hasattr(a, "dtype"):
+                dtype = str(a.dtype)
+            shapes.append([int(s) for s in getattr(a, "shape", ())])
+    shard_index, shard_shape = _shard_fields(entry, shapes or [])
     return {
         "kind": entry.kind,
         "op": reduce_ops.op_name(entry.op) if entry.op is not None
@@ -263,6 +287,8 @@ def entry_digest(entry):
         "codec": _codec_digest(entry),
         "shard_index": shard_index,
         "shard_shape": shard_shape,
+        "index_dtype": index_dtype,
+        "dense_shape": dense_shape,
     }
 
 

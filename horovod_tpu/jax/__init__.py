@@ -233,6 +233,10 @@ class DistributedOptimizer:
         return (inner, acc, jnp.zeros((), jnp.int32))
 
     def _reduce(self, grads):
+        from ..ops import sparse as sparse_ops
+        if any(sparse_ops.is_sparse(leaf) for leaf in jax.tree.leaves(
+                grads, is_leaf=sparse_ops.is_sparse)):
+            return self._reduce_with_sparse(grads)
         if self._wire_codec is not None:
             return self._reduce_quantized(grads)
         ctxs = None
@@ -279,6 +283,75 @@ class DistributedOptimizer:
                 treedef, [self.compression.decompress(g, c)
                           for g, c in zip(leaves, ctxs)])
         return out
+
+    def _reduce_with_sparse(self, grads):
+        """Gradient trees carrying :class:`ops.sparse.SparseGradient`
+        leaves (embedding gradients): sparse leaves ride the sparse
+        plane — ``HVDTPU_SPARSE`` picks allgather-of-slices vs
+        densify-then-allreduce per tensor (docs/sparse.md) — and come
+        back DENSE; dense leaves ride the normal reduction unchanged
+        (overlap/compression intact). Cast compression skips sparse
+        leaves (the plane's row-wise int8 wire codec covers their
+        values via the HVDTPU_COMPRESSION name policy instead)."""
+        from ..ops import sparse as sparse_ops
+        leaves, treedef = jax.tree.flatten(
+            grads, is_leaf=sparse_ops.is_sparse)
+        sp_pos = {i for i, leaf in enumerate(leaves)
+                  if sparse_ops.is_sparse(leaf)}
+        dense_leaves = [leaf for i, leaf in enumerate(leaves)
+                        if i not in sp_pos]
+
+        def prescaled(sg):
+            if self.prescale is None:
+                return sg
+            return sparse_ops.SparseGradient(
+                sg.indices,
+                sg.values * jnp.asarray(self.prescale).astype(
+                    sg.values.dtype), sg.dense_shape)
+
+        # Eager SPMD path: submit EVERY sparse leaf async BEFORE the
+        # dense reduction (which synchronizes internally) and before
+        # synchronizing any sparse handle — a blocking call per leaf
+        # would serialize one full coordinator cycle per table, the
+        # sparse fusion groups can only fuse entries that land in the
+        # same cycle batch, and submitting first lets the gathers ride
+        # under the dense collective. (In auto mode the per-leaf
+        # _cohort_nnz sync still blocks per submission — a scalar
+        # allreduce, cheap next to the gather it schedules.) Stable
+        # per-leaf names: the HVDTPU_SPARSE glob rules and the density
+        # EMA key on them.
+        eager_spmd = (self.axis_name is None
+                      and basics.runtime().mode == basics.MODE_SPMD)
+        handles = {}
+        if eager_spmd:
+            for i in sorted(sp_pos):
+                handles[i] = sparse_ops.sparse_allreduce_async(
+                    prescaled(leaves[i]), op=self.op, name=f"grad.sp{i}",
+                    process_set=self.process_set)
+        reduced_dense = iter(self._reduce(dense_leaves)
+                             if dense_leaves else [])
+
+        def red_sparse(sg, i):
+            if i in handles:
+                from ..ops import collectives as _collectives
+                out = _collectives.synchronize(handles[i])
+            elif self.axis_name is not None:
+                out = sparse_ops.sparse_allreduce_axis(
+                    prescaled(sg), self.axis_name, op=self.op,
+                    name=f"grad.sp{i}")
+            else:
+                # Single-controller jit path: the partitioner already
+                # reduced replicated params — densify so optax sees a
+                # dense update.
+                out = prescaled(sg).densify()
+            if self.postscale is not None:
+                out = out * jnp.asarray(self.postscale).astype(out.dtype)
+            return out
+
+        merged = [red_sparse(leaf, i) if i in sp_pos
+                  else next(reduced_dense)
+                  for i, leaf in enumerate(leaves)]
+        return jax.tree.unflatten(treedef, merged)
 
     def _reduce_quantized(self, grads):
         """Wire-codec reduction: both collective legs carry the
@@ -331,6 +404,17 @@ class DistributedOptimizer:
 
     def update(self, grads, state, params=None):
         if self.zero:
+            from ..ops import sparse as sparse_ops
+            if any(sparse_ops.is_sparse(leaf) for leaf in
+                   jax.tree.leaves(grads,
+                                   is_leaf=sparse_ops.is_sparse)):
+                raise ValueError(
+                    "zero=True (HVDTPU_ZERO) does not accept "
+                    "SparseGradient leaves: the ZeRO plan shards the "
+                    "FLAT dense state — densify the gradient, or keep "
+                    "the embedding on the sparse plane's row-sharded "
+                    "state (ops/sparse.plan_row_shards; "
+                    "docs/sparse.md)")
             if self._zero_rt is None:
                 raise RuntimeError(
                     "ZeRO mode: call init(params) (or run through "
@@ -387,6 +471,15 @@ class DistributedOptimizer:
         return updates, (new_inner, new_acc, count)
 
     def _update_aggregated_eager(self, grads, state, params):
+        from ..ops import sparse as sparse_ops
+        # Local aggregation materializes sparse gradients by
+        # construction (the accumulator mirrors the dense params) —
+        # same note as the TF binding's accumulator slots. No wire is
+        # paid here; the reduce on the k-th step is what the sparse
+        # plane would have optimized, and it sees the dense union.
+        grads = jax.tree.map(
+            lambda g: g.densify() if sparse_ops.is_sparse(g) else g,
+            grads, is_leaf=sparse_ops.is_sparse)
         inner_state, acc, count = state
         acc = jax.tree.map(jnp.add, acc, grads)
         count = int(count) + 1

@@ -436,6 +436,7 @@ class DistributedGradientTape:
         self._num_groups = num_groups
         self._groups = groups
         self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
 
     def __getattr__(self, name):
         return getattr(self._tape, name)
@@ -455,7 +456,8 @@ class DistributedGradientTape:
             list(sources), self._num_groups, self._groups)
         return _reduce_grads(grads, self._op, self._process_set,
                              self._predivide, ngroups, group_ids,
-                             compression=self._compression)
+                             compression=self._compression,
+                             sparse_as_dense=self._sparse_as_dense)
 
 
 def _grouping(n, num_groups, group_ids):
@@ -477,22 +479,63 @@ def _grouping(n, num_groups, group_ids):
     return [list(range(n))]
 
 
+def _sparse_allreduce_tf(slices, op, name, process_set):
+    """IndexedSlices through the sparse plane (``sparse_as_dense=False``;
+    ops/sparse.py, docs/sparse.md): the ``HVDTPU_SPARSE`` policy picks
+    allgather-of-slices vs densify-then-allreduce per tensor (with the
+    knob unset every call densifies — the pre-plane path, bit-identical).
+    Returns the DENSE reduced tensor: the transport is sparse, the
+    result is what apply_gradients consumes either way."""
+    tf = _tf()
+    from ..ops import sparse as sparse_ops
+
+    def fn(arrs):
+        idx, vals, shp = arrs
+        sg = sparse_ops.SparseGradient(
+            np.asarray(idx, np.int64), np.asarray(vals),
+            [int(s) for s in np.asarray(shp)])
+        out = sparse_ops.sparse_allreduce(sg, op=op, name=name,
+                                          process_set=process_set)
+        return [_result_np(out)]
+
+    # dense_shape rides as an input so graph mode resolves it at
+    # execution time like the data tensors (py_function boundary).
+    out = _eager(fn, [slices.indices, slices.values,
+                      tf.cast(slices.dense_shape, tf.int64)],
+                 [slices.values.dtype], name)[0]
+    static = tf.get_static_value(tf.convert_to_tensor(
+        slices.dense_shape))
+    if static is not None:
+        out = tf.ensure_shape(out, [int(s) for s in static])
+    return out
+
+
 def _reduce_grads(grads, op, process_set, predivide=1.0, num_groups=0,
-                  group_ids=None, compression=None):
+                  group_ids=None, compression=None,
+                  sparse_as_dense=True):
     tf = _tf()
     dense_idx, dense = [], []
+    result = list(grads)
     for i, g in enumerate(grads):
         if g is None:
             continue
         if isinstance(g, tf.IndexedSlices):
+            if not sparse_as_dense:
+                # The honored sparse_as_dense=False contract: the
+                # slices ride the sparse plane (per-tensor gather vs
+                # densify policy) instead of the unconditional
+                # densification below. Sum/Average only — other ops
+                # reject loudly inside sparse_allreduce.
+                result[i] = _sparse_allreduce_tf(
+                    g, op, f"grad_reduce.sp{i}", process_set)
+                continue
             g = tf.convert_to_tensor(g)
         dense_idx.append(i)
         dense.append(g)
     if not dense:
-        return grads
+        return result
     pre = 1.0 / predivide if predivide != 1.0 else 1.0
     post = predivide / 1.0 if predivide != 1.0 else 1.0
-    result = list(grads)
     sub_ids = None if group_ids is None else \
         [group_ids[i] for i in dense_idx]
     for b, bucket in enumerate(_grouping(len(dense), num_groups, sub_ids)):
@@ -558,9 +601,12 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
     buckets like the reference. ``compression`` (Compression.fp16/bf16)
     shrinks the bytes the host data plane carries per sync.
     ``device_dense``/``device_sparse`` are GPU stream placement in the
-    reference — inert here (XLA owns device placement);
-    ``sparse_as_dense`` likewise: the sync path always densifies
-    IndexedSlices (the reference's sparse_as_dense=True behavior)."""
+    reference — inert here (XLA owns device placement).
+    ``sparse_as_dense=False`` routes IndexedSlices gradients through
+    the sparse plane (ops/sparse.py): the ``HVDTPU_SPARSE`` policy
+    picks allgather-of-slices vs densify per tensor, and the reduced
+    gradient comes back dense; True (default) densifies before the
+    sync, the reference's sparse_as_dense=True behavior."""
     k = int(backward_passes_per_step)
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -605,11 +651,13 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                 if _spmd():
                     # _reduce_grads densifies IndexedSlices only here, on
                     # the sync path — single-rank sparse gradients keep
-                    # the inner optimizer's sparse application.
+                    # the inner optimizer's sparse application. With
+                    # sparse_as_dense=False they ride the sparse plane.
                     grads = _reduce_grads(grads, op, process_set,
                                           gradient_predivide_factor,
                                           ngroups, group_ids,
-                                          compression=compression)
+                                          compression=compression,
+                                          sparse_as_dense=sparse_as_dense)
                 return cls.apply_gradients(self, list(zip(grads, tvars)),
                                            *args, **kwargs)
 

@@ -331,7 +331,15 @@ def sparse_allreduce_async(tensor, name=None, op=None,
     """Average/sum a sparse COO tensor across ranks by allgathering its
     indices and values (reference: horovod/torch/mpi_ops.py:556
     sparse_allreduce_async — same allgather formulation). Returns a handle
-    resolving to a coalesced sparse tensor."""
+    resolving to a coalesced sparse tensor.
+
+    With the sparse plane enabled (``HVDTPU_SPARSE``; docs/sparse.md)
+    and a row-sparse tensor (``sparse_dim == 1`` — the embedding-grad
+    shape), the per-tensor density policy may pick densify-then-
+    allreduce past the crossover: the handle then resolves to a DENSE
+    tensor (gathering most of the table costs more wire than the dense
+    ring; the optimizer routing accepts both). ``coalesce()`` is the
+    local row-deduplication either way."""
     torch = _torch()
     if not tensor.is_sparse:
         raise ValueError("sparse_allreduce_async requires a sparse tensor")
@@ -341,15 +349,39 @@ def sparse_allreduce_async(tensor, name=None, op=None,
         out = tensor.coalesce()
         return _local_handle(out)
     t = tensor.coalesce()
+    from ..ops import sparse as sparse_ops
+    plane = sparse_ops._plane()
+    # Per-call-site auto name, not one shared fallback: a single key
+    # would pool every unnamed sparse tensor into one density EMA (a
+    # 1%-dense table and a 60%-dense one blending to a density wrong
+    # for both) and collide the .idx/.val allgather names of two
+    # in-flight tensors.
+    nm = name or _c._auto_name("sparse_allreduce")
+    if plane is not None and t.sparse_dim() == 1:
+        vals_t = t.values()
+        row_elems = sparse_ops.row_elems(tuple(t.shape))
+        nnz = int(t.indices().shape[1])
+        nset = len(process_set.ranks)
+        if nset > 1 and plane.policy.mode_for_name(nm) == "auto":
+            nnz = sparse_ops._cohort_nnz(nm, nnz, process_set)
+        # world = the cohort the wire spans — the PROCESS SET's size,
+        # not the global job's (ops/sparse.py keys the crossover on
+        # len(process_set.ranks); a sub-cohort's economics differ).
+        path = plane.select(nm, nnz, int(t.shape[0]),
+                            row_elems * vals_t.element_size(),
+                            8, nset)
+        if path == "dense":
+            return allreduce_async(t.to_dense(), name=nm, op=op,
+                                   process_set=process_set)
     idx_np = t.indices().cpu().numpy().T.astype(np.int64)  # (nnz, ndim)
     values_like = t.values()
     val_np, val_bf16 = _to_np(values_like)  # bf16 rides as fp32
-    nm = name or "sparse_allreduce"
     h_idx = _c.allgather_async(idx_np, name=f"{nm}.idx",
                                process_set=process_set)
     h_val = _c.allgather_async(val_np, name=f"{nm}.val",
                                process_set=process_set)
-    world = size()
+    # Average divides by the set of ranks whose slices were gathered.
+    world = len(process_set.ranks)
     shape = list(t.shape)
 
     def resolve():
@@ -613,6 +645,35 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
     optimizer.load_state_dict(synced)
 
 
+def _sparse_grad_handle(param, op, name, process_set, postscale):
+    """Sparse-grad sync for the optimizer hook: SUBMITTED at hook time
+    like the dense path (the hook only fires on the final accumulation
+    pass — the `% backward_passes_per_step` guard — so the grad is
+    already complete, and deferring submission to synchronize() would
+    serialize k tables into k coordinator round-trips that never
+    fuse); the result is written back to ``param.grad`` for the inner
+    step — re-sparsified when the density policy resolved dense, so
+    the layout the inner optimizer sees never flips mid-training."""
+    grad = param.grad
+    if postscale != 1.0:
+        grad = grad * postscale
+    handle = sparse_allreduce_async(grad, name=name, op=op,
+                                    process_set=process_set)
+
+    def resolve():
+        out = synchronize(handle)
+        if grad.is_sparse and not out.is_sparse:
+            # The density policy resolved dense past the crossover: the
+            # WIRE rode the dense ring, but the grad layout the inner
+            # optimizer sees must stay stable across steps — a
+            # sparse-only optimizer (torch.optim.SparseAdam) would
+            # crash the step the EMA crosses d* otherwise.
+            out = out.to_sparse(grad.sparse_dim()).coalesce()
+        param.grad = out
+        return out
+    return _LazyHandle(resolve)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=None, backward_passes_per_step=1,
                          op=Average, gradient_predivide_factor=1.0,
@@ -622,7 +683,19 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     horovod/torch/optimizer.py:36-275): each parameter's
     post-accumulate-grad hook fires an async allreduce; ``step()``
     synchronizes every outstanding handle, writes the averaged gradients
-    back, then runs the inner optimizer."""
+    back, then runs the inner optimizer.
+
+    Sparse gradients (embedding layers with ``sparse=True``):
+    ``sparse_as_dense=True`` densifies them into the dense sync;
+    ``sparse_as_dense=False`` (default, the reference contract) routes
+    them through :func:`sparse_allreduce_async` when ``HVDTPU_SPARSE``
+    is set — the policy picks allgather-of-slices vs densify per
+    tensor (docs/sparse.md); the result written back to ``.grad``
+    stays SPARSE either way, so sparse-only inner optimizers
+    (SparseAdam) survive a mid-training path flip, while optimizers
+    that reject sparse grads (Adam) want ``sparse_as_dense=True``.
+    With the knob unset sparse grads densify exactly as before the
+    plane existed."""
     if compression is Compression.none:
         compression = None
     if getattr(optimizer, "_hvd_wrapped", False):
@@ -664,6 +737,26 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                 if grad is None:
                     return
                 if grad.is_sparse:
+                    # The honored sparse_as_dense contract (reference:
+                    # horovod/torch/optimizer.py): True densifies into
+                    # the normal dense sync; False (default) ROUTES the
+                    # sparse gradient when the sparse plane is on —
+                    # sparse_allreduce_async's policy picks gather vs
+                    # densify per tensor, and step() writes whichever
+                    # form back. With HVDTPU_SPARSE unset the routing
+                    # would hand a SPARSE tensor to inner optimizers
+                    # that reject them (Adam) where the pre-plane code
+                    # always densified — the disabled contract keeps
+                    # that path byte-for-byte.
+                    from ..ops import sparse as _sparse_ops
+                    if not sparse_as_dense and _sparse_ops.enabled():
+                        post = 1.0
+                        if backward_passes_per_step > 1:
+                            post = 1.0 / backward_passes_per_step
+                        self._hvd_handles[param] = _sparse_grad_handle(
+                            param, op, f"grad.{name_of[param]}",
+                            process_set, post)
+                        return
                     grad = grad.to_dense()
                     param.grad = grad
                 pre = 1.0

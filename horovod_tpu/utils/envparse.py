@@ -246,6 +246,24 @@ COMPRESSION_ERROR_FEEDBACK = register(
     "Carry per-tensor quantization error into the next step's "
     "gradient (eager/fusion plane only)")
 
+# -- sparse/embedding gradient plane (docs/sparse.md) ----------------------
+SPARSE = register(
+    "SPARSE", "",
+    "Sparse-gradient path policy: auto/gather/dense or ';'-separated "
+    "'<name-glob>=<mode>' rules, first match wins; auto picks "
+    "allgather-of-slices vs densify-then-allreduce per tensor from the "
+    "EMA-smoothed measured row density against a world-scaled "
+    "crossover. Unset: every sparse gradient densifies (pre-plane "
+    "behavior)")
+SPARSE_THRESHOLD = register(
+    "SPARSE_THRESHOLD", "1.0",
+    "Scales the auto-mode crossover density "
+    "(theta * 2*row_bytes / ((n-1)*(row_bytes+index_bytes)))")
+SPARSE_EMA = register(
+    "SPARSE_EMA", "0.8",
+    "History weight of the per-name density EMA the auto policy "
+    "smooths path decisions with (0 = instantaneous)")
+
 # -- comm/compute overlap (docs/performance.md) ----------------------------
 OVERLAP = register(
     "OVERLAP", "0",

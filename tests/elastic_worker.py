@@ -30,6 +30,22 @@ KILL_EPOCH = int(os.environ.get("ELASTIC_TEST_KILL_EPOCH", "-1"))
 WID = os.environ.get("HVDTPU_WORKER_ID", "static:?")
 KILL_MARKER = LOG + ".killed"
 
+# Sparse chaos row (ISSUE 11): the per-epoch collective is a
+# sparse_allreduce of an embedding-table gradient instead of the dense
+# allreduce — deterministic in (epoch, rank), so recovery re-runs an
+# epoch to the same numbers and a dense-path run (HVDTPU_SPARSE unset)
+# is the exact reference for the gather-path run.
+SPARSE_MODE = os.environ.get("ELASTIC_TEST_SPARSE") == "1"
+SPARSE_ROWS, SPARSE_WIDTH, SPARSE_NNZ = 64, 4, 6
+
+
+def _sparse_grad(epoch, rank):
+    rng = np.random.RandomState(1000 * epoch + rank)
+    idx = rng.choice(SPARSE_ROWS, size=SPARSE_NNZ,
+                     replace=True).astype(np.int32)
+    vals = rng.randn(SPARSE_NNZ, SPARSE_WIDTH).astype(np.float32)
+    return hvd.SparseGradient(idx, vals, (SPARSE_ROWS, SPARSE_WIDTH))
+
 
 def log_line(msg):
     with open(LOG, "a") as f:
@@ -39,14 +55,29 @@ def log_line(msg):
 @elastic.run
 def train(state):
     while state.epoch < EPOCHS:
-        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum,
-                            name=f"step{state.epoch}")
-        # rtol loose enough for the int8-quantized wire format the
-        # compression chaos row runs under (ones quantize exactly up
-        # to one f32 ulp per rank).
-        np.testing.assert_allclose(np.asarray(out), float(hvd.size()),
-                                   rtol=1e-5)
-        state.total = state.total + float(np.asarray(out)[0])
+        if SPARSE_MODE:
+            sg = _sparse_grad(state.epoch, hvd.rank())
+            out = np.asarray(hvd.sparse_allreduce(
+                sg, op=hvd.Sum, name=f"step{state.epoch}"))
+            # Every rank can rebuild the oracle: the sum of every
+            # cohort member's densified gradient for this epoch.
+            expect = np.zeros((SPARSE_ROWS, SPARSE_WIDTH), np.float32)
+            for r in range(hvd.size()):
+                expect += np.asarray(
+                    _sparse_grad(state.epoch, r).densify())
+            np.testing.assert_allclose(out, expect, rtol=1e-4,
+                                       atol=1e-5)
+            state.table = state.table + out
+            state.total = state.total + float(np.abs(out).sum())
+        else:
+            out = hvd.allreduce(jnp.ones(4), op=hvd.Sum,
+                                name=f"step{state.epoch}")
+            # rtol loose enough for the int8-quantized wire format the
+            # compression chaos row runs under (ones quantize exactly
+            # up to one f32 ulp per rank).
+            np.testing.assert_allclose(np.asarray(out),
+                                       float(hvd.size()), rtol=1e-5)
+            state.total = state.total + float(np.asarray(out)[0])
 
         if (WID == KILL_WORKER and state.epoch == KILL_EPOCH
                 and not os.path.exists(KILL_MARKER)):
@@ -64,7 +95,9 @@ def train(state):
 
 def main():
     hvd.init()
-    state = elastic.ObjectState(epoch=0, total=0.0)
+    state = elastic.ObjectState(
+        epoch=0, total=0.0,
+        table=np.zeros((SPARSE_ROWS, SPARSE_WIDTH), np.float32))
     final_epoch = train(state)
     # Compression engagement evidence for the chaos matrix row: name
     # the plane state so the test can assert the quantized path (and
@@ -73,6 +106,16 @@ def main():
     plane = basics.runtime().coordinator._compression
     if plane is not None:
         log_line(f"COMPRESSION residuals={len(plane.residuals)}")
+    if SPARSE_MODE:
+        # Sparse engagement evidence + the recovered embedding table
+        # itself (the chaos row compares it against the dense-path
+        # recovery run).
+        sp = basics.runtime().coordinator._sparse
+        if sp is not None:
+            log_line("SPARSE paths=gather:%d,dense:%d"
+                     % (sp.path_counts["gather"],
+                        sp.path_counts["dense"]))
+        np.save(f"{LOG}.table.rank{hvd.rank()}.npy", state.table)
     log_line(f"DONE epoch={final_epoch} rank={hvd.rank()} "
              f"size={hvd.size()} total={state.total}")
 

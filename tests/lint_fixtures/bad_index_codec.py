@@ -1,0 +1,29 @@
+"""Fixture: lossy codec on index tensors (HVD209 x3, docs/lint.md)."""
+import horovod_tpu as hvd
+
+hvd.init()
+
+grad = embedding_grad()          # IndexedSlices-style sparse gradient
+table = load_table()
+
+# HVD209: the indices half of a sparse gradient through a lossy codec —
+# a rounded row id scatter-adds into the WRONG row, silently.
+hvd.allreduce(grad.indices, op=hvd.Sum, compression=hvd.Compression.int8)
+
+# HVD209: index-producing construction (argsort) one hop away.
+perm = table.argsort()
+hvd.allgather(perm, compression=hvd.Compression.fp16)
+
+# HVD209: torch COO spelling of the indices half.
+hvd.allreduce(grad._indices(), op=hvd.Sum,
+              compression=hvd.Compression.int8)
+
+# Fine: the VALUES half is exactly what the wire codec is for.
+hvd.allreduce(grad.values, op=hvd.Average,
+              compression=hvd.Compression.int8)
+
+# Fine: indices without compression ride exact.
+hvd.allgather(grad.indices)
+
+# Fine: a dense float gradient through the codec.
+hvd.allreduce(table, op=hvd.Average, compression=hvd.Compression.int8)

@@ -363,6 +363,62 @@ def test_compression_recovery_matches_uncompressed(tmp_path):
     np.testing.assert_allclose(q_totals, plain_totals, atol=1e-3)
 
 
+def test_sparse_recovery_matches_dense_path(tmp_path):
+    """Sparse row (ISSUE 11): the injected collective failure (rank 1's
+    epoch-3 op raises once) fires during a sparse-allgather step under
+    HVDTPU_SPARSE=auto. Elastic recovery must complete exactly as the
+    dense rows do, the gather path must have actually engaged (the
+    SPARSE log line — auto at this density/world resolves gather, not a
+    silent densify), and the post-recovery embedding table must match
+    the uncompressed dense-path recovery run (HVDTPU_SPARSE unset: the
+    pre-plane densified transport) within fp tolerance — the gather
+    scatter-add and the densified allreduce may order their f32 sums
+    differently, nothing more."""
+
+    def run(sub, sparse_spec):
+        sub.mkdir()
+        extra = {"ELASTIC_TEST_EPOCHS": 6, "ELASTIC_TEST_EPOCH_SLEEP": 0.3,
+                 "ELASTIC_TEST_SPARSE": "1"}
+        if sparse_spec:
+            extra["HVDTPU_SPARSE"] = sparse_spec
+        marker = sub / "collective.marker"
+        rc, driver, log_path, _ = _run_chaos_job(
+            sub, f"collective:fail:name=step3:rank=1:marker={marker}",
+            **extra)
+        content = _log_content(log_path)
+        assert rc == 0, content
+        assert marker.exists()  # the failure fired mid-sparse-step
+        assert driver.blacklist == set()
+        done = [line for line in content.splitlines() if "DONE" in line]
+        assert len(done) == 2, content
+        entries = _parse_log(log_path)
+        assert max(e[1] for e in entries) == 5
+        tables = sorted(
+            str(p) for p in sub.iterdir()
+            if p.name.startswith("log.table.rank"))
+        assert len(tables) == 2, (tables, content)
+        t0, t1 = (np.load(t) for t in tables)
+        # Post-recovery cross-rank agreement: both workers hold the
+        # same table.
+        np.testing.assert_allclose(t0, t1, atol=1e-5)
+        return t0, content
+
+    auto_table, auto_content = run(tmp_path / "auto", "auto")
+    # Engagement: the gather transport really carried steps (auto at
+    # 64-row/6-nnz density and n=2 resolves gather; a silent densify
+    # would make this row vacuous).
+    sp_lines = [line for line in auto_content.splitlines()
+                if "SPARSE paths=" in line]
+    assert len(sp_lines) == 2, auto_content
+    assert all("gather:0" not in line for line in sp_lines), sp_lines
+
+    dense_table, dense_content = run(tmp_path / "dense", None)
+    # Knob unset: no plane, no engagement line content with gather>0.
+    for line in dense_content.splitlines():
+        assert "SPARSE paths=" not in line, line
+    np.testing.assert_allclose(auto_table, dense_table, atol=1e-4)
+
+
 def test_stall_abort_leaves_postmortem_bundle_and_merged_trace(tmp_path):
     """Tracing row (ISSUE 8): the stall-abort scenario re-run with the
     cross-rank trace plane on (HVDTPU_TRACE=1 + the default flight

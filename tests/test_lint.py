@@ -209,6 +209,39 @@ class TestAstRules:
                "op=hvd.Adasum)  # hvd-lint: disable=HVD208\n")
         assert ast_lint.lint_source(src) == []
 
+    def test_index_codec_fixture(self):
+        diags = self.lint("bad_index_codec.py")
+        assert rules_of(diags) == ["HVD209", "HVD209", "HVD209"]
+        assert [d.line for d in diags] == [11, 15, 18]
+        msgs = " ".join(d.message for d in diags)
+        assert "index tensor" in msgs
+
+    def test_index_codec_values_half_is_clean(self):
+        # The values half of a sparse gradient is exactly what a wire
+        # codec is for — never an HVD209 finding.
+        src = ("import horovod_tpu as hvd\n"
+               "g = grad()\n"
+               "hvd.allreduce(g.values, "
+               "compression=hvd.Compression.int8)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_index_codec_int_dtype_stays_hvd205(self):
+        # An index tensor with a VISIBLE int dtype is HVD205's finding;
+        # the rules dedup — never both on one call.
+        src = ("import jax.numpy as jnp\n"
+               "import horovod_tpu as hvd\n"
+               "idx = jnp.zeros((4,), dtype=jnp.int32)\n"
+               "hvd.allreduce(idx.argsort(), "
+               "compression=hvd.Compression.int8)\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD205"]
+
+    def test_index_codec_suppressible(self):
+        src = ("import horovod_tpu as hvd\n"
+               "hvd.allreduce(g.indices, "
+               "compression=hvd.Compression.int8)"
+               "  # hvd-lint: disable=HVD209\n")
+        assert ast_lint.lint_source(src) == []
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"
