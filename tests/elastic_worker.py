@@ -38,6 +38,18 @@ KILL_MARKER = LOG + ".killed"
 SPARSE_MODE = os.environ.get("ELASTIC_TEST_SPARSE") == "1"
 SPARSE_ROWS, SPARSE_WIDTH, SPARSE_NNZ = 64, 4, 6
 
+# Autotune chaos row (ISSUE 12): after training, drive a FIXED number
+# of extra allreduces so the post-recovery cohort's tuner converges,
+# then log the applied-knob sequence for the cross-rank divergence
+# assertion. The drive count is fixed (not `while tuner.enabled`) on
+# purpose: the convergence flag flips on the cycle thread, so a
+# condition-driven loop could make one rank submit a collective its
+# peer never does — a fixed count keeps the submission schedules
+# identical by construction.
+AUTOTUNE_MODE = os.environ.get("ELASTIC_TEST_AUTOTUNE") == "1"
+AUTOTUNE_DRIVE_STEPS = int(os.environ.get("ELASTIC_TEST_AUTOTUNE_STEPS",
+                                          "60"))
+
 
 def _sparse_grad(epoch, rank):
     rng = np.random.RandomState(1000 * epoch + rank)
@@ -116,6 +128,18 @@ def main():
                      % (sp.path_counts["gather"],
                         sp.path_counts["dense"]))
         np.save(f"{LOG}.table.rank{hvd.rank()}.npy", state.table)
+    if AUTOTUNE_MODE:
+        import json as _json
+        tuner = basics.runtime().autotuner
+        assert tuner is not None, "HVDTPU_AUTOTUNE=1 must create the tuner"
+        for i in range(AUTOTUNE_DRIVE_STEPS):
+            out = hvd.allreduce(jnp.ones(4), op=hvd.Sum,
+                                name=f"tune{i % 3}")
+            np.testing.assert_allclose(np.asarray(out)[0],
+                                       float(hvd.size()), rtol=1e-5)
+        log_line("AUTOTUNE converged=%d best=%s applied=%s"
+                 % (0 if tuner.enabled else 1, tuner.best,
+                    _json.dumps(tuner.applied)))
     log_line(f"DONE epoch={final_epoch} rank={hvd.rank()} "
              f"size={hvd.size()} total={state.total}")
 

@@ -504,6 +504,61 @@ def test_stall_abort_leaves_postmortem_bundle_and_merged_trace(tmp_path):
     assert "straggler attribution" in text
 
 
+def test_autotune_resweep_after_midsweep_elastic_reset(tmp_path):
+    """Autotune row (ISSUE 12): the injected collective failure fires
+    on rank 1's epoch-3 allreduce while the online tuner is mid-sweep
+    (warmup 1, tiny grid — candidates are being scored by epoch 2).
+    The elastic reset must complete recovery, the NEW cohort's fresh
+    tuner must re-sweep and re-agree on ONE candidate, and the two
+    workers' applied-knob sequences must be identical end to end (the
+    cross-rank determinism contract under real process churn) — with
+    the guardian's per-collective digests enabled and clean
+    throughout."""
+    marker = tmp_path / "collective.marker"
+    rc, driver, log_path, chaos_log = _run_chaos_job(
+        tmp_path,
+        f"collective:fail:name=step3:rank=1:marker={marker}",
+        capture_output=True,
+        ELASTIC_TEST_EPOCHS=6, ELASTIC_TEST_EPOCH_SLEEP=0.3,
+        ELASTIC_TEST_AUTOTUNE="1",
+        HVDTPU_AUTOTUNE="1",
+        HVDTPU_AUTOTUNE_FUSION_CANDIDATES_MIB="1,2",
+        HVDTPU_AUTOTUNE_CYCLE_CANDIDATES_MS="0.5",
+        HVDTPU_AUTOTUNE_WARMUP_CYCLES="1",
+        HVDTPU_AUTOTUNE_CYCLES_PER_CANDIDATE="2",
+        HVDTPU_CONSISTENCY_CHECK="1")
+    content = _log_content(log_path)
+    assert rc == 0, content
+    assert marker.exists()   # the failure really fired mid-sweep
+    assert driver.blacklist == set()
+    done = [line for line in content.splitlines() if "DONE" in line]
+    assert len(done) == 2, content
+    entries = _parse_log(log_path)
+    assert max(e[1] for e in entries) == 5
+
+    # Both members of the post-recovery cohort converged on ONE
+    # candidate, via the identical applied-knob sequence: knob
+    # application stayed cycle-deterministic + rank-0-broadcast across
+    # a real membership reset.
+    tune_lines = [line for line in content.splitlines()
+                  if "AUTOTUNE " in line]
+    assert len(tune_lines) == 2, content
+    payloads = sorted(line.partition("AUTOTUNE ")[2]
+                      for line in tune_lines)
+    assert all(p.startswith("converged=1 ") for p in payloads), payloads
+    assert payloads[0] == payloads[1], (
+        "cross-rank knob divergence after the elastic reset:\n"
+        + "\n".join(payloads))
+    applied = json.loads(payloads[0].partition("applied=")[2])
+    assert len(applied) >= 2 and all(p == "host" for p, _ in applied), \
+        applied
+
+    # Guardian digests stayed clean: the consistency check ran the
+    # whole job without a single cross-rank mismatch abort.
+    stderr = _captured_stderr(tmp_path)
+    assert "CollectiveMismatchError" not in stderr, stderr[-4000:]
+
+
 def test_collective_failure_injection_recovers(tmp_path):
     """Bonus row: an injected collective failure (the 'collective'
     point raising HorovodInternalError once, on rank 1's epoch-3
