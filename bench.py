@@ -701,6 +701,149 @@ def _bench_sparse(hvd, on_tpu):
     return out_rows, summary
 
 
+def _bench_serving(hvd, on_tpu):
+    """`--serving` lane (ISSUE 13; docs/serving.md): closed-loop load
+    generator against the full serving stack — KV store + 2 in-process
+    continuous-batching workers + router, all over real HTTP — at 3
+    offered-load points. Arrivals are Poisson (exponential gaps, seeded
+    RNG) over a prompt/output-length mix; every request is a raw
+    client (no 429 retry), so the rejection rate IS the backpressure
+    the stack sheds at that load.
+
+    METHODOLOGY (CPU stand-in): the ToyLM decode step is padded to
+    DECODE_DELAY_S to stand in for a real model's step time — latency
+    and tokens/s scale with it, but the SHAPE of the curve (p99 growth
+    then rejection onset as offered load crosses capacity) is the
+    serving plane's own behavior: admission watermark, queue bound,
+    batch recomposition. Archived to BENCH_r11.json."""
+    import json as _json
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from horovod_tpu.runner.http_server import (AUTH_HEADER,
+                                                KVStoreServer,
+                                                new_job_token)
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.router import Router
+    from horovod_tpu.serving.worker import ServingWorker
+
+    DECODE_DELAY_S = 0.01
+    WINDOW_S = 3.0
+    LOADS_RPS = (15, 45, 135)
+    PROMPTS = ((2, 0.5), (6, 0.3), (12, 0.2))
+    NEW_TOKENS = ((4, 0.5), (8, 0.3), (16, 0.2))
+
+    class PacedToyLM(ToyLM):
+        def decode(self, contexts):
+            time.sleep(DECODE_DELAY_S)
+            return super().decode(contexts)
+
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    workers, rows = [], []
+    try:
+        for wid in range(2):
+            w = ServingWorker(PacedToyLM(), cohort="c0", wid=wid,
+                              num_pages=24, page_size=2,
+                              queue_limit=8,
+                              max_batch_tokens=128).start()
+            port = w.serve_http(addr="127.0.0.1", token=token)
+            w.register("127.0.0.1", kv_port, token,
+                       advertise=f"127.0.0.1:{port}")
+            workers.append(w)
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        router.refresh_from_kv(["c0"])
+        rport = router.serve_http(addr="127.0.0.1", token=token)
+
+        def one_request(prompt_len, max_new, record):
+            body = _json.dumps({"prompt": [1] * prompt_len,
+                                "max_new_tokens": max_new}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rport}/v1/generate", data=body,
+                method="POST")
+            req.add_header(AUTH_HEADER, token)
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    out = _json.loads(resp.read())
+                    record.append(("ok", time.monotonic() - t0,
+                                   len(out["tokens"])))
+            except urllib.error.HTTPError as e:
+                kind = "rejected" if e.code == 429 else "error"
+                record.append((kind, time.monotonic() - t0, 0))
+            except Exception:  # noqa: BLE001 — counted, not raised
+                record.append(("error", time.monotonic() - t0, 0))
+
+        def pick(rng, mix):
+            vals, weights = zip(*mix)
+            return int(rng.choice(vals, p=np.asarray(weights)
+                                  / sum(weights)))
+
+        for load in LOADS_RPS:
+            rng = np.random.RandomState(load)
+            record, threads = [], []
+            t_start = time.monotonic()
+            t_next = t_start
+            while t_next < t_start + WINDOW_S:
+                gap = rng.exponential(1.0 / load)
+                t_next += gap
+                now = time.monotonic()
+                if t_next > now:
+                    time.sleep(t_next - now)
+                th = threading.Thread(
+                    target=one_request,
+                    args=(pick(rng, PROMPTS), pick(rng, NEW_TOKENS),
+                          record))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=90)
+            span = time.monotonic() - t_start
+            lat = sorted(t for kind, t, _ in record if kind == "ok")
+            tokens = sum(tk for kind, _, tk in record if kind == "ok")
+            rejected = sum(1 for kind, _, _ in record
+                           if kind == "rejected")
+            errors = sum(1 for kind, _, _ in record if kind == "error")
+            q = (lambda p: lat[min(len(lat) - 1,
+                                   int(p * len(lat)))]) if lat \
+                else (lambda p: None)
+            rows.append({
+                "benchmark": "serving_closed_loop",
+                "offered_rps": load,
+                "offered": len(record),
+                "completed": len(lat),
+                "rejected": rejected,
+                "errors": errors,
+                "rejection_rate": round(rejected / max(len(record), 1),
+                                        4),
+                "p50_latency_s": round(q(0.50), 4) if lat else None,
+                "p99_latency_s": round(q(0.99), 4) if lat else None,
+                "tokens_per_sec": round(tokens / span, 1),
+                "window_s": round(span, 2),
+            })
+        router.stop_http()
+    finally:
+        for w in workers:
+            w.stop()
+        kv.stop()
+    summary = {
+        "hosts": 2,
+        "decode_step_delay_s": DECODE_DELAY_S,
+        "knobs": {"num_pages": 24, "page_size": 2, "queue_limit": 8,
+                  "max_batch_tokens": 128},
+        "loads_rps": list(LOADS_RPS),
+        "rejection_onset": next(
+            (r["offered_rps"] for r in rows if r["rejected"]), None),
+        "zero_error_requests": all(r["errors"] == 0 for r in rows),
+    }
+    return rows, summary
+
+
 def _bench_keras(hvd, on_tpu):
     """Keras-3 frontend with model math compiled onto the chip
     (set_data_parallel: one XLA program per train step, batch sharded over
@@ -1111,6 +1254,30 @@ def main():
             raise
         except Exception as e:  # noqa: BLE001 — best-effort lane
             print(f"# bench: sparse lane failed: {e!r}",
+                  file=sys.stderr, flush=True)
+    # --serving: closed-loop load generator over the serving plane
+    # (router + 2 continuous-batching workers over real HTTP) at 3
+    # offered loads; p50/p99 latency, tokens/s and rejection rate
+    # archived as BENCH_r11.json (ISSUE 13, docs/serving.md).
+    if "--serving" in sys.argv:
+        try:
+            rows, summary = _bench_serving(hvd, on_tpu)
+            for row in rows:
+                print(json.dumps(row), flush=True)
+            with open("BENCH_r11.json", "w") as f:
+                json.dump({"cmd": "python bench.py --serving",
+                           "rows": rows, "summary": summary}, f,
+                          indent=1)
+            print("# bench: serving load sweep archived to "
+                  "BENCH_r11.json", file=sys.stderr, flush=True)
+            assert summary["zero_error_requests"], (
+                "serving lane saw transport/5xx errors — backpressure "
+                "must reject with 429, never fail accepted requests "
+                "(BENCH_r11.json has the sweep)")
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — best-effort lane
+            print(f"# bench: serving lane failed: {e!r}",
                   file=sys.stderr, flush=True)
     # --autotune: default vs converged vs warm-started A/B of the
     # trace-driven online tuner (ISSUE 12, docs/autotune.md), archived

@@ -46,6 +46,14 @@ a static finding. Three rules:
   plane, and its disabled mode reads no clock at all. ``monotonic`` /
   ``perf_counter_ns`` pairs and elapsed values that go to logs (not
   metrics) are not findings.
+- **HVD210** (warning) — an *unbounded* request buffer in serving
+  context (a file under ``serving/``, a class named
+  scheduler/router/serving, or a ``handle_*`` request handler): a bare
+  ``queue.Queue()``/``SimpleQueue()``, a ``deque()`` without
+  ``maxlen``, or ``.append()`` onto a request-named list. The serving
+  plane's backpressure contract is bounded-queues-or-429
+  (docs/serving.md); an unbounded buffer absorbs overload into memory
+  and tail latency where nothing can shed it.
 
 The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
 analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
@@ -949,6 +957,174 @@ class _RawTimingAnalyzer:
 
 
 # ==========================================================================
+# HVD210: unbounded request buffering in serving code
+# ==========================================================================
+
+class _RequestBufferAnalyzer:
+    """HVD210 over one module: in serving context — a file under
+    ``serving/``, a class whose name says scheduler/router/serving, or
+    a ``handle_*`` request handler — flag request buffers with no
+    bound: a bare ``queue.Queue()``/``queue.SimpleQueue()``, a
+    ``deque()`` without ``maxlen``, or ``.append()`` onto a
+    request-named list. The serving plane's backpressure contract
+    (docs/serving.md) is that the *only* wait station is a bounded
+    queue whose overflow answers 429 + Retry-After; any unbounded
+    buffer silently converts overload into memory growth and tail
+    latency instead of a reject the client can act on."""
+
+    _CTX_CLASS_RE = re.compile(r"scheduler|router|serving", re.IGNORECASE)
+    _CTX_FUNC_RE = re.compile(r"^handle_", re.IGNORECASE)
+    _BUF_NAME_RE = re.compile(
+        r"request|pending|backlog|queue|inbox|waiting", re.IGNORECASE)
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        parts = os.path.normpath(filename).split(os.sep)
+        self._serving_file = "serving" in parts
+        self._queue_ctors = set()    # local names of queue.Queue et al.
+        self._deque_ctors = set()
+        self._buffers = {}           # unparsed target -> assign lineno
+
+    # -- import bookkeeping ------------------------------------------------
+    def _note_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "queue":
+                    for a in node.names:
+                        if a.name in ("Queue", "LifoQueue",
+                                      "PriorityQueue", "SimpleQueue"):
+                            self._queue_ctors.add(a.asname or a.name)
+                elif node.module == "collections":
+                    for a in node.names:
+                        if a.name == "deque":
+                            self._deque_ctors.add(a.asname or a.name)
+
+    def _ctor_kind(self, call):
+        """'queue' / 'deque' / None for a constructor call node."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value,
+                                                        ast.Name):
+            if fn.value.id == "queue" and fn.attr in (
+                    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"):
+                return "queue"
+            if fn.value.id == "collections" and fn.attr == "deque":
+                return "deque"
+        elif isinstance(fn, ast.Name):
+            if fn.id in self._queue_ctors:
+                return "queue"
+            if fn.id in self._deque_ctors:
+                return "deque"
+        return None
+
+    @staticmethod
+    def _is_unbounded(kind, call):
+        """True when the constructor carries no effective bound."""
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "SimpleQueue":
+            return True  # SimpleQueue has no maxsize at all
+        if isinstance(call.func, ast.Name) \
+                and call.func.id == "SimpleQueue":
+            return True
+        bound_kw = "maxsize" if kind == "queue" else "maxlen"
+        bound_pos = 0 if kind == "queue" else 1
+        candidates = []
+        if len(call.args) > bound_pos:
+            candidates.append(call.args[bound_pos])
+        candidates.extend(kw.value for kw in call.keywords
+                          if kw.arg == bound_kw)
+        for value in candidates:
+            if isinstance(value, ast.Constant) \
+                    and value.value in (0, None):
+                continue  # explicit "infinite" spelling
+            return False  # some bound expression is present
+        return True
+
+    def _report(self, node, what):
+        self.diags.append(Diagnostic.make(
+            "HVD210",
+            f"{what} in serving scheduler/handler code: overload "
+            "becomes unbounded memory growth and tail latency instead "
+            "of backpressure the client can act on",
+            file=self.filename, line=node.lineno,
+            hint="bound the buffer (queue.Queue(maxsize=...) sized by "
+                 "HVDTPU_SERVING_QUEUE_LIMIT, deque(maxlen=...)) and "
+                 "answer 429 + Retry-After when full — see "
+                 "docs/serving.md \"Backpressure\"; suppress with "
+                 "`# hvd-lint: disable=HVD210` only for buffers whose "
+                 "growth is bounded elsewhere; " + _DOC_HINT))
+
+    # -- context walk ------------------------------------------------------
+    def run(self, tree):
+        self._note_imports(tree)
+        self._walk(tree.body, self._serving_file)
+        return self.diags
+
+    def _walk(self, stmts, ctx):
+        for node in stmts:
+            node_ctx = ctx
+            if isinstance(node, ast.ClassDef):
+                node_ctx = ctx or bool(
+                    self._CTX_CLASS_RE.search(node.name))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                node_ctx = ctx or bool(
+                    self._CTX_FUNC_RE.search(node.name))
+            if node_ctx:
+                self._scan_statement(node)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                children = getattr(node, field, None)
+                if not children:
+                    continue
+                if field == "handlers":
+                    for h in children:
+                        self._walk(h.body, node_ctx)
+                else:
+                    self._walk(children, node_ctx)
+
+    def _scan_statement(self, stmt):
+        """One SIMPLE statement — compound statements contribute
+        through their bodies, which the context walk owns (so nothing
+        is scanned twice)."""
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.Expr,
+                                 ast.Return, ast.AugAssign)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_assign(stmt)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+
+    def _scan_assign(self, node):
+        value = node.value
+        if isinstance(value, ast.Call):
+            kind = self._ctor_kind(value)
+            if kind and self._is_unbounded(kind, value):
+                ctor = _unparse(value.func)
+                self._report(
+                    node, f"unbounded `{ctor}()` request buffer")
+                return
+        if isinstance(value, (ast.List, ast.ListComp)) or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "list"):
+            for target in node.targets:
+                name = _unparse(target)
+                if self._BUF_NAME_RE.search(name.split(".")[-1]):
+                    self._buffers[name] = node.lineno
+
+    def _scan_call(self, node):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"):
+            return
+        owner = _unparse(node.func.value)
+        if owner in self._buffers:
+            self._report(
+                node, f"`{owner}.append(...)` grows a request list "
+                      "without bound")
+
+
+# ==========================================================================
 # HVD3xx: concurrency & liveness (the static half of hvd-sanitize)
 # ==========================================================================
 
@@ -1408,6 +1584,7 @@ def lint_source(src, filename="<string>"):
     analyzer.visit(tree)
     diags = analyzer.finish()
     diags.extend(_RawTimingAnalyzer(filename).run(tree))
+    diags.extend(_RequestBufferAnalyzer(filename).run(tree))
     diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)
     return dedupe(sorted(diags, key=Diagnostic.sort_key))

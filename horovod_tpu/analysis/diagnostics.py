@@ -52,6 +52,10 @@ RULES = {
                         "(indices must be exact — a rounded row id "
                         "scatter-adds into the wrong row with no "
                         "arithmetic error to catch it)"),
+    "HVD210": (WARNING, "unbounded request buffering (bare "
+                        "queue.Queue()/deque()/list-append) in serving "
+                        "scheduler/router/handler code — backpressure "
+                        "requires bounded queues that reject when full"),
     # -- interprocedural schedule verifier (hvd-lint verify) ---------------
     "HVD401": (ERROR, "collective reachable under rank-tainted control "
                       "flow through any call depth (the whole-program "

@@ -12,6 +12,12 @@ The server also exposes the metrics plane: ``GET /metrics`` serves the
 local telemetry registry as Prometheus text plus the cluster roll-up of
 worker-pushed rank snapshots, ``GET /metrics.json`` the raw snapshots —
 both behind the same job token (docs/metrics.md).
+
+The serving plane rides the same server (docs/serving.md): attaching a
+``serving_router`` or ``serving_worker`` (``attach_serving``) enables
+the token-gated ``POST /v1/generate``, ``GET /v1/serving/stats`` and
+``POST /v1/serving/drain`` routes — the router and every serving
+worker host their HTTP surface through this one handler.
 """
 
 import secrets
@@ -43,9 +49,60 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
             return False
         return True
 
+    def _serving_target(self):
+        """The attached serving endpoint: the router when one is
+        attached, else the worker, else None (routes answer 404)."""
+        return (getattr(self.server, "serving_router", None)
+                or getattr(self.server, "serving_worker", None))
+
+    def _reply_json(self, code, obj):
+        import json as _json
+        body = _json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        if code == 429:
+            # Backpressure contract (docs/serving.md): clients are told
+            # when to come back instead of hammering the queue limit.
+            self.send_header(
+                "Retry-After",
+                str(obj.get("retry_after", 1.0) if isinstance(obj, dict)
+                    else 1.0))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802
+        """Serving-plane routes: /v1/generate, /v1/serving/drain."""
+        if not self._authorized():
+            return
+        import json as _json
+        target = self._serving_target()
+        if self.path not in ("/v1/generate", "/v1/serving/drain") \
+                or target is None:
+            return self._reply(404, b"")
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length)
+        try:
+            payload = _json.loads(raw) if raw else {}
+        except ValueError:
+            return self._reply_json(400, {"error": "bad JSON body"})
+        if not isinstance(payload, dict):
+            return self._reply_json(
+                400, {"error": "bad JSON body: must be an object"})
+        if self.path == "/v1/generate":
+            code, body = target.handle_generate(payload)
+        else:
+            code, body = target.handle_drain(payload)
+        self._reply_json(code, body)
+
     def do_GET(self):  # noqa: N802 (http.server API)
         if not self._authorized():
             return
+        if self.path == "/v1/serving/stats":
+            target = self._serving_target()
+            if target is None:
+                return self._reply(404, b"")
+            return self._reply_json(200, target.stats())
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 1 and parts[0] in ("metrics", "metrics.json"):
             return self._serve_metrics(parts[0] == "metrics.json")
@@ -143,10 +200,23 @@ class KVStoreServer:
         self._thread = None
         self.job_token = job_token
         self.verbose = verbose
+        self.serving_worker = None
+        self.serving_router = None
 
     @property
     def port(self):
         return self._httpd.server_address[1] if self._httpd else None
+
+    def attach_serving(self, worker=None, router=None):
+        """Attach a serving worker/router; enables the /v1 routes
+        (callable before or after start())."""
+        if worker is not None:
+            self.serving_worker = worker
+        if router is not None:
+            self.serving_router = router
+        if self._httpd is not None:
+            self._httpd.serving_worker = self.serving_worker
+            self._httpd.serving_router = self.serving_router
 
     def start(self):
         self._httpd = ThreadingHTTPServer((self._addr, 0), _KVStoreHandler)
@@ -154,6 +224,8 @@ class KVStoreServer:
         self._httpd.store_lock = threading.Lock()
         self._httpd.job_token = self.job_token
         self._httpd.verbose = self.verbose
+        self._httpd.serving_worker = self.serving_worker
+        self._httpd.serving_router = self.serving_router
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="hvdtpu-kvstore")

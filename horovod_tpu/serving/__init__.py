@@ -1,0 +1,46 @@
+"""horovod_tpu.serving: multi-host continuous-batching inference.
+
+The "millions of users" pillar of the north star (ROADMAP item 1): a
+request router on the existing runner HTTP/KV plane feeding per-host
+continuous-batching workers, with bounded queues and backpressure end
+to end, a paged KV cache with watermark admission and preemption +
+recompute-on-resume, sharded model state loaded via the ZeRO-1 plan
+geometry (``load_for_inference``), elastic autoscaling of serving
+cohorts from queue-depth/latency signals, and SLO telemetry
+(``hvd_serving_*`` families, docs/metrics.md).
+
+Layers (docs/serving.md has the full architecture):
+
+- :mod:`kv_cache`   — fixed-size page pool per host, page tables per
+  sequence, watermark admission, preemption frees pages.
+- :mod:`scheduler`  — continuous batching: prefill admission interleaved
+  with in-flight decode steps, the batch recomposed every step.
+- :mod:`model`      — the ``ModelAdapter`` contract + the deterministic
+  ``ToyLM`` stand-in tests/bench serve.
+- :mod:`worker`     — per-host serving loop, HTTP surface, KV-plane
+  registration + stats push.
+- :mod:`router`     — assigns requests to host cohorts, 429 +
+  Retry-After past the queue limit, re-routes streams off dead workers.
+- :mod:`state`      — ``load_for_inference``: train (mesh, layout) →
+  inference layout on the ZeRO plan geometry, gather-free where shapes
+  allow (the 2112.01075 redistribution paving stone).
+- :mod:`autoscale`  — queue-depth/latency driven cohort scale-up and
+  drain-first scale-down.
+
+Enable with ``HVDTPU_SERVING=1`` (all knobs: docs/knobs.md). CLI:
+``hvd-serve route|stats|drain``.
+"""
+
+from .kv_cache import PagePool, PageTable, PoolExhausted  # noqa: F401
+from .model import ModelAdapter, ToyLM  # noqa: F401
+from .scheduler import Request, Scheduler, SequenceResult  # noqa: F401
+from .worker import ServingWorker  # noqa: F401
+from .router import Router, WorkerClient, InProcClient  # noqa: F401
+from .autoscale import Autoscaler  # noqa: F401
+
+
+def load_for_inference(*args, **kwargs):
+    """Lazy re-export of :func:`state.load_for_inference` (the state
+    module imports jax; the serving hot path does not need it)."""
+    from .state import load_for_inference as _impl
+    return _impl(*args, **kwargs)

@@ -1,0 +1,165 @@
+"""Paged KV cache: fixed-size page pool per host, page tables per
+sequence.
+
+The KV cache is the scarce resource of continuous batching: every
+in-flight sequence owns ceil(tokens / page_size) pages of attention
+state, and admission control is what keeps the pool from thrashing. The
+design is the paged-attention formulation — a fixed pool of fixed-size
+pages, per-sequence page tables mapping logical token positions to
+physical pages — with two policies layered on top:
+
+- **watermark admission**: a prefill is admitted only when the pool
+  would keep ``watermark`` free pages after allocating the prompt; the
+  reserve is what lets already-running sequences keep growing during
+  decode instead of deadlocking against new arrivals.
+- **preemption**: when decode growth does exhaust the pool, the
+  scheduler frees a victim sequence's pages wholesale
+  (:meth:`PageTable.release`) and re-runs its prefill when pages free
+  up — recompute-on-resume, cheaper in page-pool pressure than swapping
+  KV state to host memory and exact for deterministic models.
+
+The pool optionally carries real per-token payload (``kv_dim`` > 0):
+:meth:`PageTable.append` writes KV vectors into page slots and
+:meth:`PageTable.gather` reads the sequence's context back in token
+order. Tests and the ToyLM decode through this path, so paging is data
+movement, not just bookkeeping.
+"""
+
+import threading
+
+import numpy as np
+
+from . import metrics as _m
+
+#: Default reserve fraction: admission keeps 1/16 of the pool free.
+WATERMARK_FRACTION = 16
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by :meth:`PagePool.alloc` when the pool cannot satisfy an
+    allocation; the scheduler catches it and preempts."""
+
+
+class PagePool:
+    """Fixed pool of ``num_pages`` pages, ``page_size`` token slots
+    each. Thread-safe; the free list is LIFO so hot pages stay hot."""
+
+    def __init__(self, num_pages, page_size, kv_dim=0, watermark=None):
+        num_pages = int(num_pages)
+        page_size = int(page_size)
+        if num_pages < 1 or page_size < 1:
+            raise ValueError(
+                f"page pool needs >=1 pages of >=1 tokens, got "
+                f"{num_pages} x {page_size}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.kv_dim = int(kv_dim)
+        if watermark is None:
+            watermark = max(1, num_pages // WATERMARK_FRACTION)
+        if watermark >= num_pages:
+            raise ValueError(
+                f"watermark {watermark} leaves no usable pages of "
+                f"{num_pages}")
+        self.watermark = int(watermark)
+        self._lock = threading.Lock()
+        self._free = list(range(num_pages - 1, -1, -1))
+        self.data = (np.zeros((num_pages, page_size, self.kv_dim),
+                              np.float32)
+                     if self.kv_dim else None)
+        _m.kv_pages_free().set(num_pages)
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def free_pages(self):
+        with self._lock:
+            return len(self._free)
+
+    def pages_needed(self, tokens):
+        return -(-int(tokens) // self.page_size)  # ceil div
+
+    def can_admit(self, tokens):
+        """Watermark admission check: would allocating ``tokens`` worth
+        of pages keep the reserve intact?"""
+        with self._lock:
+            return (len(self._free) - self.pages_needed(tokens)
+                    >= self.watermark)
+
+    # -- alloc/free --------------------------------------------------------
+    def alloc(self, n):
+        """``n`` page ids, or :class:`PoolExhausted` (allocation is
+        all-or-nothing so a failed grab never strands partial pages)."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                raise PoolExhausted(
+                    f"need {n} pages, {len(self._free)} free "
+                    f"(pool {self.num_pages})")
+            pages = [self._free.pop() for _ in range(n)]
+            free_now = len(self._free)
+        _m.kv_pages_free().set(free_now)
+        return pages
+
+    def free(self, pages):
+        with self._lock:
+            self._free.extend(pages)
+            free_now = len(self._free)
+        _m.kv_pages_free().set(free_now)
+
+
+class PageTable:
+    """One sequence's mapping of logical token positions to physical
+    pages. Owned by a single scheduler thread — not itself locked (the
+    pool it allocates from is)."""
+
+    __slots__ = ("pool", "pages", "num_tokens")
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.pages = []
+        self.num_tokens = 0
+
+    @property
+    def capacity(self):
+        return len(self.pages) * self.pool.page_size
+
+    def ensure_capacity(self, total_tokens):
+        """Grow the table to hold ``total_tokens``; raises
+        :class:`PoolExhausted` (all-or-nothing) when the pool can't."""
+        need = self.pool.pages_needed(total_tokens) - len(self.pages)
+        if need > 0:
+            self.pages.extend(self.pool.alloc(need))
+
+    def append(self, vecs):
+        """Write ``(k, kv_dim)`` KV vectors at the next ``k`` token
+        slots, allocating pages as needed."""
+        vecs = np.asarray(vecs, np.float32)
+        k = vecs.shape[0]
+        self.ensure_capacity(self.num_tokens + k)
+        if self.pool.data is not None:
+            ps = self.pool.page_size
+            for i in range(k):
+                pos = self.num_tokens + i
+                self.pool.data[self.pages[pos // ps], pos % ps] = vecs[i]
+        self.num_tokens += k
+
+    def gather(self):
+        """The sequence's KV context, ``(num_tokens, kv_dim)``, read
+        back through the page table in token order."""
+        if self.pool.data is None:
+            raise ValueError("pool carries no KV payload (kv_dim=0)")
+        ps = self.pool.page_size
+        full, rem = divmod(self.num_tokens, ps)
+        parts = [self.pool.data[p] for p in self.pages[:full]]
+        if rem:
+            parts.append(self.pool.data[self.pages[full], :rem])
+        if not parts:
+            return np.zeros((0, self.pool.kv_dim), np.float32)
+        return np.concatenate(parts, axis=0)
+
+    def release(self):
+        """Free every page (preemption / completion). The table resets
+        to empty so a resume re-appends from position 0."""
+        if self.pages:
+            self.pool.free(self.pages)
+        self.pages = []
+        self.num_tokens = 0

@@ -1,0 +1,106 @@
+"""The model contract the serving plane schedules, plus the
+deterministic ``ToyLM`` stand-in tests and bench serve.
+
+A :class:`ModelAdapter` sees the world in the two phases continuous
+batching interleaves:
+
+- :meth:`prefill`: the prompt's KV vectors in one shot (the
+  compute-bound phase — its token count is what the scheduler's
+  ``max_batch_tokens`` budget meters);
+- :meth:`decode`: one token per running sequence given each sequence's
+  KV context *as read back through its page table* — decode consumes
+  the paged cache, so an adapter never holds per-sequence state of its
+  own and preemption/re-routing cannot strand anything inside it.
+
+``ToyLM`` is the CPU-backend stand-in: next token and KV vectors are
+pure functions of (params, context), so two hosts loaded with the same
+``load_for_inference`` shards provably produce identical streams, a
+preempted sequence resumed via prefill recompute provably continues
+exactly where it left off, and a re-routed request completes with the
+same tokens on the surviving worker.
+"""
+
+import numpy as np
+
+
+class ModelAdapter:
+    """Duck-typed contract (ToyLM is the reference implementation).
+
+    Attributes: ``kv_dim`` (per-token KV vector width), ``eos_id``
+    (generation stops early on this token; None disables).
+    """
+
+    kv_dim = 0
+    eos_id = None
+
+    def prefill(self, tokens):
+        """``(len(tokens), kv_dim)`` KV vectors for a prompt."""
+        raise NotImplementedError
+
+    def decode(self, contexts):
+        """One decode step over the running batch: ``contexts`` is a
+        list of ``(n_i, kv_dim)`` KV arrays (each gathered through a
+        page table); returns ``(next_tokens, next_kv)`` — a list of
+        ints and a list of ``(kv_dim,)`` vectors to append."""
+        raise NotImplementedError
+
+
+def toy_params(vocab=97, kv_dim=4):
+    """The ToyLM parameter pytree — shaped like a real checkpoint (an
+    embedding table + a projection) so the ZeRO-sharded
+    ``load_for_inference`` path has something honest to transform.
+    Deterministic in (vocab, kv_dim)."""
+    emb = np.zeros((vocab, kv_dim), np.float32)
+    emb[:, 0] = np.arange(vocab)                       # token identity
+    for j in range(1, kv_dim):
+        emb[:, j] = (np.arange(vocab) * (j + 3)) % 17  # mixing planes
+    proj = np.arange(1, kv_dim + 1, dtype=np.float32)
+    return {"emb": emb, "proj": proj}
+
+
+class ToyLM(ModelAdapter):
+    """Deterministic integer LM over ``vocab`` tokens.
+
+    KV vector of token t = ``emb[t]``; the next token is a fixed
+    mixing function of the summed KV context and the context length.
+    Everything routes through the page-table gather, so the KV pages
+    carry the actual information decode needs.
+    """
+
+    def __init__(self, params=None, vocab=97, eos_id=None):
+        if params is None:
+            params = toy_params(vocab=vocab)
+        self.params = {k: np.asarray(v, np.float32)
+                       for k, v in params.items()}
+        self.vocab = int(self.params["emb"].shape[0])
+        self.kv_dim = int(self.params["emb"].shape[1])
+        self.eos_id = eos_id
+
+    def prefill(self, tokens):
+        toks = np.asarray(tokens, np.int64) % self.vocab
+        return self.params["emb"][toks]
+
+    def _next(self, context):
+        s = float(context.sum(axis=0) @ self.params["proj"]) \
+            if context.shape[0] else 0.0
+        return int(round(s) + 7 * context.shape[0]) % self.vocab
+
+    def decode(self, contexts):
+        next_tokens = [self._next(c) for c in contexts]
+        next_kv = [self.params["emb"][t] for t in next_tokens]
+        return next_tokens, next_kv
+
+    def reference_completion(self, prompt, max_new_tokens):
+        """The exact token stream serving must produce for ``prompt`` —
+        the oracle e2e/chaos tests compare re-routed and resumed
+        streams against. Runs the same prefill/decode math without any
+        paging."""
+        ctx = self.prefill(prompt)
+        out = []
+        for _ in range(int(max_new_tokens)):
+            t = self._next(ctx)
+            out.append(t)
+            if self.eos_id is not None and t == self.eos_id:
+                break
+            ctx = np.concatenate([ctx, self.params["emb"][t][None]])
+        return out

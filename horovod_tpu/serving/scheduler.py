@@ -1,0 +1,393 @@
+"""Continuous-batching scheduler: prefill admission interleaved with
+in-flight decode, the batch recomposed every step.
+
+Static batching pads every request to the slowest member and leaves the
+accelerator idle in the gaps; continuous batching re-forms the running
+batch at every decode step — finished sequences leave immediately, new
+prompts prefill into the freed budget, and the decode batch is whatever
+is alive *right now*. The scheduler owns that loop:
+
+1. **resume** preempted sequences (LRU order) whose pages fit again —
+   recompute-on-resume: the prompt *and* everything generated so far
+   re-prefill into fresh pages, which is exact because a ModelAdapter's
+   prefill is defined to reproduce the per-token KV appends;
+2. **admit** new requests from the bounded queue while the per-step
+   prefill token budget (``max_batch_tokens`` minus one slot per
+   running sequence) holds and the page pool stays above its admission
+   watermark — otherwise admission *blocks* (the request stays queued;
+   ``admission_blocked`` counts every refusal so tests can prove the
+   watermark engaged);
+3. **decode** one token for every running sequence through its page
+   table; KV growth that exhausts the pool preempts the
+   least-recently-(re)admitted sequence and retries;
+4. **retire** finished sequences (max tokens or EOS), freeing pages and
+   completing their streams.
+
+Bounded end to end: the admission queue is a ``queue.Queue(maxsize=
+queue_limit)`` — ``submit`` never buffers past it (rule HVD210 exists
+to keep it that way) — and each sequence's token stream is bounded by
+its own ``max_new_tokens``.
+
+Threading: ``submit``/``stats`` are called from HTTP handler threads,
+``step`` from the single worker loop thread; ``_lock`` guards the
+shared tables. The scheduler never sleeps — pacing belongs to the
+worker loop.
+"""
+
+import collections
+import itertools
+import queue
+import threading
+import time
+
+from . import metrics as _m
+from .kv_cache import PagePool, PageTable, PoolExhausted
+
+#: recent step compositions kept for stats/debug (bounded).
+STEP_LOG = 256
+
+QUEUED, PREFILL, RUNNING, PREEMPTED, DONE, FAILED = (
+    "queued", "prefill", "running", "preempted", "done", "failed")
+
+
+class Request:
+    """One generation request as the scheduler sees it."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens")
+
+    def __init__(self, id, prompt, max_new_tokens):
+        self.id = str(id)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+class SequenceResult:
+    """Completion surface of one request: a bounded token stream (one
+    slot per possible token + the terminal None) plus a done event and
+    the final summary dict."""
+
+    def __init__(self, max_new_tokens):
+        self.stream = queue.Queue(maxsize=max_new_tokens + 1)
+        self.done = threading.Event()
+        self.summary = None
+
+    def finish(self, summary):
+        self.summary = summary
+        try:
+            self.stream.put_nowait(None)
+        except queue.Full:  # stream already carries the terminal slot
+            pass
+        self.done.set()
+
+    def tokens(self, timeout=None):
+        """Block until completion; the full generated token list (or
+        raises TimeoutError)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not complete in time")
+        return list(self.summary["tokens"])
+
+
+class _Seq:
+    __slots__ = ("req", "result", "table", "generated", "state",
+                 "t_submit", "t_admit", "t_prefill_done", "t_done",
+                 "admit_stamp", "preempts")
+
+    def __init__(self, req, result):
+        self.req = req
+        self.result = result
+        self.table = None
+        self.generated = []
+        self.state = QUEUED
+        self.t_submit = time.monotonic()
+        self.t_admit = None
+        self.t_prefill_done = None
+        self.t_done = None
+        self.admit_stamp = 0     # LRU key: last (re)admission order
+        self.preempts = 0
+
+    def tokens_alive(self):
+        return self.req.prompt + self.generated
+
+
+class Scheduler:
+    """One host's continuous-batching scheduler over a page pool."""
+
+    def __init__(self, model, pool=None, *, max_batch_tokens=256,
+                 queue_limit=64, num_pages=256, page_size=16,
+                 watermark=None):
+        self.model = model
+        if pool is None:
+            pool = PagePool(num_pages, page_size,
+                            kv_dim=model.kv_dim, watermark=watermark)
+        self.pool = pool
+        self.max_batch_tokens = int(max_batch_tokens)
+        self.queue_limit = int(queue_limit)
+        # The one place requests wait: bounded, so a flood turns into
+        # submit()=False -> 429 at the HTTP layer, never into memory.
+        self._admit_q = queue.Queue(maxsize=self.queue_limit)
+        self._lock = threading.Lock()
+        self._running = {}            # id -> _Seq, decode set
+        self._preempted = collections.OrderedDict()  # id -> _Seq, LRU
+        self._stamp = itertools.count(1)
+        self.step_log = collections.deque(maxlen=STEP_LOG)
+        self.draining = False
+        self.steps = 0
+        self.completed = 0
+        self.failed = 0
+        self.admission_blocked = 0
+        self.tokens_out = 0
+        self.preemptions = 0
+
+    # -- intake (HTTP handler threads) -------------------------------------
+    def submit(self, req):
+        """Queue a request for admission. Returns the
+        :class:`SequenceResult` or ``None`` when the bounded queue is
+        full / the host is draining (caller answers 429/503)."""
+        if self.draining:
+            return None
+        total = len(req.prompt) + req.max_new_tokens
+        if self.pool.pages_needed(total) > self.pool.num_pages \
+                - self.pool.watermark:
+            res = SequenceResult(req.max_new_tokens)
+            res.finish({"id": req.id, "tokens": [], "state": FAILED,
+                        "reason": "too_large",
+                        "error": "request exceeds KV pool capacity"})
+            return res
+        if len(req.prompt) > self.max_batch_tokens:
+            # A prompt the per-step budget can never prefill would sit
+            # at the queue head forever and head-of-line-block every
+            # request behind it — reject loudly instead.
+            res = SequenceResult(req.max_new_tokens)
+            res.finish({"id": req.id, "tokens": [], "state": FAILED,
+                        "reason": "too_large",
+                        "error": "prompt exceeds the per-step batch "
+                                 "budget (HVDTPU_SERVING_MAX_BATCH_"
+                                 "TOKENS)"})
+            return res
+        seq = _Seq(req, SequenceResult(req.max_new_tokens))
+        try:
+            self._admit_q.put_nowait(seq)
+        except queue.Full:
+            return None
+        _m.queue_depth().set(self._admit_q.qsize())
+        return seq.result
+
+    def drain(self):
+        """Stop admitting; in-flight and already-queued sequences run
+        to completion (docs/serving.md drain semantics)."""
+        self.draining = True
+
+    def idle(self):
+        with self._lock:
+            busy = self._running or self._preempted
+        return not busy and self._admit_q.empty()
+
+    # -- admission ---------------------------------------------------------
+    def _try_place(self, seq, budget, force=False):
+        """Prefill ``seq`` into fresh pages if the watermark and the
+        prefill token budget allow. Returns tokens spent (0 = blocked).
+        ``force`` waives the token budget (NOT the watermark) — used
+        only to resume a preempted sequence into an otherwise-empty
+        batch, where pool capacity is the real bound."""
+        toks = seq.tokens_alive()
+        if len(toks) > budget and not force:
+            return 0
+        if not self.pool.can_admit(len(toks)):
+            self.admission_blocked += 1
+            return 0
+        table = PageTable(self.pool)
+        try:
+            table.append(self.model.prefill(toks))
+        except PoolExhausted:      # raced below watermark: stay queued
+            table.release()
+            self.admission_blocked += 1
+            return 0
+        now = time.monotonic()
+        if seq.t_admit is None:
+            seq.t_admit = now
+            _m.latency("queue").observe(now - seq.t_submit)
+        seq.table = table
+        seq.state = RUNNING
+        seq.admit_stamp = next(self._stamp)
+        seq.t_prefill_done = time.monotonic()
+        _m.latency("prefill").observe(seq.t_prefill_done - now)
+        self._running[seq.req.id] = seq
+        return len(toks)
+
+    def _admit(self):
+        budget = self.max_batch_tokens - len(self._running)
+        # Preempted sequences first, least-recently-admitted order:
+        # they already consumed queue latency once and hold completed
+        # work worth resuming before fresh prompts pile in.
+        for sid in list(self._preempted):
+            if budget <= 0:
+                break
+            seq = self._preempted[sid]
+            spent = self._try_place(seq, budget)
+            if not spent and not self._running:
+                # Nothing else is running and the LRU head still does
+                # not fit the step budget (its prompt+generated grew
+                # past max_batch_tokens while it was running). One
+                # oversized re-prefill step beats a permanent stall:
+                # pool capacity (checked at submit) is the real bound.
+                spent = self._try_place(seq, budget, force=True)
+            if spent:
+                del self._preempted[sid]
+                budget -= spent
+            else:
+                break  # LRU head blocked: keep resume order FIFO
+        while budget > 0:
+            try:
+                seq = self._admit_q.get_nowait()
+            except queue.Empty:
+                break
+            spent = self._try_place(seq, budget)
+            if spent:
+                budget -= spent
+            else:
+                # Blocked at the watermark/budget: the queue is the
+                # wait station — put it back at the front by using a
+                # side slot (order preserved for everything behind it).
+                self._requeue_front(seq)
+                break
+        _m.queue_depth().set(self._admit_q.qsize())
+
+    def _requeue_front(self, seq):
+        # queue.Queue has no push-front; splice via the internal deque
+        # under its own mutex (documented CPython attribute).
+        with self._admit_q.mutex:
+            self._admit_q.queue.appendleft(seq)
+            self._admit_q.unfinished_tasks += 1
+            self._admit_q.not_empty.notify()
+
+    # -- preemption --------------------------------------------------------
+    def _preempt_lru(self, exclude_id):
+        """Free the least-recently-(re)admitted running sequence's
+        pages; it re-enters via recompute-on-resume. Returns True when
+        a victim was found."""
+        victims = [s for s in self._running.values()
+                   if s.req.id != exclude_id]
+        if not victims:
+            return False
+        victim = min(victims, key=lambda s: s.admit_stamp)
+        victim.table.release()
+        victim.table = None
+        victim.state = PREEMPTED
+        victim.preempts += 1
+        del self._running[victim.req.id]
+        self._preempted[victim.req.id] = victim
+        self.preemptions += 1
+        _m.preempted_total().inc()
+        return True
+
+    # -- completion --------------------------------------------------------
+    def _finish(self, seq, state=DONE, error=None):
+        if seq.table is not None:
+            seq.table.release()
+            seq.table = None
+        seq.state = state
+        seq.t_done = time.monotonic()
+        if state == DONE:
+            self.completed += 1
+            if seq.t_prefill_done is not None:
+                _m.latency("decode").observe(
+                    seq.t_done - seq.t_prefill_done)
+        else:
+            self.failed += 1
+        summary = {
+            "id": seq.req.id, "tokens": list(seq.generated),
+            "state": state, "preempts": seq.preempts,
+            "latency": {
+                "queue": (seq.t_admit or seq.t_done) - seq.t_submit,
+                "prefill": ((seq.t_prefill_done - seq.t_admit)
+                            if seq.t_prefill_done else 0.0),
+                "decode": ((seq.t_done - seq.t_prefill_done)
+                           if seq.t_prefill_done else 0.0),
+            },
+        }
+        if error:
+            summary["error"] = error
+        seq.result.finish(summary)
+
+    # -- the step ----------------------------------------------------------
+    def step(self):
+        """One continuous-batching step. Returns the step's batch
+        composition (tuple of sequence ids) — empty when idle."""
+        with self._lock:
+            self._admit()
+            batch = list(self._running.values())
+            if not batch:
+                # Idle ticks are not steps: logging them would wash the
+                # recent-composition window out with () entries.
+                return ()
+            contexts = [s.table.gather() for s in batch]
+            next_tokens, next_kv = self.model.decode(contexts)
+            for seq, tok, kv in zip(batch, next_tokens, next_kv):
+                seq.generated.append(int(tok))
+                self.tokens_out += 1
+                _m.tokens_total().inc()
+                try:
+                    seq.result.stream.put_nowait(int(tok))
+                except queue.Full:
+                    pass  # stream bound == max_new_tokens: can't happen
+                done = (len(seq.generated) >= seq.req.max_new_tokens
+                        or (self.model.eos_id is not None
+                            and int(tok) == self.model.eos_id))
+                if done:
+                    if seq.req.id in self._running:
+                        del self._running[seq.req.id]
+                    else:
+                        self._preempted.pop(seq.req.id, None)
+                    self._finish(seq)
+                    continue
+                if seq.state == PREEMPTED:
+                    # An earlier sequence's KV growth preempted this one
+                    # mid-step. Its token for THIS step is already
+                    # recorded (computed from the pre-preemption
+                    # context); the resume prefill reconstructs the KV
+                    # including it, so nothing is lost — just don't
+                    # touch the released table.
+                    continue
+                # Grow the KV table by one token; exhaustion preempts
+                # the LRU sequence and retries (recompute-on-resume).
+                while True:
+                    try:
+                        seq.table.append(kv[None] if kv.ndim == 1
+                                         else kv)
+                        break
+                    except PoolExhausted:
+                        if not self._preempt_lru(seq.req.id):
+                            del self._running[seq.req.id]
+                            self._finish(
+                                seq, state=FAILED,
+                                error="KV pool exhausted with no "
+                                      "preemption victim")
+                            break
+            self.steps += 1
+            composition = tuple(sorted(s.req.id for s in batch))
+            self.step_log.append(composition)
+            return composition
+
+    # -- stats -------------------------------------------------------------
+    def stats(self):
+        with self._lock:
+            return {
+                "queue_depth": self._admit_q.qsize(),
+                "running": len(self._running),
+                "preempted_waiting": len(self._preempted),
+                "steps": self.steps,
+                "completed": self.completed,
+                "failed": self.failed,
+                "tokens_out": self.tokens_out,
+                "preemptions": self.preemptions,
+                "admission_blocked": self.admission_blocked,
+                "pages_free": self.pool.free_pages,
+                "pages_total": self.pool.num_pages,
+                "draining": self.draining,
+                "recent_steps": [list(c) for c in
+                                 list(self.step_log)[-32:]],
+            }

@@ -1,0 +1,211 @@
+"""Train layout → inference layout: ``load_for_inference``.
+
+The serving plane consumes the same parameters ZeRO-1 training
+produced, but in a different geometry: training's portable layout is
+*flat bucket shards* — each of ``n`` train ranks owns contiguous
+``shard_len`` slices of the padded fusion buckets ``ops.zero.plan_zero``
+derived — while inference wants *per-leaf* arrays, replicated across
+the serving cohort or row-sharded over ``serving_world`` hosts.
+
+This module is the first concrete consumer of the portable
+redistribution direction (PAPERS.md 2112.01075, ROADMAP item 3): the
+transform is expressed as a source-spec × target-spec range program
+(:func:`plan_inference_ranges`) — for every (serving host, leaf), the
+exact ``(bucket, src_rank, src_offset, length)`` ranges that assemble
+it — executed host-side over whichever shards are addressable. A
+(host, leaf) pair whose ranges all land in ONE source shard is
+**gather-free**: the leaf is a copy out of a single rank's shard, no
+cross-rank assembly at all (shapes allow this whenever a leaf's flat
+extent does not straddle a shard boundary).
+
+Two entry points:
+
+- :func:`load_for_inference` — from a live params pytree (single-
+  controller meshes; leaves must be fully addressable, the same
+  contract as ``zero.reshard_state``);
+- :func:`load_from_shards` — from per-rank flat bucket shards (the
+  checkpointed form), running the range program directly.
+"""
+
+import numpy as np
+
+REPLICATED = "replicated"
+ROWS = "rows"
+
+
+class _Range:
+    """One copy instruction: ``length`` elements from
+    ``shards[src_rank][bucket]`` at ``src_offset`` into the assembled
+    leaf at ``dst_offset``."""
+
+    __slots__ = ("bucket", "src_rank", "src_offset", "length",
+                 "dst_offset")
+
+    def __init__(self, bucket, src_rank, src_offset, length, dst_offset):
+        self.bucket = bucket
+        self.src_rank = src_rank
+        self.src_offset = src_offset
+        self.length = length
+        self.dst_offset = dst_offset
+
+    def __repr__(self):
+        return (f"_Range(b{self.bucket} r{self.src_rank}"
+                f"[{self.src_offset}:{self.src_offset + self.length}] "
+                f"-> dst[{self.dst_offset}])")
+
+
+def _leaf_flat_offsets(plan):
+    """leaf index -> (bucket index, flat offset inside the packed
+    bucket buffer). Packing order is the bucket's ``indices`` order
+    (ops.bucketing._pack)."""
+    out = {}
+    for k, b in enumerate(plan.buckets):
+        off = 0
+        for i in b.indices:
+            out[i] = (k, off)
+            off += int(np.prod(plan.leaf_shapes[i]))
+    return out
+
+
+def row_slice(dim0, world, host):
+    """Contiguous near-even row range [lo, hi) of host ``host``."""
+    dim0, world, host = int(dim0), int(world), int(host)
+    return (dim0 * host) // world, (dim0 * (host + 1)) // world
+
+
+def plan_inference_ranges(plan, serving_world, layout=REPLICATED):
+    """The redistribution program: ``ranges[host][leaf]`` = list of
+    :class:`_Range`, plus ``gather_free[host][leaf]`` flags (True when
+    the leaf assembles from a single source shard)."""
+    serving_world = int(serving_world)
+    if serving_world < 1:
+        raise ValueError("serving_world must be >= 1")
+    if layout not in (REPLICATED, ROWS):
+        raise ValueError(f"unknown inference layout {layout!r}")
+    offsets = _leaf_flat_offsets(plan)
+    ranges, gather_free = [], []
+    for host in range(serving_world):
+        host_ranges, host_free = [], []
+        for i, shape in enumerate(plan.leaf_shapes):
+            k, off = offsets[i]
+            shard_len = plan.shards[k].shard_len
+            size = int(np.prod(shape))
+            if layout == ROWS and len(shape) >= 1 and shape[0] >= 1:
+                rowsz = size // shape[0] if shape[0] else size
+                lo, hi = row_slice(shape[0], serving_world, host)
+                start, length = off + lo * rowsz, (hi - lo) * rowsz
+            else:
+                start, length = off, size
+            # Split [start, start+length) across the source ranks'
+            # contiguous shard_len slices of the padded bucket.
+            leaf_ranges = []
+            pos = start
+            end = start + length
+            while pos < end:
+                r = pos // shard_len
+                in_shard = pos - r * shard_len
+                take = min(end - pos, shard_len - in_shard)
+                leaf_ranges.append(_Range(k, r, in_shard, take,
+                                          pos - start))
+                pos += take
+            host_ranges.append(leaf_ranges)
+            host_free.append(len({rg.src_rank for rg in leaf_ranges})
+                             <= 1)
+        ranges.append(host_ranges)
+        gather_free.append(host_free)
+    return ranges, gather_free
+
+
+def _leaf_from_ranges(leaf_ranges, shards, dtype):
+    total = sum(r.length for r in leaf_ranges)
+    out = np.empty((total,), dtype)
+    for r in leaf_ranges:
+        src = np.asarray(shards[r.src_rank][r.bucket]).reshape(-1)
+        out[r.dst_offset:r.dst_offset + r.length] = \
+            src[r.src_offset:r.src_offset + r.length]
+    return out
+
+
+def load_from_shards(shards, plan, serving_world=1, serving_rank=0,
+                     layout=REPLICATED, treedef=None):
+    """Assemble THIS serving host's parameter leaves from per-rank flat
+    bucket shards.
+
+    ``shards``: mapping ``src_rank -> [per-bucket (shard_len,) arrays]``
+    (only the ranks the range program touches need to be present — a
+    gather-free host passes exactly one). Returns ``(leaves_or_tree,
+    report)``; leaves are reshaped to the (possibly row-sliced) leaf
+    shapes, and ``report['gather_free']`` lists the per-leaf flags.
+    """
+    ranges, free = plan_inference_ranges(plan, serving_world, layout)
+    host_ranges = ranges[int(serving_rank)]
+    host_free = free[int(serving_rank)]
+    leaves = []
+    for i, (leaf_ranges, shape) in enumerate(
+            zip(host_ranges, plan.leaf_shapes)):
+        needed = {r.src_rank for r in leaf_ranges}
+        missing = needed - set(shards)
+        if missing:
+            raise KeyError(
+                f"leaf {i} needs source shard(s) from rank(s) "
+                f"{sorted(missing)} which were not provided")
+        flat = _leaf_from_ranges(leaf_ranges, shards,
+                                 np.dtype(plan.leaf_dtypes[i]))
+        if layout == ROWS and len(shape) >= 1 and shape[0] >= 1:
+            lo, hi = row_slice(shape[0], serving_world, serving_rank)
+            out_shape = (hi - lo,) + tuple(shape[1:])
+        else:
+            out_shape = tuple(shape)
+        leaves.append(flat.reshape(out_shape))
+    report = {
+        "layout": layout,
+        "serving_world": int(serving_world),
+        "serving_rank": int(serving_rank),
+        "gather_free": list(host_free),
+        "gather_free_leaves": sum(bool(f) for f in host_free),
+        "total_leaves": len(host_free),
+    }
+    if treedef is not None:
+        import jax
+        return jax.tree.unflatten(treedef, leaves), report
+    return leaves, report
+
+
+def load_for_inference(params, serving_world=1, serving_rank=0,
+                       layout=REPLICATED):
+    """Transform a live (train-layout) params pytree into this serving
+    host's inference layout.
+
+    ``replicated``: every host gets the full tree (host-side numpy —
+    inference frameworks feed from host memory). ``rows``: dim-0
+    contiguous row slices per host, gather-free by construction (a row
+    slice is a view of the addressable array — no collective, no
+    assembly). Multi-process global meshes whose leaves this process
+    cannot address are refused with the checkpoint route, mirroring
+    ``zero.reshard_state``.
+    """
+    import jax
+    if layout not in (REPLICATED, ROWS):
+        raise ValueError(f"unknown inference layout {layout!r}")
+    serving_world = int(serving_world)
+    serving_rank = int(serving_rank)
+    if not 0 <= serving_rank < serving_world:
+        raise ValueError(
+            f"serving_rank {serving_rank} outside world {serving_world}")
+
+    def to_host(leaf):
+        if not getattr(leaf, "is_fully_addressable", True):
+            raise RuntimeError(
+                "serving: cannot read train-layout params in place — a "
+                "leaf lives on non-addressable devices (multi-process "
+                "global mesh). Checkpoint the train state and "
+                "load_from_shards on the serving hosts instead "
+                "(docs/serving.md).")
+        arr = np.asarray(jax.device_get(leaf))
+        if layout == ROWS and arr.ndim >= 1 and arr.shape[0] >= 1:
+            lo, hi = row_slice(arr.shape[0], serving_world,
+                               serving_rank)
+            return arr[lo:hi]
+        return arr
+
+    return jax.tree.map(to_host, params)

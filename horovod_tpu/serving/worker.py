@@ -1,0 +1,247 @@
+"""Per-host serving worker: the continuous-batching loop, its HTTP
+surface, and KV-plane registration + stats push.
+
+One ``ServingWorker`` per serving host: it owns a
+:class:`~.scheduler.Scheduler`, steps it on a dedicated loop thread,
+and exposes ``POST /v1/generate`` / ``GET /v1/serving/stats`` /
+``POST /v1/serving/drain`` through the runner HTTP server
+(``serve_http``). Requests block their HTTP handler thread until the
+stream completes — the *scheduler's* bounded queue is the only wait
+station; a full queue answers 429 immediately (backpressure, never
+buffering).
+
+On the control plane the worker registers itself in the launcher KV
+store (``serving`` scope, ``member.<cohort>.<wid>`` = ``host:port``)
+and pushes a stats snapshot every ``stats_interval`` seconds
+(``stats.<cohort>.<wid>``), which is what the router's cohort view and
+the autoscaler consume. The same pump polls the cohort drain flag
+(``drain.<cohort>``), so ``hvd-serve drain`` reaches workers through
+the KV plane alone. Push/poll errors are swallowed — a KV blackout
+degrades stats to stale, it never stops serving (the chaos matrix row
+pins that).
+"""
+
+import itertools
+import json
+import threading
+import time
+
+from ..utils import envparse
+from ..utils.logging_util import get_logger
+from . import metrics as _m
+from .model import ToyLM
+from .scheduler import Request, Scheduler
+
+#: serving control-plane scope in the launcher KV store.
+SERVING_SCOPE = "serving"
+#: loop sleep when there is nothing to schedule.
+_IDLE_SLEEP_S = 0.002
+#: default seconds between stats pushes / drain-flag polls.
+STATS_INTERVAL_S = 0.5
+
+
+def knob_defaults():
+    """The serving knob family resolved through envparse
+    (docs/knobs.md)."""
+    return {
+        "max_batch_tokens": envparse.get_int(
+            envparse.SERVING_MAX_BATCH_TOKENS, 256),
+        "queue_limit": envparse.get_int(envparse.SERVING_QUEUE_LIMIT, 64),
+        "num_pages": envparse.get_int(envparse.SERVING_KV_PAGES, 256),
+        "page_size": envparse.get_int(envparse.SERVING_KV_PAGE_SIZE, 16),
+        "drain_timeout": envparse.get_float(
+            envparse.SERVING_DRAIN_TIMEOUT, 30.0),
+    }
+
+
+class ServingWorker:
+    """One serving host: scheduler loop + HTTP + KV registration."""
+
+    def __init__(self, model=None, cohort="c0", wid=0, *,
+                 scheduler=None, max_batch_tokens=None, queue_limit=None,
+                 num_pages=None, page_size=None, watermark=None,
+                 request_timeout_s=120.0):
+        knobs = knob_defaults()
+        self.model = model if model is not None else ToyLM()
+        self.cohort = str(cohort)
+        self.wid = int(wid)
+        if scheduler is None:
+            scheduler = Scheduler(
+                self.model,
+                max_batch_tokens=(max_batch_tokens
+                                  or knobs["max_batch_tokens"]),
+                queue_limit=queue_limit or knobs["queue_limit"],
+                num_pages=num_pages or knobs["num_pages"],
+                page_size=page_size or knobs["page_size"],
+                watermark=watermark)
+        self.scheduler = scheduler
+        self.request_timeout_s = float(request_timeout_s)
+        self.drain_timeout_s = knobs["drain_timeout"]
+        self._stop = threading.Event()
+        self._reqno = itertools.count(1)
+        self._loop_thread = None
+        self._pump_thread = None
+        self._server = None
+        self._kv = None      # (addr, port, token) once registered
+        self._log = get_logger()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._loop_thread is not None:
+            return self
+        self._loop_thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"hvd-serving-{self.cohort}.{self.wid}")
+        self._loop_thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            composition = self.scheduler.step()
+            if not composition:
+                # Nothing running: wait for arrivals without burning
+                # a core (bounded sleep, not a blocking get — drain
+                # and stop must stay responsive).
+                self._stop.wait(_IDLE_SLEEP_S)
+
+    def stop(self):
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+            self._loop_thread = None
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5)
+            self._pump_thread = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    # -- HTTP surface ------------------------------------------------------
+    def serve_http(self, addr="0.0.0.0", token=""):
+        """Start a runner HTTP server with this worker attached;
+        returns the bound port."""
+        from ..runner.http_server import KVStoreServer
+        self._server = KVStoreServer(job_token=token, addr=addr)
+        self._server.serving_worker = self
+        port = self._server.start()
+        return port
+
+    def handle_generate(self, payload):
+        """``(status, body)`` for one request — called from an HTTP
+        handler thread (or directly by InProcClient). Blocks until the
+        stream completes; 429 body carries ``retry_after``."""
+        if not isinstance(payload, dict):
+            # A JSON array/scalar body must be a 400, not an
+            # AttributeError that resets the connection (the router
+            # would read that as a dead worker).
+            return 400, {"error": "bad request: body must be a JSON "
+                                  "object"}
+        client_id = str(payload.get("id") or f"r{next(self._reqno)}")
+        try:
+            # Scheduler ids must be unique per worker lifetime — a
+            # client-chosen id re-routed here after a retry must not
+            # collide with an in-flight sequence's table entry.
+            req = Request(f"{client_id}#{next(self._reqno)}",
+                          payload["prompt"],
+                          payload.get("max_new_tokens", 16))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": f"bad request: {e}"}
+        result = self.scheduler.submit(req)
+        if result is None:
+            reason = "draining" if self.scheduler.draining \
+                else "queue_full"
+            _m.rejected_total(reason).inc()
+            status = 503 if reason == "draining" else 429
+            return status, {"error": reason, "retry_after": 1.0}
+        try:
+            tokens = result.tokens(timeout=self.request_timeout_s)
+        except TimeoutError:
+            return 504, {"error": "generation timed out",
+                         "id": client_id}
+        summary = dict(result.summary)
+        summary["id"] = client_id  # report the caller's id, not the
+        #                            suffixed scheduler-unique one
+        if summary.get("state") != "done":
+            # A request the pool/budget can never serve is the
+            # client's error (413) — the router must hand it back, not
+            # retry it on every member. Runtime failures stay 500.
+            status = 413 if summary.get("reason") == "too_large" \
+                else 500
+            return status, {"error": summary.get("error", "failed"),
+                            "id": client_id,
+                            "state": summary.get("state")}
+        summary["worker"] = f"{self.cohort}.{self.wid}"
+        summary["tokens"] = tokens
+        return 200, summary
+
+    def handle_drain(self, payload=None):
+        self.scheduler.drain()
+        return 200, {"draining": True,
+                     "cohort": self.cohort, "wid": self.wid}
+
+    def stats(self):
+        s = self.scheduler.stats()
+        s.update(cohort=self.cohort, wid=self.wid, role="worker")
+        return s
+
+    # -- drain -------------------------------------------------------------
+    def drain(self, timeout=None):
+        """Stop admitting, wait for in-flight sequences to complete.
+        Returns True when fully drained within the timeout."""
+        self.scheduler.drain()
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else self.drain_timeout_s)
+        while time.monotonic() < deadline:
+            if self.scheduler.idle():
+                return True
+            time.sleep(0.01)
+        return self.scheduler.idle()
+
+    # -- KV-plane registration + stats push --------------------------------
+    def register(self, kv_addr, kv_port, token="", advertise=None):
+        """Announce this worker under ``serving/member.<cohort>.<wid>``
+        and start the stats/drain pump."""
+        from ..runner import http_client
+        self._kv = (kv_addr, int(kv_port), token)
+        if advertise:
+            http_client.put_kv(
+                kv_addr, kv_port, SERVING_SCOPE,
+                f"member.{self.cohort}.{self.wid}", advertise,
+                token=token)
+        if self._pump_thread is None:
+            self._pump_thread = threading.Thread(
+                target=self._stats_pump, daemon=True,
+                name=f"hvd-serving-stats-{self.cohort}.{self.wid}")
+            self._pump_thread.start()
+
+    def push_stats_once(self):
+        """One stats push + drain-flag poll; KV trouble is swallowed
+        (stale stats beat a dead worker). Returns True on success."""
+        from ..runner import http_client
+        if self._kv is None:
+            return False
+        addr, port, token = self._kv
+        try:
+            http_client.put_kv(
+                addr, port, SERVING_SCOPE,
+                f"stats.{self.cohort}.{self.wid}",
+                json.dumps(self.stats()), token=token,
+                retries=0, deadline=2.0)
+            flag = http_client.get_kv(
+                addr, port, SERVING_SCOPE, f"drain.{self.cohort}",
+                token=token, retries=0, deadline=2.0)
+            if flag and flag.strip() == b"1" \
+                    and not self.scheduler.draining:
+                self._log.warning(
+                    "serving %s.%d: drain flag set on the KV plane; "
+                    "admission stopped", self.cohort, self.wid)
+                self.scheduler.drain()
+            return True
+        except Exception as e:  # noqa: BLE001 — stats are best-effort
+            self._log.debug("serving stats push failed: %s", e)
+            return False
+
+    def _stats_pump(self):
+        while not self._stop.is_set():
+            self.push_stats_once()
+            self._stop.wait(STATS_INTERVAL_S)

@@ -338,6 +338,35 @@ FLIGHT_RECORDER_EVENTS = register(
     "FLIGHT_RECORDER_EVENTS", "4096",
     "Flight-recorder ring capacity, events per rank")
 
+# -- serving plane (docs/serving.md) ---------------------------------------
+SERVING = register(
+    "SERVING", "0",
+    "Enable the serving plane: continuous-batching workers + router "
+    "routes on the runner HTTP server (horovod_tpu/serving/)")
+SERVING_MAX_BATCH_TOKENS = register(
+    "SERVING_MAX_BATCH_TOKENS", "256",
+    "Per-step scheduler budget: prefill tokens admitted plus one slot "
+    "per running sequence may not exceed this")
+SERVING_KV_PAGE_SIZE = register(
+    "SERVING_KV_PAGE_SIZE", "16",
+    "Token slots per KV-cache page")
+SERVING_KV_PAGES = register(
+    "SERVING_KV_PAGES", "256",
+    "KV-cache pages in the per-host pool; admission keeps 1/16 of "
+    "them free (the watermark reserve)")
+SERVING_QUEUE_LIMIT = register(
+    "SERVING_QUEUE_LIMIT", "64",
+    "Bound of the per-host admission queue; past it submissions are "
+    "rejected 429 + Retry-After (backpressure, never buffering)")
+SERVING_SCALE_UP_DEPTH = register(
+    "SERVING_SCALE_UP_DEPTH", "32",
+    "Autoscaler: total cohort pressure (queued + running) that, "
+    "sustained, triggers a serving scale-up")
+SERVING_DRAIN_TIMEOUT = register(
+    "SERVING_DRAIN_TIMEOUT", "30",
+    "Seconds a draining cohort may take to finish in-flight "
+    "sequences before scale-down proceeds anyway")
+
 # -- kernels ----------------------------------------------------------------
 BRIDGE_FLASH = register(
     "BRIDGE_FLASH", "auto",

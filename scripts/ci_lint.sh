@@ -47,7 +47,8 @@ rules = {r["ruleId"] for r in results}
 families = {rule[:4] for rule in rules if rule.startswith("HVD")}
 missing = {"HVD2", "HVD3", "HVD4"} - families
 assert not missing, f"fixture corpus no longer trips {sorted(missing)}xx"
-for tag in ("HVD401", "HVD402", "HVD403", "HVD404", "HVD405"):
+for tag in ("HVD210", "HVD401", "HVD402", "HVD403", "HVD404",
+            "HVD405"):
     assert tag in rules, f"fixture corpus no longer trips {tag}"
 print(f"canary ok: {len(results)} finding(s), "
       f"{len(rules)} rule(s), families {sorted(families)}")

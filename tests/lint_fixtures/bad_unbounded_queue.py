@@ -1,0 +1,42 @@
+"""Fixture: unbounded request buffering in serving code (HVD210 x3,
+docs/lint.md)."""
+import collections
+import queue
+
+
+class RequestScheduler:
+    """Serving-context class: the name marks it (docs/serving.md)."""
+
+    def __init__(self, limit):
+        # HVD210: bare queue.Queue() — overload grows memory instead of
+        # answering 429 at the admission bound.
+        self.pending = queue.Queue()
+        # Fine: bounded admission queue, the backpressure contract.
+        self.admit = queue.Queue(maxsize=limit)
+        # Fine: bounded ring of recent step compositions.
+        self.step_log = collections.deque(maxlen=256)
+        # Fine: non-request bookkeeping list (name says so).
+        self.completed_ids = []
+        self.backlog = []
+
+    def submit(self, req):
+        # HVD210: request list growing without bound inside the
+        # scheduler — the queue limit never engages.
+        self.backlog.append(req)
+
+
+def handle_generate(payload, waiting=None):
+    # HVD210: SimpleQueue has no maxsize at all — never a valid
+    # request buffer in a handler.
+    inbox = queue.SimpleQueue()
+    inbox.put(payload)
+    return inbox
+
+
+def unrelated_pipeline():
+    # Fine: not serving context — plain data plumbing elsewhere keeps
+    # its idioms.
+    stages = queue.Queue()
+    items = []
+    items.append(1)
+    return stages, items

@@ -1,0 +1,60 @@
+"""Standalone serving-host process for the serving e2e / chaos tests.
+
+Starts one :class:`horovod_tpu.serving.ServingWorker` (ToyLM), serves
+its HTTP surface on an ephemeral port, registers with the launcher KV
+store, prints ``SERVING <port>`` on stdout, and runs until killed —
+SIGTERM takes the default fatal path, which is exactly the "replica
+lost mid-decode" shape chaos row (a) injects.
+
+Env (all optional):
+  SERVING_HOST_COHORT / SERVING_HOST_WID    identity (default c0 / 0)
+  SERVING_HOST_KV                           HOST:PORT of the KV store
+  SERVING_HOST_TOKEN                        job token
+  SERVING_HOST_DELAY                        seconds per decode step
+                                            (slows generation so kills
+                                            land mid-decode)
+  HVDTPU_SERVING_*                          the registered knobs
+"""
+
+import os
+import sys
+import time
+
+from horovod_tpu.serving.model import ToyLM
+from horovod_tpu.serving.worker import ServingWorker
+
+
+class SlowToyLM(ToyLM):
+    """ToyLM with a per-decode-step delay: gives tests a window to kill
+    a worker while streams are provably mid-decode."""
+
+    def __init__(self, delay_s, **kwargs):
+        super().__init__(**kwargs)
+        self.delay_s = float(delay_s)
+
+    def decode(self, contexts):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return super().decode(contexts)
+
+
+def main():
+    cohort = os.environ.get("SERVING_HOST_COHORT", "c0")
+    wid = int(os.environ.get("SERVING_HOST_WID", "0"))
+    token = os.environ.get("SERVING_HOST_TOKEN", "")
+    delay = float(os.environ.get("SERVING_HOST_DELAY", "0"))
+    model = SlowToyLM(delay) if delay else ToyLM()
+    worker = ServingWorker(model, cohort=cohort, wid=wid).start()
+    port = worker.serve_http(addr="127.0.0.1", token=token)
+    kv = os.environ.get("SERVING_HOST_KV", "")
+    if kv:
+        host, _, kv_port = kv.rpartition(":")
+        worker.register(host, int(kv_port), token,
+                        advertise=f"127.0.0.1:{port}")
+    print(f"SERVING {port}", flush=True)
+    while True:  # until SIGTERM/SIGKILL from the test
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -579,3 +579,162 @@ def test_collective_failure_injection_recovers(tmp_path):
     assert len(done) == 2, content
     entries = _parse_log(log_path)
     assert max(e[1] for e in entries) == 5
+
+
+# ==========================================================================
+# Serving-plane rows (ISSUE 13, docs/serving.md "Chaos semantics")
+# ==========================================================================
+
+def test_serving_worker_sigterm_reroutes_and_replacement_joins():
+    """Serving row (a): SIGTERM a serving worker while >= 16 streams
+    are mid-decode. The router must re-route the affected streams to
+    the surviving host and EVERY accepted request must complete with
+    the exact oracle tokens — zero accepted-request loss. A
+    replacement worker registering on the KV plane afterwards (the
+    elastic-respawn shape) is discovered and takes traffic."""
+    import signal
+    import threading
+
+    from horovod_tpu.runner.http_server import KVStoreServer, \
+        new_job_token
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.router import Router
+    from test_serving import _spawn_host
+
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    procs = []
+    try:
+        for wid in range(2):
+            procs.append(_spawn_host(
+                "c0", wid, kv_port, token,
+                env_extra={"SERVING_HOST_DELAY": "0.04"}))
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        assert router.refresh_from_kv(["c0"]) == {"c0": 2}
+        m = ToyLM()
+        specs = [([(i % 5) + 1, 3], 24) for i in range(16)]
+        out = [None] * 16
+
+        def gen(i, p, n):
+            out[i] = router.generate(
+                {"prompt": p, "max_new_tokens": n})
+
+        threads = [threading.Thread(target=gen, args=(i, p, n))
+                   for i, (p, n) in enumerate(specs)]
+        for t in threads:
+            t.start()
+        # 24 tokens x 40ms/step >= ~1s of decode: the kill lands with
+        # streams provably mid-decode on both hosts.
+        time.sleep(0.4)
+        procs[0][0].send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(timeout=180)
+        for i, (p, n) in enumerate(specs):
+            status, body = out[i]
+            assert status == 200, (i, out[i])
+            assert body["tokens"] == m.reference_completion(p, n), i
+        assert router.completed == 16, "zero accepted-request loss"
+        assert router.rerouted >= 1, \
+            "SIGTERM landed after completion; re-route never exercised"
+
+        # Elastic-respawn shape: a replacement host registers under the
+        # next member slot, discovery picks it up, traffic reaches it.
+        procs.append(_spawn_host(
+            "c0", 2, kv_port, token,
+            env_extra={"SERVING_HOST_DELAY": "0.005"}))
+        assert router.refresh_from_kv(["c0"])["c0"] >= 3
+        used = set()
+        for k in range(6):
+            status, body = router.generate(
+                {"prompt": [k + 1], "max_new_tokens": 3})
+            assert status == 200
+            used.add(body["worker"])
+        assert "c0.2" in used, used
+    finally:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        kv.stop()
+
+
+def test_serving_kv_blackout_degrades_to_local_and_resyncs(monkeypatch):
+    """Serving row (b): a KV blackout while requests are in flight.
+    The router must keep serving — generation never touches the KV
+    store — and its stats view degrades to the last-known local view
+    (source=local) instead of erroring; once the blackout lifts, the
+    next refresh re-syncs the cohort roll-up from the workers' pushed
+    snapshots (source=kv, fresh completion counts)."""
+    import threading
+
+    from horovod_tpu import chaos
+    from horovod_tpu.runner.http_server import KVStoreServer, \
+        new_job_token
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.router import Router
+    from test_serving import _spawn_host
+
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    procs = []
+    try:
+        for wid in range(2):
+            procs.append(_spawn_host(
+                "c0", wid, kv_port, token,
+                env_extra={"SERVING_HOST_DELAY": "0.02"}))
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        router.refresh_from_kv(["c0"])
+        # Healthy baseline: the roll-up comes from the KV plane.
+        time.sleep(0.8)  # let the workers push their first snapshots
+        assert router.stats()["source"] == "kv"
+
+        # Blackout: the next 10 serving-scope KV GETs fail at the
+        # injection point inside the retry client.
+        monkeypatch.setenv("HVDTPU_CHAOS",
+                           "kv_get:fail:n=10:scope=serving")
+        chaos.reset()
+        m = ToyLM()
+        out = [None] * 8
+
+        def gen(i):
+            out[i] = router.generate(
+                {"prompt": [i + 1, 2], "max_new_tokens": 12})
+
+        threads = [threading.Thread(target=gen, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        saw_local = False
+        for _ in range(10):  # poll through the blackout, under load
+            if router.stats()["source"] == "local":
+                saw_local = True
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=120)
+        assert saw_local, "blackout never degraded stats to local"
+        # Under the blackout, every request still completed exactly.
+        for i in range(8):
+            status, body = out[i]
+            assert status == 200, (i, out[i])
+            assert body["tokens"] == m.reference_completion(
+                [i + 1, 2], 12), i
+        # Recovery: injections exhausted -> the roll-up re-syncs from
+        # the KV plane with the workers' fresh post-load snapshots.
+        monkeypatch.delenv("HVDTPU_CHAOS")
+        chaos.reset()
+        time.sleep(1.0)  # one push interval: snapshots include the load
+        stats = router.stats()
+        assert stats["source"] == "kv"
+        assert stats["cohorts"]["c0"]["completed"] >= 8
+    finally:
+        monkeypatch.delenv("HVDTPU_CHAOS", raising=False)
+        chaos.reset()
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        kv.stop()

@@ -242,6 +242,68 @@ class TestAstRules:
                "  # hvd-lint: disable=HVD209\n")
         assert ast_lint.lint_source(src) == []
 
+    def test_unbounded_queue_fixture(self):
+        diags = self.lint("bad_unbounded_queue.py")
+        assert rules_of(diags) == ["HVD210", "HVD210", "HVD210"]
+        assert [d.line for d in diags] == [13, 25, 31]
+        msgs = " ".join(d.message for d in diags)
+        assert "queue.Queue" in msgs and "append" in msgs
+
+    def test_bounded_buffers_in_serving_context_are_clean(self):
+        src = ("import collections\n"
+               "import queue\n"
+               "class RequestScheduler:\n"
+               "    def __init__(self, limit):\n"
+               "        self.pending = queue.Queue(maxsize=limit)\n"
+               "        self.admit = queue.Queue(limit)\n"
+               "        self.recent = collections.deque(maxlen=64)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_unbounded_queue_outside_serving_context_is_clean(self):
+        # The same spellings in plain data-plumbing code are idiomatic;
+        # only serving scheduler/router/handler context is held to the
+        # backpressure contract.
+        src = ("import queue\n"
+               "class TilePipeline:\n"
+               "    def __init__(self):\n"
+               "        self.stages = queue.Queue()\n"
+               "        self.pending = []\n"
+               "    def push(self, t):\n"
+               "        self.pending.append(t)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_serving_file_path_is_context(self):
+        # Under a serving/ path every unbounded queue is in scope, even
+        # without a telling class name.
+        src = ("import queue\n"
+               "class Pump:\n"
+               "    def __init__(self):\n"
+               "        self.inbox = queue.Queue()\n")
+        diags = ast_lint.lint_source(
+            src, filename="horovod_tpu/serving/pump.py")
+        assert rules_of(diags) == ["HVD210"]
+
+    def test_simple_queue_always_flagged_in_context(self):
+        src = ("from queue import SimpleQueue\n"
+               "def handle_submit(req):\n"
+               "    box = SimpleQueue()\n"
+               "    box.put(req)\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD210"]
+
+    def test_unbounded_queue_suppressible(self):
+        src = ("import queue\n"
+               "class RequestRouter:\n"
+               "    def __init__(self):\n"
+               "        self.audit_queue = queue.Queue()"
+               "  # hvd-lint: disable=HVD210\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_hvd210_in_catalog(self):
+        from horovod_tpu.analysis.diagnostics import RULES, WARNING
+        severity, title = RULES["HVD210"]
+        assert severity == WARNING
+        assert "backpressure" in title
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"

@@ -1,0 +1,965 @@
+"""Serving plane: continuous batching, KV paging, backpressure, router,
+state transform, autoscaler, CLI — plus the 2-host e2e acceptance test.
+
+The tier-1 acceptance contract (ISSUE 13 / docs/serving.md):
+
+- a 2-host CPU-backend serving cohort completes >= 16 concurrent
+  streaming requests with the batch composition PROVABLY changing
+  across decode steps (continuous batching, not static);
+- admission provably blocks at the KV-page watermark
+  (``admission_blocked`` > 0 while the pool is pressured);
+- a 429 + Retry-After is observed at the queue limit;
+- a worker SIGTERMed mid-decode loses ZERO accepted requests — the
+  router re-routes the affected streams and they complete with the
+  exact oracle tokens (deterministic generation).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner.http_server import (AUTH_HEADER, KVStoreServer,
+                                            new_job_token)
+from horovod_tpu.serving import autoscale as sautoscale
+from horovod_tpu.serving import state as sstate
+from horovod_tpu.serving.kv_cache import PagePool, PageTable, PoolExhausted
+from horovod_tpu.serving.model import ToyLM, toy_params
+from horovod_tpu.serving.router import InProcClient, Router, WorkerClient
+from horovod_tpu.serving.scheduler import Request, Scheduler
+from horovod_tpu.serving.worker import ServingWorker
+from horovod_tpu.utils import envparse
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+HOST_SCRIPT = os.path.join(HERE, "serving_host.py")
+
+
+# ==========================================================================
+# KV cache
+# ==========================================================================
+
+def test_page_pool_alloc_free_watermark():
+    pool = PagePool(8, 4, watermark=2)
+    assert pool.free_pages == 8
+    assert pool.pages_needed(9) == 3
+    pages = pool.alloc(3)
+    assert pool.free_pages == 5
+    # watermark admission: 5 free, reserve 2 -> 3 pages (12 tokens) ok,
+    # 4 pages (13 tokens) not.
+    assert pool.can_admit(12)
+    assert not pool.can_admit(13)
+    pool.free(pages)
+    assert pool.free_pages == 8
+
+
+def test_page_pool_alloc_is_all_or_nothing():
+    pool = PagePool(4, 2, watermark=1)
+    with pytest.raises(PoolExhausted):
+        pool.alloc(5)
+    assert pool.free_pages == 4  # nothing stranded
+
+
+def test_page_pool_validates():
+    with pytest.raises(ValueError):
+        PagePool(0, 4)
+    with pytest.raises(ValueError):
+        PagePool(4, 4, watermark=4)
+
+
+def test_page_table_append_gather_release():
+    pool = PagePool(8, 2, kv_dim=3, watermark=1)
+    table = PageTable(pool)
+    vecs = np.arange(15, dtype=np.float32).reshape(5, 3)
+    table.append(vecs[:2])
+    table.append(vecs[2:])
+    assert table.num_tokens == 5
+    assert len(table.pages) == 3          # ceil(5/2)
+    np.testing.assert_array_equal(table.gather(), vecs)
+    table.release()
+    assert table.num_tokens == 0 and table.pages == []
+    assert pool.free_pages == 8
+
+
+# ==========================================================================
+# Model
+# ==========================================================================
+
+def test_toylm_deterministic_and_page_driven():
+    m = ToyLM()
+    ref = m.reference_completion([5, 3, 8], 6)
+    assert len(ref) == 6
+    assert ref == m.reference_completion([5, 3, 8], 6)
+    # decode consumes exactly what prefill wrote (the paging contract:
+    # prefill(tokens) == the per-token KV appends).
+    ctx = m.prefill([5, 3, 8])
+    toks, kv = m.decode([ctx])
+    assert toks[0] == ref[0]
+    np.testing.assert_array_equal(kv[0], m.prefill([toks[0]])[0])
+
+
+# ==========================================================================
+# Scheduler: continuous batching
+# ==========================================================================
+
+def _drive(scheduler, results, max_steps=500):
+    comps = []
+    for _ in range(max_steps):
+        comps.append(scheduler.step())
+        if all(r.done.is_set() for r in results):
+            return comps
+    raise AssertionError(f"not done after {max_steps} steps: "
+                         f"{scheduler.stats()}")
+
+
+def test_scheduler_matches_oracle_with_mixed_lengths():
+    m = ToyLM()
+    s = Scheduler(m, max_batch_tokens=64, queue_limit=16,
+                  num_pages=64, page_size=4)
+    reqs = [([i + 1, 2, 3][:1 + i % 3], 3 + i % 5) for i in range(6)]
+    results = [s.submit(Request(f"q{i}", p, n))
+               for i, (p, n) in enumerate(reqs)]
+    _drive(s, results)
+    for r, (p, n) in zip(results, reqs):
+        assert r.tokens(timeout=1) == m.reference_completion(p, n)
+    # Mixed output lengths => the decode batch provably recomposes.
+    nonempty = [c for c in s.step_log if c]
+    assert len(set(nonempty)) > 2
+
+
+def test_scheduler_admits_mid_flight():
+    """A request submitted while others are decoding joins the SAME
+    running batch — the continuous-batching property itself."""
+    m = ToyLM()
+    s = Scheduler(m, max_batch_tokens=64, queue_limit=8,
+                  num_pages=64, page_size=4)
+    first = s.submit(Request("a", [1, 2], 8))
+    for _ in range(3):
+        s.step()
+    second = s.submit(Request("b", [3], 8))
+    comps = _drive(s, [first, second])
+    assert first.tokens(1) == m.reference_completion([1, 2], 8)
+    assert second.tokens(1) == m.reference_completion([3], 8)
+    joined = [c for c in comps if set(c) == {"a", "b"}]
+    assert joined, f"b never decoded alongside a: {comps}"
+
+
+def test_scheduler_preemption_resumes_exactly():
+    m = ToyLM()
+    s = Scheduler(m, max_batch_tokens=32, queue_limit=8,
+                  num_pages=6, page_size=2, watermark=1)
+    reqs = [([i + 1, 2], 5) for i in range(4)]
+    results = [s.submit(Request(f"q{i}", p, n))
+               for i, (p, n) in enumerate(reqs)]
+    _drive(s, results)
+    assert s.preemptions > 0, "pool was sized to force preemption"
+    for r, (p, n) in zip(results, reqs):
+        assert r.tokens(1) == m.reference_completion(p, n), \
+            "recompute-on-resume must continue the exact stream"
+
+
+def test_scheduler_watermark_blocks_admission():
+    m = ToyLM()
+    # Pool of 8 pages, watermark 4: two 4-token prompts (2 pages each)
+    # fill the non-reserve half; the third must WAIT despite free pages.
+    s = Scheduler(m, max_batch_tokens=64, queue_limit=8,
+                  num_pages=8, page_size=2, watermark=4)
+    a = s.submit(Request("a", [1, 2, 3, 4], 2))
+    b = s.submit(Request("b", [5, 6, 7, 8], 2))
+    c = s.submit(Request("c", [9, 10, 11, 12], 2))
+    s.step()
+    assert s.admission_blocked > 0
+    st = s.stats()
+    assert st["queue_depth"] >= 1, "third prompt must still be queued"
+    _drive(s, [a, b, c])
+    assert c.tokens(1) == m.reference_completion([9, 10, 11, 12], 2)
+
+
+def test_scheduler_queue_limit_rejects():
+    s = Scheduler(ToyLM(), queue_limit=2, num_pages=16, page_size=2)
+    assert s.submit(Request("a", [1], 2)) is not None
+    assert s.submit(Request("b", [1], 2)) is not None
+    assert s.submit(Request("c", [1], 2)) is None  # bound: caller 429s
+
+
+def test_scheduler_too_large_request_fails_loudly():
+    s = Scheduler(ToyLM(), queue_limit=4, num_pages=4, page_size=2,
+                  watermark=1)
+    res = s.submit(Request("big", [1, 2, 3], 20))
+    assert res.done.is_set()
+    assert res.summary["state"] == "failed"
+    assert "capacity" in res.summary["error"]
+
+
+def test_scheduler_drain_finishes_inflight_rejects_new():
+    m = ToyLM()
+    s = Scheduler(m, queue_limit=4, num_pages=16, page_size=2)
+    a = s.submit(Request("a", [1, 2], 6))
+    s.step()
+    s.drain()
+    assert s.submit(Request("b", [1], 2)) is None
+    _drive(s, [a])
+    assert a.tokens(1) == m.reference_completion([1, 2], 6)
+    assert s.idle()
+
+
+def test_scheduler_prompt_over_batch_budget_fails_loudly():
+    """An oversized prompt must be rejected at submit, not parked at
+    the queue head where it would block every request behind it."""
+    m = ToyLM()
+    s = Scheduler(m, max_batch_tokens=8, queue_limit=4,
+                  num_pages=64, page_size=2)
+    big = s.submit(Request("big", list(range(10)), 2))
+    assert big.done.is_set()
+    assert big.summary["state"] == "failed"
+    assert big.summary["reason"] == "too_large"
+    # The request behind it is unaffected and completes.
+    small = s.submit(Request("small", [1, 2], 3))
+    _drive(s, [small])
+    assert small.tokens(1) == m.reference_completion([1, 2], 3)
+
+
+def test_scheduler_preempted_beyond_budget_still_resumes():
+    """A sequence whose prompt+generated outgrows max_batch_tokens
+    while running must still resume after preemption (forced re-prefill
+    into an empty batch), not hang forever."""
+    m = ToyLM()
+    # prompt 6 + up to 8 generated = 14 > budget 8; pool 8x2=16 slots
+    # shared with a rival so the long sequence gets preempted.
+    s = Scheduler(m, max_batch_tokens=8, queue_limit=4,
+                  num_pages=8, page_size=2, watermark=1)
+    long_seq = s.submit(Request("long", [1, 2, 3, 4, 5, 6], 8))
+    for _ in range(4):
+        s.step()
+    rival = s.submit(Request("rival", [7, 8], 4))
+    comps = _drive(s, [long_seq, rival])
+    assert s.preemptions > 0, comps
+    assert long_seq.tokens(1) == m.reference_completion(
+        [1, 2, 3, 4, 5, 6], 8)
+    assert rival.tokens(1) == m.reference_completion([7, 8], 4)
+
+
+def test_worker_maps_too_large_to_413_and_router_hands_it_back():
+    """A deterministic client error (413) must come straight back from
+    the router — never retried on other members, never mis-reported as
+    'no worker reachable'."""
+    calls = []
+
+    class CountingClient(InProcClient):
+        def generate(self, payload):
+            calls.append(self.base_url)
+            return super().generate(payload)
+
+    w0 = _worker(wid=0, num_pages=8, page_size=2).start()
+    w1 = _worker(wid=1, num_pages=8, page_size=2).start()
+    try:
+        router = Router(members={"c0": [CountingClient(w0),
+                                        CountingClient(w1)]})
+        status, body = router.generate(
+            {"prompt": [1, 2, 3], "max_new_tokens": 50})
+        assert status == 413, (status, body)
+        assert "capacity" in body["error"]
+        assert len(calls) == 1, "413 must not be retried on members"
+    finally:
+        w0.stop()
+        w1.stop()
+
+
+def test_worker_non_dict_payload_is_400_not_crash():
+    w = _worker()
+    assert w.handle_generate([1, 2, 3])[0] == 400
+    token = new_job_token()
+    try:
+        port = w.serve_http(addr="127.0.0.1", token=token)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=b"[]",
+            method="POST")
+        req.add_header(AUTH_HEADER, token)
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        w.stop()
+
+
+def test_router_kv_stats_keyed_by_wid_not_index(tmp_path):
+    """Workers at non-contiguous wids (a replacement takes the next
+    free slot) must all appear in the KV-sourced roll-up."""
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    workers = []
+    try:
+        for wid in (0, 2):  # gap at wid 1
+            w = _worker(wid=wid).start()
+            port = w.serve_http(addr="127.0.0.1", token=token)
+            w.register("127.0.0.1", kv_port, token,
+                       advertise=f"127.0.0.1:{port}")
+            workers.append(w)
+            w.push_stats_once()
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        assert router.refresh_from_kv(["c0"]) == {"c0": 2}
+        stats = router.stats()
+        assert stats["source"] == "kv"
+        assert set(stats["cohorts"]["c0"]["members"]) == {"0", "2"}
+    finally:
+        for w in workers:
+            w.stop()
+        kv.stop()
+
+
+def test_request_validation():
+    with pytest.raises(ValueError):
+        Request("x", [], 4)
+    with pytest.raises(ValueError):
+        Request("x", [1], 0)
+
+
+# ==========================================================================
+# load_for_inference: train layout -> inference layout
+# ==========================================================================
+
+def _bucket_shards(leaves, plan):
+    """Per-rank flat bucket shards exactly as the ZeRO pack lays them
+    out (pad-and-split over the packed bucket buffer)."""
+    shards = {r: [] for r in range(plan.n)}
+    for b, s in zip(plan.buckets, plan.shards):
+        buf = np.zeros((s.padded,), np.float32)
+        off = 0
+        for i in b.indices:
+            arr = np.ravel(leaves[i])
+            buf[off:off + arr.size] = arr
+            off += arr.size
+        for r in range(plan.n):
+            shards[r].append(buf[r * s.shard_len:(r + 1) * s.shard_len])
+    return shards
+
+
+def test_load_from_shards_replicated_roundtrip():
+    import jax
+    from horovod_tpu.ops.zero import plan_zero
+    params = toy_params()
+    leaves, treedef = jax.tree.flatten(params)
+    plan = plan_zero(leaves, 4, bucket_bytes=512)
+    shards = _bucket_shards(leaves, plan)
+    tree, report = sstate.load_from_shards(shards, plan, treedef=treedef)
+    for k in params:
+        np.testing.assert_array_equal(tree[k], params[k])
+    assert report["layout"] == "replicated"
+    assert report["total_leaves"] == 2
+
+
+def test_load_from_shards_rows_roundtrip_and_gather_free():
+    import jax
+    from horovod_tpu.ops.zero import plan_zero
+    params = toy_params()
+    leaves, _ = jax.tree.flatten(params)
+    plan = plan_zero(leaves, 4, bucket_bytes=512)
+    shards = _bucket_shards(leaves, plan)
+    for world in (1, 2, 3):
+        per_leaf = {}
+        any_gather_free = False
+        for host in range(world):
+            lv, rep = sstate.load_from_shards(
+                shards, plan, serving_world=world, serving_rank=host,
+                layout=sstate.ROWS)
+            any_gather_free |= any(rep["gather_free"])
+            for i, leaf in enumerate(lv):
+                per_leaf.setdefault(i, []).append(leaf)
+        for i, shape in enumerate(plan.leaf_shapes):
+            whole = np.concatenate(per_leaf[i], axis=0)
+            np.testing.assert_array_equal(whole.reshape(shape),
+                                          leaves[i])
+        if world == 3:
+            # A small host slice fits inside one train shard: the
+            # range program marks it gather-free (single source rank).
+            assert any_gather_free
+
+
+def test_load_from_shards_missing_rank_raises():
+    import jax
+    from horovod_tpu.ops.zero import plan_zero
+    params = toy_params()
+    leaves, _ = jax.tree.flatten(params)
+    plan = plan_zero(leaves, 4, bucket_bytes=512)
+    shards = _bucket_shards(leaves, plan)
+    del shards[2]
+    with pytest.raises(KeyError, match="rank"):
+        sstate.load_from_shards(shards, plan)
+
+
+def test_load_for_inference_live_params():
+    params = toy_params()
+    full = sstate.load_for_inference(params)
+    np.testing.assert_array_equal(full["emb"], params["emb"])
+    half = sstate.load_for_inference(params, serving_world=2,
+                                     serving_rank=1, layout=sstate.ROWS)
+    np.testing.assert_array_equal(half["emb"], params["emb"][48:])
+    # Two hosts loaded from the same transform serve identical streams.
+    m0 = ToyLM(params=sstate.load_for_inference(params))
+    m1 = ToyLM(params=sstate.load_for_inference(params))
+    assert m0.reference_completion([4, 4], 5) == \
+        m1.reference_completion([4, 4], 5)
+    with pytest.raises(ValueError):
+        sstate.load_for_inference(params, layout="diagonal")
+
+
+# ==========================================================================
+# Router
+# ==========================================================================
+
+class _DeadClient:
+    """Transport-failing member (a SIGTERMed worker)."""
+
+    base_url = "inproc:dead"
+
+    def generate(self, payload):
+        raise ConnectionRefusedError("worker gone")
+
+    def stats(self):
+        raise ConnectionRefusedError("worker gone")
+
+    def drain(self):
+        raise ConnectionRefusedError("worker gone")
+
+
+def _worker(cohort="c0", wid=0, **kw):
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("queue_limit", 8)
+    return ServingWorker(ToyLM(), cohort=cohort, wid=wid, **kw)
+
+
+def test_router_routes_and_reroutes_off_dead_worker():
+    w = _worker().start()
+    try:
+        router = Router(members={"c0": [_DeadClient(),
+                                        InProcClient(w)]})
+        status, body = router.generate(
+            {"prompt": [2, 7], "max_new_tokens": 4})
+        assert status == 200
+        assert body["tokens"] == ToyLM().reference_completion([2, 7], 4)
+        assert router.rerouted == 1
+        assert router.completed == 1
+    finally:
+        w.stop()
+
+
+def test_router_backpressure_429_with_retry_after():
+    # Worker whose queue is instantly full: loop NOT started, queue
+    # limit 1, one request parked.
+    w = _worker(queue_limit=1)
+    assert w.scheduler.submit(Request("parked", [1], 2)) is not None
+    router = Router(members={"c0": [InProcClient(w)]})
+    status, body = router.generate({"prompt": [1], "max_new_tokens": 2})
+    assert status == 429
+    assert body["retry_after"] > 0
+    assert router.rejected == 1
+
+
+def test_router_no_members_503_and_bad_request_400():
+    router = Router(members={})
+    assert router.generate({"prompt": [1]})[0] == 503
+    w = _worker().start()
+    try:
+        router = Router(members={"c0": [InProcClient(w)]})
+        status, body = router.generate({"prompt": [],
+                                        "max_new_tokens": 2})
+        assert status == 400
+    finally:
+        w.stop()
+
+
+def test_router_drain_cohort_direct():
+    w = _worker().start()
+    try:
+        router = Router(members={"c0": [InProcClient(w)]})
+        out = router.drain_cohort("c0")
+        assert out["acks"]["0"] is True
+        assert w.scheduler.draining
+        status, body = router.generate({"prompt": [1],
+                                        "max_new_tokens": 2})
+        assert status == 503
+        assert "draining" in body["error"]
+    finally:
+        w.stop()
+
+
+def test_router_stats_local_source_without_kv():
+    w = _worker().start()
+    try:
+        router = Router(members={"c0": [InProcClient(w)]})
+        stats = router.stats()
+        assert stats["source"] == "local"
+        assert "c0" in stats["cohorts"]
+        assert stats["cohorts"]["c0"]["members"]
+    finally:
+        w.stop()
+
+
+# ==========================================================================
+# Autoscaler
+# ==========================================================================
+
+def test_autoscaler_scales_up_after_sustained_pressure():
+    ups = []
+    a = sautoscale.Autoscaler(lambda: ups.append(1), scale_up_depth=10,
+                              window=3, cooldown_s=100.0)
+    busy = {"c0": {"queue_depth": 8, "running": 4}}
+    idle = {"c0": {"queue_depth": 0, "running": 0}}
+    t = 0.0
+    a.observe(busy, now=t)
+    a.observe(idle, now=t + 1)        # breach streak resets
+    a.observe(busy, now=t + 2)
+    a.observe(busy, now=t + 3)
+    assert ups == []
+    a.observe(busy, now=t + 4)        # third consecutive breach
+    assert ups == [1]
+    a.observe(busy, now=t + 5)
+    a.observe(busy, now=t + 6)
+    a.observe(busy, now=t + 7)        # cooldown holds
+    assert ups == [1]
+
+
+def test_autoscaler_scale_down_drains_first():
+    drained, downed = [], []
+    a = sautoscale.Autoscaler(
+        lambda: None, scale_down=downed.append, drain=drained.append,
+        scale_up_depth=100, idle_s=5.0, drain_timeout=60.0)
+    stats = {"c0": {"queue_depth": 0, "running": 3},
+             "c1": {"queue_depth": 0, "running": 0}}
+    a.observe(stats, now=0.0)
+    a.observe(stats, now=6.0)         # c1 idle past idle_s -> drain
+    assert drained == ["c1"] and downed == []
+    # Still "running 0": drained -> scale_down next tick.
+    a.observe(stats, now=7.0)
+    assert downed == ["c1"]
+
+
+def test_autoscaler_never_drains_last_cohort():
+    drained = []
+    a = sautoscale.Autoscaler(lambda: None, scale_down=lambda c: None,
+                              drain=drained.append, scale_up_depth=100,
+                              idle_s=1.0)
+    only = {"c0": {"queue_depth": 0, "running": 0}}
+    a.observe(only, now=0.0)
+    a.observe(only, now=10.0)
+    assert drained == []
+
+
+def test_autoscaler_elastic_target_file(tmp_path):
+    target = tmp_path / "targets"
+    sautoscale.write_target(str(target), ["localhost:2"])
+    assert target.read_text() == "localhost:2\n"
+    script = "\n".join(
+        sautoscale.discovery_script_lines(str(target)))
+    path = tmp_path / "discover.sh"
+    path.write_text(script + "\n")
+    path.chmod(0o755)
+    out = subprocess.run([str(path)], capture_output=True, text=True)
+    assert out.stdout.strip() == "localhost:2"
+    sautoscale.write_target(str(target), ["localhost:2", "otherhost:2"])
+    out = subprocess.run([str(path)], capture_output=True, text=True)
+    assert out.stdout.splitlines() == ["localhost:2", "otherhost:2"]
+
+
+# ==========================================================================
+# Knobs + metrics contract
+# ==========================================================================
+
+def test_serving_knobs_registered():
+    for name in ("SERVING", "SERVING_MAX_BATCH_TOKENS",
+                 "SERVING_KV_PAGE_SIZE", "SERVING_KV_PAGES",
+                 "SERVING_QUEUE_LIMIT", "SERVING_SCALE_UP_DEPTH",
+                 "SERVING_DRAIN_TIMEOUT"):
+        assert name in envparse.KNOBS, name
+        assert getattr(envparse, name) == name
+
+
+def test_serving_metrics_disabled_mode_accumulates_nothing(monkeypatch):
+    from horovod_tpu.telemetry import core as telemetry
+    monkeypatch.delenv("HOROVOD_TPU_METRICS", raising=False)
+    monkeypatch.delenv("HVDTPU_METRICS", raising=False)
+    telemetry.reset()
+    try:
+        m = ToyLM()
+        s = Scheduler(m, queue_limit=4, num_pages=16, page_size=2)
+        r = s.submit(Request("a", [1, 2], 4))
+        _drive(s, [r])
+        assert telemetry.registry().snapshot()["families"] == {}
+    finally:
+        telemetry.reset()
+
+
+def test_serving_metrics_families_emitted(monkeypatch):
+    from horovod_tpu.telemetry import core as telemetry
+    monkeypatch.setenv("HOROVOD_TPU_METRICS", "1")
+    telemetry.reset()
+    try:
+        m = ToyLM()
+        w = ServingWorker(m, num_pages=16, page_size=2, queue_limit=1)
+        # One completed stream + one queue_full rejection.
+        assert w.scheduler.submit(Request("a", [1, 2], 3)) is not None
+        assert w.handle_generate({"prompt": [1],
+                                  "max_new_tokens": 2})[0] == 429
+        while not w.scheduler.idle():
+            w.scheduler.step()
+        fams = telemetry.registry().snapshot()["families"]
+        assert "hvd_serving_latency_seconds" in fams
+        assert "hvd_serving_tokens_total" in fams
+        assert "hvd_serving_kv_pages_free" in fams
+        assert "hvd_serving_queue_depth" in fams
+        assert "hvd_serving_rejected_total" in fams
+        reasons = {tuple(sorted(s.get("labels", {}).items()))
+                   for s in fams["hvd_serving_rejected_total"]["samples"]}
+        assert (("reason", "queue_full"),) in reasons
+    finally:
+        telemetry.reset()
+
+
+# ==========================================================================
+# HTTP surface (in-process)
+# ==========================================================================
+
+def _http_json(port, path, payload=None, token="", timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=(json.dumps(payload).encode()
+              if payload is not None else None),
+        method="POST" if payload is not None else "GET")
+    if token:
+        req.add_header(AUTH_HEADER, token)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers), \
+            (json.loads(body) if body else {})
+
+
+def test_http_generate_stats_drain_and_auth():
+    token = new_job_token()
+    w = _worker().start()
+    try:
+        port = w.serve_http(addr="127.0.0.1", token=token)
+        status, _, body = _http_json(
+            port, "/v1/generate",
+            {"prompt": [3, 1], "max_new_tokens": 4}, token=token)
+        assert status == 200
+        assert body["tokens"] == ToyLM().reference_completion([3, 1], 4)
+        assert body["latency"]["decode"] >= 0
+        status, _, stats = _http_json(port, "/v1/serving/stats",
+                                      token=token)
+        assert status == 200 and stats["completed"] == 1
+        # Token gate: serving routes are job-token-authenticated.
+        status, _, _ = _http_json(port, "/v1/serving/stats")
+        assert status == 403
+        status, _, _ = _http_json(port, "/v1/generate",
+                                  {"prompt": [1]})
+        assert status == 403
+        # Drain over HTTP.
+        status, _, body = _http_json(port, "/v1/serving/drain", {},
+                                     token=token)
+        assert status == 200 and body["draining"]
+        status, _, body = _http_json(
+            port, "/v1/generate", {"prompt": [1], "max_new_tokens": 2},
+            token=token)
+        assert status == 503
+    finally:
+        w.stop()
+
+
+def test_http_429_carries_retry_after_header():
+    token = new_job_token()
+    w = _worker(queue_limit=1)  # loop not started: queue fills
+    try:
+        port = w.serve_http(addr="127.0.0.1", token=token)
+        assert w.scheduler.submit(Request("parked", [1], 2)) is not None
+        status, headers, body = _http_json(
+            port, "/v1/generate", {"prompt": [1], "max_new_tokens": 2},
+            token=token)
+        assert status == 429
+        assert float(headers.get("Retry-After")) > 0
+        assert body["error"] == "queue_full"
+    finally:
+        w.stop()
+
+
+# ==========================================================================
+# 2-host e2e: the acceptance test
+# ==========================================================================
+
+def _spawn_host(cohort, wid, kv_port, token, env_extra=None):
+    env = {
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE), HERE,
+             os.environ.get("PYTHONPATH", "")]),
+        "PATH": os.environ.get("PATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "SERVING_HOST_COHORT": cohort,
+        "SERVING_HOST_WID": str(wid),
+        "SERVING_HOST_KV": f"127.0.0.1:{kv_port}",
+        "SERVING_HOST_TOKEN": token,
+    }
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, HOST_SCRIPT], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("SERVING "), f"bad host banner: {line!r}"
+    return proc, int(line.split()[1])
+
+
+def _gen_http(port, token, prompt, max_new, out, idx, timeout=120,
+              retry_429=False):
+    """One closed-loop client. With ``retry_429`` it honors
+    Retry-After — the documented client contract — so backpressure
+    shows up as latency, not as loss."""
+    for _ in range(200):
+        status, headers, body = _http_json(
+            port, "/v1/generate",
+            {"prompt": prompt, "max_new_tokens": max_new},
+            token=token, timeout=timeout)
+        if status == 429 and retry_429:
+            time.sleep(min(float(headers.get("Retry-After", 1.0)),
+                           0.2))
+            continue
+        break
+    out[idx] = (status, headers, body)
+
+
+def test_e2e_two_host_cohort_16_streams():
+    """The acceptance e2e: 2 real worker processes ("hosts"), the
+    router + KV store in-process, 16 concurrent streaming requests,
+    with the continuous-batching / watermark / 429 properties asserted
+    from the workers' own stats."""
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    # Tight pools so the watermark provably engages under 16 streams
+    # (12 pages x 2 tokens = 24 slots vs ~5 concurrent streams of up
+    # to 15 tokens per host).
+    knobs = {
+        "HVDTPU_SERVING_KV_PAGES": "12",
+        "HVDTPU_SERVING_KV_PAGE_SIZE": "2",
+        "HVDTPU_SERVING_QUEUE_LIMIT": "4",
+        "HVDTPU_SERVING_MAX_BATCH_TOKENS": "64",
+        "SERVING_HOST_DELAY": "0.005",
+    }
+    procs = []
+    try:
+        for wid in range(2):
+            procs.append(_spawn_host("c0", wid, kv_port, token,
+                                     env_extra=knobs))
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        found = router.refresh_from_kv(["c0"])
+        assert found == {"c0": 2}
+        rport = router.serve_http(addr="127.0.0.1", token=token)
+
+        m = ToyLM()
+        specs = [([(i % 7) + 1, (3 * i) % 11, 5][: 1 + i % 3],
+                  4 + i % 9) for i in range(16)]
+        out = [None] * 16
+        threads = [
+            threading.Thread(target=_gen_http,
+                             args=(rport, token, p, n, out, i),
+                             kwargs={"retry_429": True})
+            for i, (p, n) in enumerate(specs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        # Every stream completed with the exact oracle tokens.
+        for i, (p, n) in enumerate(specs):
+            status, _, body = out[i]
+            assert status == 200, (i, out[i])
+            assert body["tokens"] == m.reference_completion(p, n), i
+        # The cohort genuinely split the load across both hosts.
+        workers_used = {out[i][2]["worker"] for i in range(16)}
+        assert len(workers_used) == 2, workers_used
+
+        # Worker-side acceptance properties, from their own stats.
+        blocked = 0
+        changing = False
+        joined_mid_flight = False
+        for proc, port in procs:
+            _, _, st = _http_json(port, "/v1/serving/stats",
+                                  token=token)
+            blocked += st["admission_blocked"]
+            comps = [tuple(c) for c in st["recent_steps"] if c]
+            if len(set(comps)) > 2:
+                changing = True
+            for a, b in zip(comps, comps[1:]):
+                if set(a) & set(b) and set(b) - set(a):
+                    joined_mid_flight = True
+        assert changing, "batch composition never changed (static?)"
+        assert joined_mid_flight, \
+            "no sequence ever joined an in-flight batch"
+        assert blocked > 0, "KV-page watermark never blocked admission"
+
+        # 429 at the queue limit: flood one worker directly with
+        # prompts too big to admit while the pool is this small.
+        wport = procs[0][1]
+        flood = [None] * 12
+        fthreads = [
+            threading.Thread(
+                target=_gen_http,
+                args=(wport, token, [1] * 10, 10, flood, i))
+            for i in range(12)]
+        for t in fthreads:
+            t.start()
+        for t in fthreads:
+            t.join(timeout=120)
+        statuses = [flood[i][0] for i in range(12)]
+        assert 429 in statuses, statuses
+        hit = statuses.index(429)
+        assert float(flood[hit][1].get("Retry-After")) > 0
+        # Backpressure, not loss: every ACCEPTED flood request (non-
+        # 429) completed correctly.
+        for i, st_ in enumerate(statuses):
+            if st_ == 200:
+                assert flood[i][2]["tokens"] == \
+                    m.reference_completion([1] * 10, 10)
+        assert statuses.count(200) >= 1
+        router.stop_http()
+    finally:
+        for proc, _ in procs:
+            proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        kv.stop()
+
+
+def test_worker_sigterm_mid_decode_streams_rerouted_and_complete():
+    """Chaos row (a), fast form: SIGTERM one of two hosts while its
+    streams are provably mid-decode; the router re-routes and every
+    accepted request completes with the oracle tokens — zero
+    accepted-request loss."""
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    procs = []
+    try:
+        for wid in range(2):
+            procs.append(_spawn_host(
+                "c0", wid, kv_port, token,
+                env_extra={"SERVING_HOST_DELAY": "0.05"}))
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        router.refresh_from_kv(["c0"])
+        m = ToyLM()
+        specs = [([i + 1, 2], 20) for i in range(8)]
+        out = [None] * 8
+
+        def gen(i, p, n):
+            out[i] = router.generate(
+                {"prompt": p, "max_new_tokens": n})
+
+        threads = [threading.Thread(target=gen, args=(i, p, n))
+                   for i, (p, n) in enumerate(specs)]
+        for t in threads:
+            t.start()
+        # Let both hosts reach decode (20 tokens x 50ms/step ~ 1s),
+        # then kill host 0 mid-decode.
+        time.sleep(0.4)
+        procs[0][0].send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(timeout=120)
+        for i, (p, n) in enumerate(specs):
+            status, body = out[i]
+            assert status == 200, (i, out[i])
+            assert body["tokens"] == m.reference_completion(p, n), i
+        assert router.completed == 8
+        assert router.rerouted >= 1, \
+            "the kill landed after all streams finished; re-route " \
+            "path never exercised"
+    finally:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        kv.stop()
+
+
+# ==========================================================================
+# hvd-serve CLI (shell-outs)
+# ==========================================================================
+
+def _cli(*args, timeout=60):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE), env.get("PYTHONPATH", "")])
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.serving.cli", *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_cli_help_lists_subcommands():
+    out = _cli("--help")
+    assert out.returncode == 0
+    for cmd in ("route", "stats", "drain"):
+        assert cmd in out.stdout
+
+
+def test_cli_stats_and_drain_against_live_worker():
+    token = new_job_token()
+    w = _worker().start()
+    try:
+        port = w.serve_http(addr="127.0.0.1", token=token)
+        w.handle_generate({"prompt": [1, 2], "max_new_tokens": 3})
+        out = _cli("stats", "--url", f"http://127.0.0.1:{port}",
+                   "--token", token, "--json")
+        assert out.returncode == 0, out.stderr
+        stats = json.loads(out.stdout)
+        assert stats["completed"] == 1
+        out = _cli("drain", "c0", "--url",
+                   f"http://127.0.0.1:{port}", "--token", token)
+        assert out.returncode == 0, out.stderr
+        assert w.scheduler.draining
+    finally:
+        w.stop()
+
+
+def test_cli_stats_unreachable_exits_2():
+    out = _cli("stats", "--url", "http://127.0.0.1:9", "--token", "x")
+    assert out.returncode == 2
+    assert "failed" in out.stderr
+
+
+def test_cli_route_serves_and_exits():
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    w = _worker().start()
+    try:
+        port = w.serve_http(addr="127.0.0.1", token=token)
+        w.register("127.0.0.1", kv_port, token,
+                   advertise=f"127.0.0.1:{port}")
+        out = _cli("route", "--kv", f"127.0.0.1:{kv_port}",
+                   "--token", token, "--cohorts", "c0",
+                   "--bind", "127.0.0.1", "--serve-seconds", "1.5")
+        assert out.returncode == 0, out.stderr
+        assert "serving router on :" in out.stdout
+        assert "c0=1" in out.stdout
+    finally:
+        w.stop()
+        kv.stop()
+
+
+def test_cli_route_bad_kv_exits_2():
+    out = _cli("route", "--kv", "127.0.0.1:9", "--token", "x",
+               "--serve-seconds", "1")
+    assert out.returncode == 2
+    assert "cannot reach KV store" in out.stderr
