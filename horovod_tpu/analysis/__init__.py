@@ -15,9 +15,15 @@ Three layers, one finding type (:class:`Diagnostic`):
    :func:`verify_source` / :func:`extract_schedule`) — ``hvd-lint
    verify``: call graph + rank-dependence taint lattice + symbolic
    per-rank collective schedules, behind the HVD4xx rule family
-   (analysis/schedule.py). SARIF 2.1.0 output (analysis/sarif.py) and
-   the content-hash baseline workflow (analysis/baseline.py) ride on
-   the same Diagnostic stream.
+   (analysis/schedule.py), then **executed** by the symbolic N-rank
+   simulator (analysis/simulate.py, :func:`verify_and_simulate_paths`)
+   whose lockstep matcher proves deadlocks/digest mismatches as
+   HVD501/502 with per-rank counterexample traces (HVD503 for bounded
+   approximations); ``hvd-lint explain`` (analysis/explain.py) maps a
+   flight-recorder postmortem bundle back to the divergent slot's
+   source line. SARIF 2.1.0 output (analysis/sarif.py, counterexamples
+   as ``codeFlows``) and the content-hash baseline workflow
+   (analysis/baseline.py) ride on the same Diagnostic stream.
 4. **runtime order guard** (:class:`SubmissionOrderGuard`) — the opt-in
    ``HOROVOD_TPU_ORDER_CHECK=1`` dynamic backstop in the coordinator.
 5. **runtime concurrency sanitizer** (``sanitizer``) — the opt-in
@@ -38,6 +44,13 @@ from .ast_lint import (  # noqa: F401
 )
 from .schedule import (  # noqa: F401
     extract_schedule, verify_paths, verify_source,
+)
+from .simulate import (  # noqa: F401
+    render_trace, simulate_paths, simulate_source,
+    verify_and_simulate_paths, verify_and_simulate_source,
+)
+from .explain import (  # noqa: F401
+    ExplainError, explain_bundle, render_report,
 )
 from .sarif import to_sarif  # noqa: F401
 from .baseline import (  # noqa: F401

@@ -1572,14 +1572,39 @@ def _apply_suppressions(diags, src):
     return [d for d in diags if not suppressed(d)]
 
 
-def lint_source(src, filename="<string>"):
-    """Lint python source text; returns a list of :class:`Diagnostic`."""
+# Parsed-corpus cache shared by every analysis layer of one process:
+# within one CLI invocation the AST linter, the interprocedural
+# verifier, and the schedule simulator all consume the same files —
+# re-reading and re-parsing per leg dominated the --self/dogfood wall
+# time. Keyed by (mtime_ns, size) so an edited file re-parses; trees
+# are treated as read-only by every consumer.
+_PARSE_CACHE = {}
+_PARSE_CACHE_MAX = 2048
+
+
+def parse_cached(path):
+    """``(src, tree)`` for ``path``, parsed at most once per content
+    version per process. Raises ``OSError``/``SyntaxError`` exactly
+    like an uncached open+parse would."""
+    path = os.path.abspath(path)
     try:
-        tree = ast.parse(src, filename=filename)
-    except SyntaxError as exc:
-        return [Diagnostic.make(
-            "HVD001", f"syntax error: {exc.msg}",
-            file=filename, line=exc.lineno or 0)]
+        st = os.stat(path)
+        token = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        token = None
+    hit = _PARSE_CACHE.get(path)
+    if hit is not None and hit[0] == token and token is not None:
+        return hit[1], hit[2]
+    with open(path, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+        _PARSE_CACHE.clear()
+    _PARSE_CACHE[path] = (token, src, tree)
+    return src, tree
+
+
+def _lint_tree(src, tree, filename):
     analyzer = _Analyzer(filename)
     analyzer.visit(tree)
     diags = analyzer.finish()
@@ -1590,9 +1615,25 @@ def lint_source(src, filename="<string>"):
     return dedupe(sorted(diags, key=Diagnostic.sort_key))
 
 
+def lint_source(src, filename="<string>"):
+    """Lint python source text; returns a list of :class:`Diagnostic`."""
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as exc:
+        return [Diagnostic.make(
+            "HVD001", f"syntax error: {exc.msg}",
+            file=filename, line=exc.lineno or 0)]
+    return _lint_tree(src, tree, filename)
+
+
 def lint_file(path):
-    with open(path, encoding="utf-8", errors="replace") as f:
-        return lint_source(f.read(), filename=path)
+    try:
+        src, tree = parse_cached(path)
+    except SyntaxError as exc:
+        return [Diagnostic.make(
+            "HVD001", f"syntax error: {exc.msg}",
+            file=path, line=exc.lineno or 0)]
+    return _lint_tree(src, tree, path)
 
 
 def iter_python_files(paths):

@@ -4,7 +4,8 @@ Runs the AST layer over scripts/directories and prints structured
 findings with ``file:line`` + fix hints:
 
     hvd-lint train.py examples/
-    hvd-lint verify train.py             # + interprocedural HVD4xx
+    hvd-lint verify train.py             # + HVD4xx + simulated HVD5xx
+    hvd-lint explain ./traces --program train.py   # postmortem → line
     hvd-lint --format json --fail-on warning src/
     hvd-lint --format sarif src/ > lint.sarif
     hvd-lint --write-baseline lint-baseline.json src/
@@ -13,13 +14,23 @@ findings with ``file:line`` + fix hints:
     hvd-lint --check-knobs          # knob registry vs docs/knobs.md
     hvd-lint --list-rules
 
-``verify`` is the interprocedural mode (analysis/schedule.py): on top
-of the single-hop rules it builds a call graph over each script plus
-the ``horovod_tpu`` modules it imports, propagates a rank-dependence
-taint lattice, extracts the symbolic per-rank collective schedule, and
-applies the HVD4xx family (rank-tainted reachability at any call
-depth, divergent loop bounds, early exits skipping collectives,
-cross-process-set interleavings, Adasum through bucketing paths).
+``verify`` is the interprocedural mode: on top of the single-hop rules
+it builds a call graph over each script plus the ``horovod_tpu``
+modules it imports, propagates a rank-dependence taint lattice,
+extracts the symbolic per-rank collective schedule, applies the
+heuristic HVD4xx family (analysis/schedule.py), and then **executes**
+the extracted schedules in the symbolic N-rank simulator
+(analysis/simulate.py): proven deadlocks (HVD501) and digest
+mismatches (HVD502) are emitted with per-rank counterexample traces
+(SARIF ``codeFlows``), approximations stay HVD503 warnings, and a
+proven finding supersedes the heuristic one on the same event. Both
+layers share one parsed corpus and one call-graph fixpoint per
+invocation.
+
+``explain`` is the postmortem loop (analysis/explain.py): point it at
+a flight-recorder postmortem bundle directory (and the program via
+``--program``) and it names the first divergent slot, the matching
+HVD5xx diagnosis, and the submitting source line.
 
 ``--self`` is the hvd-sanitize self-analysis: every rule — collective
 HVD2xx + concurrency HVD3xx + the interprocedural HVD4xx — over the
@@ -46,8 +57,10 @@ import argparse
 import json
 import os
 import sys
+import time
 
-from . import ast_lint, baseline as baseline_mod, schedule, sarif
+from . import (ast_lint, baseline as baseline_mod, explain as
+               explain_mod, sarif, simulate)
 from .diagnostics import ERROR, RULES, dedupe, Diagnostic
 
 
@@ -70,7 +83,9 @@ def _build_parser():
                     "linter for horovod_tpu training scripts (and, "
                     "via --self, for horovod_tpu itself). Prepend the "
                     "`verify` subcommand for the interprocedural "
-                    "schedule verifier (HVD4xx).")
+                    "schedule verifier + symbolic simulator "
+                    "(HVD4xx/HVD5xx), or `explain` to map a "
+                    "postmortem bundle back to source.")
     parser.add_argument("paths", nargs="*", default=[],
                         help="python files or directories (default: . "
                              "unless only --check-knobs is requested)")
@@ -86,9 +101,10 @@ def _build_parser():
     parser.add_argument("--self", dest="self_sweep", action="store_true",
                         help="sweep the horovod_tpu package itself with "
                              "every rule (incl. the interprocedural "
-                             "HVD4xx family) + the knob-docs "
-                             "cross-check, failing on warnings (the "
-                             "hvd-sanitize self-analysis)")
+                             "HVD4xx family and the simulated HVD5xx) "
+                             "+ the knob-docs cross-check, failing on "
+                             "warnings (the hvd-sanitize "
+                             "self-analysis)")
     parser.add_argument("--check-knobs", action="store_true",
                         help="cross-check the envparse knob registry "
                              "against docs/knobs.md (HVD306); with no "
@@ -112,8 +128,42 @@ def _build_parser():
 def _collect(paths, verify):
     diags = ast_lint.lint_paths(paths)
     if verify:
-        diags.extend(schedule.verify_paths(paths))
+        # heuristic HVD4xx + simulated HVD5xx over ONE shared corpus
+        # and call-graph fixpoint (the parse cache already de-dupes
+        # the file reads against the AST leg above)
+        diags.extend(simulate.verify_and_simulate_paths(paths))
     return dedupe(sorted(diags, key=Diagnostic.sort_key))
+
+
+def _explain_main(argv):
+    parser = argparse.ArgumentParser(
+        prog="hvd-lint explain",
+        description="Map a flight-recorder postmortem bundle back to "
+                    "the source line where the per-rank schedules "
+                    "diverged.")
+    parser.add_argument("bundle", help="directory holding the "
+                        "postmortem.*.jsonl shards")
+    parser.add_argument("--program", action="append", default=[],
+                        metavar="PATH",
+                        help="the training program (repeatable) whose "
+                             "extracted schedule maps slots to source "
+                             "lines")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    args = parser.parse_args(argv)
+    try:
+        report = explain_mod.explain_bundle(args.bundle, args.program)
+    except explain_mod.ExplainError as exc:
+        print(f"hvd-lint explain: {exc}", file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"hvd-lint explain: {exc}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(explain_mod.to_json(report))
+    else:
+        print(explain_mod.render_report(report))
+    return 0
 
 
 def _baseline_path(args):
@@ -130,11 +180,14 @@ def main(argv=None):
     if argv is None:
         argv = sys.argv[1:]
     argv = list(argv)
+    if argv and argv[0] == "explain":
+        return _explain_main(argv[1:])
     verify = bool(argv) and argv[0] == "verify"
     if verify:
         argv = argv[1:]
     parser = _build_parser()
     args = parser.parse_args(argv)
+    t_start = time.perf_counter()
 
     if args.list_rules:
         for rule, (severity, title) in sorted(RULES.items()):
@@ -217,12 +270,16 @@ def main(argv=None):
     else:
         for d in diags:
             print(d.format())
+            trace_text = simulate.render_trace(d)
+            if trace_text:
+                print(trace_text)
         errors = sum(d.severity == ERROR for d in diags)
         tail = (f", {len(suppressed)} baseline-suppressed"
                 if suppressed else "")
+        elapsed = time.perf_counter() - t_start
         print(f"hvd-lint: {len(diags)} finding(s) "
               f"({errors} error(s), {len(diags) - errors} warning(s)"
-              f"{tail})")
+              f"{tail}) in {elapsed:.2f}s")
 
     if fail_on == "never":
         return 0

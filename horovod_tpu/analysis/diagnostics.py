@@ -74,6 +74,20 @@ RULES = {
                       "through a bucketing/concatenating path (its "
                       "scale-invariant combination is defined per whole "
                       "tensor; bucketing silently changes the math)"),
+    # -- symbolic schedule simulator (analysis/simulate.py) ----------------
+    "HVD501": (ERROR, "proven deadlock: symbolic N-rank simulation of the "
+                      "extracted schedules finds irreconcilable per-rank "
+                      "collective sequences (counterexample trace "
+                      "attached — one event list per symbolic rank up to "
+                      "the hang point)"),
+    "HVD502": (ERROR, "proven digest mismatch: a matched collective slot "
+                      "diverges in statically-computable fields "
+                      "(kind/op) across symbolic ranks — the guardian "
+                      "abort foretold at lint time"),
+    "HVD503": (WARNING, "possible hang: bounded schedule simulation "
+                        "(scenario caps, loop widening, inline depth) "
+                        "could neither prove nor refute divergence "
+                        "under rank-tainted control flow"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "
@@ -96,7 +110,12 @@ _SEV_ORDER = {ERROR: 0, WARNING: 1}
 
 @dataclasses.dataclass
 class Diagnostic:
-    """One finding, renderable as text or JSON."""
+    """One finding, renderable as text or JSON.
+
+    ``trace`` is the structured per-symbolic-rank counterexample the
+    schedule simulator (analysis/simulate.py) attaches to proven
+    HVD501/502 findings — rendered as SARIF ``codeFlows`` and by the
+    CLI text formatter; ``None`` for every other rule."""
 
     rule: str
     severity: str
@@ -104,12 +123,15 @@ class Diagnostic:
     file: str = "<unknown>"
     line: int = 0
     hint: str = ""
+    trace: dict = None
 
     @classmethod
-    def make(cls, rule, message, file="<unknown>", line=0, hint=""):
+    def make(cls, rule, message, file="<unknown>", line=0, hint="",
+             trace=None):
         severity = RULES.get(rule, (ERROR, ""))[0]
         return cls(rule=rule, severity=severity, message=message,
-                   file=file, line=int(line or 0), hint=hint)
+                   file=file, line=int(line or 0), hint=hint,
+                   trace=trace)
 
     @property
     def location(self):
@@ -122,7 +144,10 @@ class Diagnostic:
         return out
 
     def to_dict(self):
-        return dataclasses.asdict(self)
+        out = dataclasses.asdict(self)
+        if out.get("trace") is None:
+            del out["trace"]
+        return out
 
     def sort_key(self):
         return (self.file, self.line, _SEV_ORDER.get(self.severity, 9),

@@ -44,6 +44,57 @@ def _uri(path):
     return relative_to_cwd(path, posix=True)
 
 
+def _thread_flow_location(file, line, text):
+    return {"location": {
+        "physicalLocation": {
+            "artifactLocation": {"uri": _uri(file)},
+            "region": {"startLine": max(1, int(line or 0))},
+        },
+        "message": {"text": text},
+    }}
+
+
+def _code_flows(diag):
+    """The simulator's counterexample trace as a SARIF
+    ``codeFlows``/``threadFlows`` object — ONE threadFlow per symbolic
+    rank, so code-scanning UIs render the interleaving that deadlocks:
+    each rank's matched prefix, then its blocked/mismatched head (or
+    exhaustion), then the fork points that split the paths."""
+    trace = getattr(diag, "trace", None)
+    if not trace:
+        return None
+    thread_flows = []
+    for entry in trace.get("ranks", []):
+        locations = []
+        for ev in entry.get("events", []):
+            name = f" name={ev['name']!r}" if ev.get("name") else ""
+            locations.append(_thread_flow_location(
+                ev["file"], ev["line"],
+                f"rank {entry['rank']}: {ev['kind']}{name} "
+                f"[{ev['status']}]"))
+        if entry.get("end") == "exhausted":
+            anchor = trace.get("forks") or [
+                {"file": diag.file, "line": diag.line}]
+            locations.append(_thread_flow_location(
+                anchor[0]["file"], anchor[0]["line"],
+                f"rank {entry['rank']}: schedule exhausted — "
+                "submits nothing further"))
+        if not locations:
+            locations.append(_thread_flow_location(
+                diag.file, diag.line,
+                f"rank {entry['rank']}: no collective submissions"))
+        thread_flows.append({"id": f"rank {entry['rank']}",
+                             "locations": locations})
+    if not thread_flows:
+        return None
+    flow = {"threadFlows": thread_flows}
+    forks = trace.get("forks", [])
+    if forks:
+        flow["message"] = {"text": "schedules fork at " + "; ".join(
+            f"{f['file']}:{f['line']} ({f['why']})" for f in forks)}
+    return [flow]
+
+
 def to_sarif(diags, suppressed=()):
     """Build the SARIF 2.1.0 document for ``diags`` (new findings) plus
     ``suppressed`` (baseline-suppressed findings, emitted with a
@@ -80,6 +131,9 @@ def to_sarif(diags, suppressed=()):
             }],
             "partialFingerprints": {"hvdLintKey/v1": key},
         }
+        code_flows = _code_flows(d)
+        if code_flows:
+            result["codeFlows"] = code_flows
         if len(results) >= len(diags):
             result["suppressions"] = [{
                 "kind": "external",

@@ -67,7 +67,7 @@ import re
 from .diagnostics import Diagnostic, dedupe, relative_to_cwd
 from .ast_lint import (
     AliasResolver, _apply_suppressions, _root_name, _terminal_name,
-    iter_python_files,
+    iter_python_files, parse_cached,
 )
 
 _DOC_HINT = "see docs/lint.md"
@@ -130,9 +130,10 @@ class ScheduleEvent:
     """One collective in the symbolic per-rank schedule."""
 
     __slots__ = ("kind", "name", "pset", "op", "line", "ctx",
-                 "from_concat")
+                 "from_concat", "pattern")
 
-    def __init__(self, kind, name, pset, op, line, ctx, from_concat):
+    def __init__(self, kind, name, pset, op, line, ctx, from_concat,
+                 pattern=None):
         self.kind = kind
         self.name = name              # explicit name= constant, or None
         self.pset = pset              # "global" or the unparsed expr
@@ -140,6 +141,10 @@ class ScheduleEvent:
         self.line = line
         self.ctx = ctx                # tuple of _Frame
         self.from_concat = from_concat
+        # regex for an f-string name= (``f"step{epoch}"`` -> ``step(.+)``)
+        # — what lets `hvd-lint explain` map a runtime name like
+        # ``step3`` back to this call site. None for constant/absent.
+        self.pattern = pattern
 
     def to_dict(self, func):
         return {
@@ -199,6 +204,7 @@ class _Func:
         self.exits = []
         self.loops = []
         self.frames = []
+        self.program = []             # structured tree (walk_block doc)
         # fixpoint summary bits
         self.return_tainted = False
         self.guard_params = frozenset()
@@ -289,9 +295,7 @@ class _Corpus:
         if len(self.modules) >= _MAX_MODULES:
             return None
         try:
-            with open(path, encoding="utf-8", errors="replace") as f:
-                src = f.read()
-            tree = ast.parse(src, filename=path)
+            src, tree = parse_cached(path)
         except (OSError, SyntaxError):
             return None
         mod = _Module(path, src, tree)
@@ -447,7 +451,7 @@ class _FuncWalker:
                          if isinstance(n, ast.Name) and n.id in params)
 
     # -- expression scan: events + call sites ------------------------------
-    def scan_expr(self, expr, ctx):
+    def scan_expr(self, expr, ctx, prog=None):
         if expr is None:
             return
         for n in ast.walk(expr):
@@ -455,19 +459,34 @@ class _FuncWalker:
                 continue
             kind = self.res.collective_kind(n)
             if kind is not None:
-                self._record_event(n, kind, ctx)
+                self._record_event(n, kind, ctx, prog)
                 continue
             callee = self.corpus.resolve_call(n, self.func, self.module)
             if callee is None or callee is self.func:
                 continue
-            self._record_call(n, callee, ctx)
+            self._record_call(n, callee, ctx, prog)
 
-    def _record_event(self, n, kind, ctx):
-        name = op = None
+    @staticmethod
+    def _name_pattern(node):
+        """Regex for an f-string ``name=`` (constant parts escaped,
+        interpolations matched loosely), or None."""
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(re.escape(str(v.value)))
+            else:
+                parts.append("(.+)")
+        return "".join(parts) or None
+
+    def _record_event(self, n, kind, ctx, prog=None):
+        name = op = pattern = None
         pset = "global"
         for kw in n.keywords:
             if kw.arg == "name" and isinstance(kw.value, ast.Constant):
                 name = str(kw.value.value)
+            elif kw.arg == "name" and isinstance(kw.value,
+                                                 ast.JoinedStr):
+                pattern = self._name_pattern(kw.value)
             elif kw.arg == "op":
                 op = _terminal_name(kw.value)
             elif kw.arg == "process_set":
@@ -483,8 +502,11 @@ class _FuncWalker:
             elif (isinstance(first, ast.Name)
                     and first.id in self.concat_vars):
                 from_concat = True
-        self.func.events.append(ScheduleEvent(
-            kind, name, pset, op, n.lineno, tuple(ctx), from_concat))
+        event = ScheduleEvent(kind, name, pset, op, n.lineno,
+                              tuple(ctx), from_concat, pattern)
+        self.func.events.append(event)
+        if prog is not None:
+            prog.append(("ev", event))
         # an op= that is a bare parameter feeding a grouped/bucketed
         # collective: record for the interprocedural HVD405 check
         if kind.startswith(_GROUPED_PREFIX):
@@ -494,7 +516,7 @@ class _FuncWalker:
                     self.func.grouped_op_params = (
                         self.func.grouped_op_params | {kw.value.id})
 
-    def _record_call(self, n, callee, ctx):
+    def _record_call(self, n, callee, ctx, prog=None):
         tainted_params, adasum_params = set(), set()
         arg_params, arg_names = {}, set()
         own = set(self.func.params)
@@ -519,9 +541,12 @@ class _FuncWalker:
         for kw in n.keywords:
             if kw.arg and kw.arg in callee.params:
                 bind(kw.arg, kw.value)
-        self.func.calls.append(_CallSite(
+        site = _CallSite(
             callee, n.lineno, tuple(ctx), frozenset(tainted_params),
-            frozenset(adasum_params), arg_params, frozenset(arg_names)))
+            frozenset(adasum_params), arg_params, frozenset(arg_names))
+        self.func.calls.append(site)
+        if prog is not None:
+            prog.append(("call", site))
 
     # -- assignment bookkeeping --------------------------------------------
     @staticmethod
@@ -583,10 +608,11 @@ class _FuncWalker:
         fn = self.func
         fn.events, fn.calls, fn.exits = [], [], []
         fn.loops, fn.frames = [], []
+        fn.program = []
         fn.return_tainted = False
         fn.grouped_op_params = frozenset()
         body = fn.body if fn.node is not None else fn.module.tree.body
-        self.walk_block(body, [])
+        self.walk_block(body, [], fn.program)
         fn.has_coll = bool(fn.events)
 
     def _make_frame(self, kind, test, line, loop=False):
@@ -598,15 +624,25 @@ class _FuncWalker:
         self.func.frames.append(frame)
         return frame
 
-    def walk_block(self, stmts, ctx):
+    def walk_block(self, stmts, ctx, prog):
+        """Walk statements recording events/calls/exits/loops (the rule
+        inputs) AND building the structured **program tree** in ``prog``
+        — the executable form the schedule simulator
+        (analysis/simulate.py) replays per symbolic rank. Node shapes:
+        ``("ev", ScheduleEvent)``, ``("call", _CallSite)``,
+        ``("br", _Frame, then_prog, else_prog)``,
+        ``("loop", _Loop, body_prog)``, ``("exit", _Exit)``, and
+        ``("opt", prog)`` for exception handlers (never executed by the
+        simulator — exception paths are a documented approximation)."""
         for node in stmts:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
                 continue  # separate _Func entries
             elif isinstance(node, ast.If):
-                self.scan_expr(node.test, ctx)
+                self.scan_expr(node.test, ctx, prog)
                 frame = self._make_frame("if", node.test, node.lineno)
-                self.walk_block(node.body, ctx + [frame])
+                then_prog, else_prog = [], []
+                self.walk_block(node.body, ctx + [frame], then_prog)
                 other = _Frame("else", node.lineno, frame.tainted,
                                frame.direct,
                                test_params=frame.test_params,
@@ -614,9 +650,10 @@ class _FuncWalker:
                 frame.partner = other
                 other.partner = frame
                 self.func.frames.append(other)
-                self.walk_block(node.orelse, ctx + [other])
+                self.walk_block(node.orelse, ctx + [other], else_prog)
+                prog.append(("br", frame, then_prog, else_prog))
             elif isinstance(node, ast.While):
-                self.scan_expr(node.test, ctx)
+                self.scan_expr(node.test, ctx, prog)
                 frame = self._make_frame("while", node.test, node.lineno,
                                          loop=True)
                 loop = _Loop(frame, "while", node.lineno,
@@ -624,11 +661,13 @@ class _FuncWalker:
                               if isinstance(m, ast.Name)})
                 self.func.loops.append(loop)
                 self.active_loops.append(loop)
-                self.walk_block(node.body, ctx + [frame])
+                body_prog = []
+                self.walk_block(node.body, ctx + [frame], body_prog)
                 self.active_loops.pop()
-                self.walk_block(node.orelse, ctx)
+                prog.append(("loop", loop, body_prog))
+                self.walk_block(node.orelse, ctx, prog)
             elif isinstance(node, (ast.For, ast.AsyncFor)):
-                self.scan_expr(node.iter, ctx)
+                self.scan_expr(node.iter, ctx, prog)
                 frame = self._make_frame("for", node.iter, node.lineno,
                                          loop=True)
                 if frame.tainted:
@@ -647,24 +686,28 @@ class _FuncWalker:
                 loop = _Loop(frame, "for", node.lineno, set())
                 self.func.loops.append(loop)
                 self.active_loops.append(loop)
-                self.walk_block(node.body, ctx + [frame])
+                body_prog = []
+                self.walk_block(node.body, ctx + [frame], body_prog)
                 self.active_loops.pop()
-                self.walk_block(node.orelse, ctx)
+                prog.append(("loop", loop, body_prog))
+                self.walk_block(node.orelse, ctx, prog)
             elif isinstance(node, ast.Try):
-                self.walk_block(node.body, ctx)
+                self.walk_block(node.body, ctx, prog)
                 for handler in node.handlers:
-                    self.walk_block(handler.body, ctx)
-                self.walk_block(node.orelse, ctx)
-                self.walk_block(node.finalbody, ctx)
+                    handler_prog = []
+                    self.walk_block(handler.body, ctx, handler_prog)
+                    prog.append(("opt", handler_prog))
+                self.walk_block(node.orelse, ctx, prog)
+                self.walk_block(node.finalbody, ctx, prog)
             elif isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
-                    self.scan_expr(item.context_expr, ctx)
-                self.walk_block(node.body, ctx)
+                    self.scan_expr(item.context_expr, ctx, prog)
+                self.walk_block(node.body, ctx, prog)
             elif isinstance(node, ast.Assign):
-                self.scan_expr(node.value, ctx)
+                self.scan_expr(node.value, ctx, prog)
                 self._note_assign(node.targets, node.value)
             elif isinstance(node, ast.AugAssign):
-                self.scan_expr(node.value, ctx)
+                self.scan_expr(node.value, ctx, prog)
                 # += keeps the existing classification ("pure" update)
                 for loop in self.active_loops:
                     for name in self._target_names(node.target):
@@ -672,33 +715,37 @@ class _FuncWalker:
                                 and name not in loop.body_assigns):
                             loop.body_assigns[name] = "pure"
             elif isinstance(node, ast.AnnAssign):
-                self.scan_expr(node.value, ctx)
+                self.scan_expr(node.value, ctx, prog)
                 if node.value is not None:
                     self._note_assign([node.target], node.value)
             elif isinstance(node, ast.Return):
-                self.scan_expr(node.value, ctx)
+                self.scan_expr(node.value, ctx, prog)
                 if self.expr_tainted(node.value):
                     self.func.return_tainted = True
-                self.func.exits.append(_Exit("return", node.lineno,
-                                             tuple(ctx)))
+                exit_ = _Exit("return", node.lineno, tuple(ctx))
+                self.func.exits.append(exit_)
+                prog.append(("exit", exit_))
             elif isinstance(node, ast.Raise):
-                self.scan_expr(node.exc, ctx)
-                self.func.exits.append(_Exit("raise", node.lineno,
-                                             tuple(ctx)))
+                self.scan_expr(node.exc, ctx, prog)
+                exit_ = _Exit("raise", node.lineno, tuple(ctx))
+                self.func.exits.append(exit_)
+                prog.append(("exit", exit_))
             elif isinstance(node, ast.Continue):
-                self.func.exits.append(_Exit("continue", node.lineno,
-                                             tuple(ctx)))
+                exit_ = _Exit("continue", node.lineno, tuple(ctx))
+                self.func.exits.append(exit_)
+                prog.append(("exit", exit_))
             elif isinstance(node, ast.Break):
-                self.func.exits.append(_Exit("break", node.lineno,
-                                             tuple(ctx)))
+                exit_ = _Exit("break", node.lineno, tuple(ctx))
+                self.func.exits.append(exit_)
+                prog.append(("exit", exit_))
             elif isinstance(node, ast.Expr):
-                self.scan_expr(node.value, ctx)
+                self.scan_expr(node.value, ctx, prog)
             else:
                 # assert/delete/global/... — scan any embedded
                 # expressions; no new control context
                 for child in ast.iter_child_nodes(node):
                     if isinstance(child, ast.expr):
-                        self.scan_expr(child, ctx)
+                        self.scan_expr(child, ctx, prog)
 
 
 def _mentions(pset_text, var):

@@ -75,6 +75,32 @@ def load_paths(paths, kinds=(SHARD_PREFIX, POSTMORTEM_PREFIX)):
     return [load_shard(p) for p in shard_paths(paths, kinds)]
 
 
+def bundle_by_rank(shards, version=None):
+    """Group loaded shards into one record per rank for forensic
+    consumers (``hvd-lint explain``, report tooling): keep only the
+    newest elastic ``version`` present (or the explicit one), and when
+    a rank left several dumps for that version (respawns share a
+    directory), keep the newest by meta timestamp. Returns
+    ``(version, {rank: shard})``."""
+    if not shards:
+        return None, {}
+    if version is None:
+        version = max(s["meta"].get("ver", 0) or 0 for s in shards)
+    by_rank = {}
+    for s in shards:
+        meta = s["meta"]
+        if (meta.get("ver", 0) or 0) != version:
+            continue
+        rank = meta.get("rank")
+        if rank is None:
+            continue
+        prev = by_rank.get(rank)
+        if prev is None or (meta.get("t", 0)
+                            > prev["meta"].get("t", 0)):
+            by_rank[rank] = s
+    return version, by_rank
+
+
 def aligned(t, meta, align=True):
     """A local stamp moved onto the driver's clock."""
     return t - meta.get("off", 0.0) if align else t

@@ -3,15 +3,24 @@
 #
 # Three legs, all of which must hold or the gate fails:
 #   1. self-analysis  — hvd-lint --self --check-knobs: every rule
-#      (HVD2xx + HVD3xx + the interprocedural HVD4xx) over horovod_tpu/
-#      itself plus the knob-registry/docs cross-check, failing on
-#      warnings.
+#      (HVD2xx + HVD3xx + the interprocedural HVD4xx + the simulated
+#      HVD5xx) over horovod_tpu/ itself plus the knob-registry/docs
+#      cross-check, failing on warnings.
 #   2. dogfood sweep  — hvd-lint verify over examples/ and bench.py,
-#      failing on warnings: the shipped entry points stay clean.
+#      failing on warnings: the shipped entry points stay clean (the
+#      schedule simulator included — zero HVD5xx).
 #   3. canary corpus  — the fixture corpus must still TRIP every rule
-#      family (a gate that stopped seeing its fixtures has rotted), and
-#      its findings are emitted as lint.sarif (SARIF 2.1.0) for the CI
+#      family (a gate that stopped seeing its fixtures has rotted),
+#      including the simulator's proven HVD501/502 and the bounded
+#      HVD503, and its findings are emitted as lint.sarif (SARIF
+#      2.1.0, counterexample traces as codeFlows) for the CI
 #      artifact/code-scanning upload.
+#
+# Each leg reports its analysis wall time; within one hvd-lint
+# invocation the AST, verify, and simulate layers share one parsed
+# corpus and one call-graph fixpoint (analysis/ast_lint.py
+# parse_cached), so the gate's cost is one corpus build per leg, not
+# one per layer.
 #
 # Env: LINT_SARIF_OUT overrides the artifact path (default: lint.sarif
 # in the repo root). HVDTPU_LINT_BASELINE is honored by hvd-lint itself
@@ -23,18 +32,27 @@ sarif_out="${LINT_SARIF_OUT:-lint.sarif}"
 python="${PYTHON:-python3}"
 command -v "${python}" >/dev/null 2>&1 || python=python
 run_lint() { "${python}" -m horovod_tpu.analysis.cli "$@"; }
+leg_t0=0
+leg_start() { leg_t0=${SECONDS}; }
+leg_done() { echo "-- leg wall time: $((SECONDS - leg_t0))s"; }
 
-echo "== hvd-lint: self-analysis (HVD2xx/3xx/4xx + knob docs) =="
+echo "== hvd-lint: self-analysis (HVD2xx/3xx/4xx/5xx + knob docs) =="
+leg_start
 run_lint --self --check-knobs
+leg_done
 
 echo "== hvd-lint verify: examples/ + bench.py (fail on warnings) =="
+leg_start
 run_lint verify examples bench.py --fail-on warning
+leg_done
 
 echo "== hvd-lint verify: fixture corpus -> ${sarif_out} =="
 # --fail-on never: the corpus is SUPPOSED to be full of findings; the
 # canary below asserts they are all still being caught.
+leg_start
 run_lint verify tests/lint_fixtures --format sarif --fail-on never \
     > "${sarif_out}"
+leg_done
 
 "${python}" - "${sarif_out}" <<'EOF'
 import json
@@ -45,11 +63,19 @@ assert doc["version"] == "2.1.0", doc["version"]
 results = doc["runs"][0]["results"]
 rules = {r["ruleId"] for r in results}
 families = {rule[:4] for rule in rules if rule.startswith("HVD")}
-missing = {"HVD2", "HVD3", "HVD4"} - families
+missing = {"HVD2", "HVD3", "HVD4", "HVD5"} - families
 assert not missing, f"fixture corpus no longer trips {sorted(missing)}xx"
 for tag in ("HVD210", "HVD401", "HVD402", "HVD403", "HVD404",
-            "HVD405"):
+            "HVD405", "HVD501", "HVD502", "HVD503"):
     assert tag in rules, f"fixture corpus no longer trips {tag}"
+# Proven findings must ship their counterexample: one threadFlow per
+# symbolic rank, rendered by code-scanning UIs.
+flows = [r for r in results
+         if r["ruleId"] in ("HVD501", "HVD502")]
+assert flows, "no proven HVD501/502 results in the corpus"
+for r in flows:
+    tfs = r.get("codeFlows", [{}])[0].get("threadFlows", [])
+    assert len(tfs) >= 2, f"{r['ruleId']} result lacks per-rank threadFlows"
 print(f"canary ok: {len(results)} finding(s), "
       f"{len(rules)} rule(s), families {sorted(families)}")
 EOF

@@ -1,0 +1,107 @@
+"""Lint fixture (never executed): schedules the symbolic simulator
+PROVES deadlock on — shapes the heuristic HVD4xx family is blind to
+(every positive here sits in a balanced branch, which HVD401 exempts).
+
+Expected findings (hvd-lint verify): HVD501 x4 over three shapes —
+- balanced arms submitting DIFFERENT explicit names (the slots never
+  negotiate together),
+- a three-way rank fork where each arm submits its own slot (two
+  counterexamples: way.a-vs-way.b and way.b-vs-way.c),
+- balanced arms whose schedules differ in LENGTH (one arm submits an
+  extra collective nobody else ever matches);
+plus HVD503 x1 — the depth-capped helper chain the simulator cannot
+fully inline (bounded exploration, possible hang).
+"""
+
+import horovod_tpu as hvd
+
+
+def balanced_incompatible_names(x):
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="alpha")  # HVD501: alpha vs beta
+    else:
+        hvd.allreduce(x, name="beta")
+
+
+def three_way_fork(x):
+    r = hvd.rank()
+    if r == 0:
+        hvd.allreduce(x, name="way.a")  # HVD501: a vs b (vs c at n=3)
+    elif r == 1:
+        hvd.allreduce(x, name="way.b")  # HVD501: b vs c
+    else:
+        hvd.allreduce(x, name="way.c")
+
+
+def balanced_length_divergence(x):
+    if hvd.rank() == 0:
+        x = hvd.allreduce(x, name="shared")
+        hvd.barrier()  # HVD501: only the root arm submits the barrier
+    else:
+        x = hvd.allreduce(x, name="shared")
+    return x
+
+
+# -- bounded exploration (HVD503) ------------------------------------------
+def _deep5(x):
+    return hvd.allreduce(x, name="deep")
+
+
+def _deep4(x):
+    return _deep5(x)
+
+
+def _deep3(x):
+    return _deep4(x)
+
+
+def _deep2(x):
+    return _deep3(x)
+
+
+def _deep1(x):
+    return _deep2(x)
+
+
+def capped_inline_depth(x):
+    x = hvd.allreduce(x, name="visible")
+    if hvd.rank() == 0:  # HVD503: `deep` hides past the inline cap
+        x = _deep1(x)
+    else:
+        x = _deep1(x)
+    return x
+
+
+# -- negatives -------------------------------------------------------------
+def balanced_compatible(x):
+    # Same slot from both arms: the simulator matches them — clean.
+    if hvd.rank() == 0:
+        x = hvd.allreduce(x, name="same.slot")
+    else:
+        x = hvd.allreduce(x, name="same.slot")
+    return x
+
+
+def laundered_guard(x, n):
+    # Collective results are replica-invariant: no fork, no finding.
+    total = hvd.allreduce(n, name="launder")
+    if total > 0:
+        x = hvd.allreduce(x, name="after.launder")
+    return x
+
+
+def member_only_is_unprovable(x):
+    # Non-global process sets have statically-unknown membership: the
+    # simulator never claims a proof about them — clean here.
+    crew = hvd.add_process_set([0, 1])
+    if crew.included():
+        x = hvd.allreduce(x, name="crew.only", process_set=crew)
+    return x
+
+
+def suppressed_with_rationale(x):
+    # fixture: divergence is reconciled by an external barrier layer
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="sup.a")  # hvd-lint: disable=HVD501
+    else:
+        hvd.allreduce(x, name="sup.b")

@@ -1,0 +1,71 @@
+"""Lint fixture (never executed): matched slots whose
+statically-computable fields diverge — the simulator PROVES the
+guardian digest abort (HVD502) that would otherwise cost a live cohort
+at runtime. Every positive sits in a balanced branch (HVD4xx-silent).
+
+Expected findings (hvd-lint verify): HVD502 x3 —
+- one named slot reduced under Sum on one arm and Adasum on the other
+  (the Adasum op fence),
+- one named slot submitted as allreduce vs allgather (kind field),
+- one named slot riding the ZeRO legs in divergent order
+  (reducescatter vs allgather — the sharded-update fence).
+"""
+
+import horovod_tpu as hvd
+
+
+def op_fence_sum_vs_adasum(x):
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="grad", op=hvd.Sum)  # HVD502: op diverges
+    else:
+        hvd.allreduce(x, name="grad", op=hvd.Adasum)
+
+
+def kind_divergence(x):
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="payload")  # HVD502: kind diverges
+    else:
+        hvd.allgather(x, name="payload")
+
+
+def zero_leg_divergence(x):
+    if hvd.rank() == 0:
+        hvd.reducescatter(x, name="zero.leg")  # HVD502: scatter vs gather
+    else:
+        hvd.allgather(x, name="zero.leg")
+
+
+# -- negatives -------------------------------------------------------------
+def same_fields_clean(x):
+    if hvd.rank() == 0:
+        x = hvd.allreduce(x, name="ok", op=hvd.Average)
+    else:
+        x = hvd.allreduce(x, name="ok", op=hvd.Average)
+    return x
+
+
+def unknown_op_is_compatible(x):
+    # One arm names no op: not statically computable — never a proof.
+    if hvd.rank() == 0:
+        x = hvd.allreduce(x, name="soft", op=hvd.Sum)
+    else:
+        x = hvd.allreduce(x, name="soft")
+    return x
+
+
+def fstring_names_are_unprovable(x, epoch):
+    # f-string names make the slot key unknowable at lint time: the
+    # simulator assumes it matches rather than prove from a guess.
+    if hvd.rank() == 0:
+        x = hvd.allreduce(x, name=f"ep{epoch}.a")
+    else:
+        x = hvd.allreduce(x, name=f"ep{epoch}.b")
+    return x
+
+
+def suppressed_with_rationale(x):
+    # fixture: arms run under disjoint deployments, never one cohort
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="w", op=hvd.Sum)  # hvd-lint: disable=HVD502
+    else:
+        hvd.allreduce(x, name="w", op=hvd.Adasum)

@@ -1,0 +1,28 @@
+"""Lint fixture (never executed): the training program whose abort
+left the golden postmortem bundle (postmortem_bundle/). Shapes mirror
+the chaos-matrix stall row's elastic worker: a fixed epoch loop
+submitting one f-string-named allreduce per epoch.
+
+`hvd-lint explain tests/lint_fixtures/postmortem_bundle --program
+tests/lint_fixtures/sim_explain_program.py` must name the `step3` slot
+and point at the allreduce below (the f-string pattern `step{...}` is
+how the runtime name maps back here).
+"""
+
+import horovod_tpu as hvd
+
+
+def train(state, epochs, grads_of):
+    while state.epoch < epochs:
+        out = hvd.allreduce(grads_of(state), op=hvd.Sum,
+                            name=f"step{state.epoch}")
+        state.apply(out)
+        state.epoch += 1
+        state.commit()
+    return state.epoch
+
+
+def main():
+    hvd.init()
+    state = hvd.elastic.ObjectState(epoch=0)
+    return train(state, 6, lambda s: s.grads)

@@ -478,6 +478,30 @@ def test_stall_abort_leaves_postmortem_bundle_and_merged_trace(tmp_path):
                            "--out", str(pm_out)]) == 0
     assert json.loads(pm_out.read_text())["traceEvents"]
 
+    # (a2) The real abort bundle is explainable end-to-end (ISSUE 14):
+    # `hvd-lint explain` aligns the runtime sub/fin sequences against
+    # the statically extracted schedule of the worker program, names
+    # the never-submitted slot, the HVD501 diagnosis, and the exact
+    # source line (the f-string `step{...}` name maps back through
+    # the extractor's pattern).
+    from horovod_tpu.analysis import explain as lint_explain
+    worker_src = os.path.join(os.path.dirname(__file__),
+                              "elastic_worker.py")
+    report = lint_explain.explain_bundle(str(trace_dir), [worker_src])
+    div = report["divergence"]
+    assert div is not None, report
+    assert div["name"] == "step3"
+    assert div["type"] == "missing_submission"
+    assert div["rule"] == "HVD501"
+    assert div["submitted_by"] == [0]
+    assert div["involved_ranks"] == [1]
+    assert div["sources"], report
+    assert div["sources"][0]["file"].endswith("elastic_worker.py")
+    assert div["sources"][0]["kind"] == "allreduce"
+    explained = lint_explain.render_report(report)
+    assert "first divergent slot: `step3`" in explained
+    assert "elastic_worker.py" in explained
+
     # (b) Full-run merge + analysis: shards from both workers (pre- and
     # post-reset cohorts push under distinct versions/pids).
     shards = trace_merge.load_paths(

@@ -22,7 +22,9 @@ from jax import lax
 from conftest import clean_spawn_env
 from horovod_tpu import analysis
 from horovod_tpu.analysis import (ast_lint, baseline as baseline_mod,
-                                  sarif as sarif_mod, schedule)
+                                  explain as explain_mod,
+                                  sarif as sarif_mod, schedule,
+                                  simulate)
 from horovod_tpu.analysis.diagnostics import Diagnostic
 from horovod_tpu.analysis.order_guard import SubmissionOrderGuard
 from horovod_tpu.exceptions import (CollectiveLintError,
@@ -604,6 +606,29 @@ _SARIF_21_SCHEMA = {
                                             "minimum": 1}}},
                                 }}}}},
                         "partialFingerprints": {"type": "object"},
+                        "codeFlows": {"type": "array", "items": {
+                            "type": "object",
+                            "required": ["threadFlows"],
+                            "properties": {
+                                "message": {"type": "object",
+                                            "required": ["text"]},
+                                "threadFlows": {
+                                    "type": "array", "minItems": 1,
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["locations"],
+                                        "properties": {
+                                            "id": {"type": "string"},
+                                            "locations": {
+                                                "type": "array",
+                                                "minItems": 1,
+                                                "items": {
+                                                    "type": "object",
+                                                    "required": [
+                                                        "location"],
+                                                }},
+                                        }}},
+                            }}},
                         "suppressions": {"type": "array", "items": {
                             "type": "object", "required": ["kind"],
                             "properties": {"kind": {
@@ -647,6 +672,49 @@ class TestSarifOutput:
             # ruleIndex must actually point at its rule
             assert rules[result["ruleIndex"]] == result["ruleId"]
             assert "hvdLintKey/v1" in result["partialFingerprints"]
+
+    def test_sim_golden_file(self):
+        """Pin the exact SARIF document for a proven HVD501 finding —
+        counterexample trace as codeFlows, one threadFlow per symbolic
+        rank — against the checked-in golden."""
+        src = ("import horovod_tpu as hvd\n"
+               "def exchange(x):\n"
+               "    if hvd.rank() == 0:\n"
+               "        hvd.allreduce(x, name='alpha')\n"
+               "    else:\n"
+               "        hvd.allreduce(x, name='beta')\n")
+        diags = simulate.simulate_source(src, "golden/train.py")
+        assert rules_of(diags) == ["HVD501"]
+        doc = sarif_mod.to_sarif(diags)
+        doc["runs"][0]["tool"]["driver"]["version"] = "GOLDEN"
+        with open(os.path.join(FIXTURES, "golden_sim.sarif")) as f:
+            golden = json.load(f)
+        assert doc == golden
+
+    def test_sim_corpus_codeflows_validate_against_schema(self):
+        import jsonschema
+        proc = _run_cli("verify",
+                        os.path.join(FIXTURES, "bad_sim_deadlock.py"),
+                        os.path.join(FIXTURES, "bad_sim_mismatch.py"),
+                        "--format", "sarif", "--fail-on", "never")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        jsonschema.validate(doc, _SARIF_21_SCHEMA)
+        results = doc["runs"][0]["results"]
+        proven = [r for r in results
+                  if r["ruleId"] in ("HVD501", "HVD502")]
+        assert len(proven) == 7
+        for r in proven:
+            flows = r["codeFlows"]
+            thread_flows = flows[0]["threadFlows"]
+            # one threadFlow per symbolic rank, each with locations
+            assert len(thread_flows) >= 2
+            ids = {tf["id"] for tf in thread_flows}
+            assert any(i.startswith("rank") for i in ids)
+        # the HVD503 approximation carries no counterexample
+        for r in results:
+            if r["ruleId"] == "HVD503":
+                assert "codeFlows" not in r
 
     def test_suppressed_results_are_marked_not_dropped(self):
         d = Diagnostic.make("HVD402", "divergent loop",
@@ -756,7 +824,9 @@ class TestBaseline:
         proc = _run_cli("verify", fixture, str(extra),
                         "--baseline", base)
         assert proc.returncode == 1, proc.stdout + proc.stderr
-        assert "HVD401" in proc.stdout
+        # the injected rank-gated collective is a PROVEN deadlock now:
+        # HVD501 supersedes the heuristic HVD401 on the same event
+        assert "HVD501" in proc.stdout
         assert "regression.py" in proc.stdout
 
     def test_env_knob_default_baseline(self, tmp_path):
@@ -799,7 +869,10 @@ def test_ci_lint_script(tmp_path):
     doc = json.load(open(out))
     assert doc["version"] == "2.1.0"
     rules = {r["ruleId"] for r in doc["runs"][0]["results"]}
-    assert {"HVD401", "HVD402", "HVD403", "HVD404", "HVD405"} <= rules
+    assert {"HVD401", "HVD402", "HVD403", "HVD404", "HVD405",
+            "HVD501", "HVD502", "HVD503"} <= rules
+    # per-leg analysis wall time is part of the gate output
+    assert "leg wall time" in proc.stdout
 
 
 # ==========================================================================
@@ -837,6 +910,404 @@ def test_cli_clean_sweep_and_rule_listing():
     listing = _run_cli("--list-rules")
     assert listing.returncode == 0
     assert "HVD201" in listing.stdout
+
+
+# ==========================================================================
+# Symbolic N-rank schedule simulator (analysis/simulate.py, HVD5xx)
+# ==========================================================================
+class TestSimulator:
+    def test_deadlock_fixture(self):
+        """Pinned positives: 4 proven deadlocks over 3 shapes, plus
+        the bounded-exploration HVD503; negatives + the HVD501
+        suppression case stay silent."""
+        diags = simulate.simulate_paths(
+            [os.path.join(FIXTURES, "bad_sim_deadlock.py")])
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD501", 21), ("HVD501", 29), ("HVD501", 31),
+             ("HVD501", 39), ("HVD503", 68)]
+
+    def test_mismatch_fixture(self):
+        diags = simulate.simulate_paths(
+            [os.path.join(FIXTURES, "bad_sim_mismatch.py")])
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD502", 19), ("HVD502", 26), ("HVD502", 33)]
+
+    def test_every_proven_finding_carries_a_counterexample(self):
+        """Acceptance pin: every HVD501/502 positive ships a trace
+        with a pinned file:line event list for EACH symbolic rank."""
+        diags = simulate.simulate_paths(
+            [os.path.join(FIXTURES, "bad_sim_deadlock.py"),
+             os.path.join(FIXTURES, "bad_sim_mismatch.py")])
+        proven = [d for d in diags if d.rule in ("HVD501", "HVD502")]
+        assert len(proven) == 7
+        for d in proven:
+            trace = d.trace
+            assert trace and len(trace["ranks"]) >= 2, d.format()
+            for entry in trace["ranks"]:
+                if entry["end"] != "exhausted":
+                    assert entry["events"], (d.rule, entry)
+                for ev in entry["events"]:
+                    assert ev["file"].endswith(".py")
+                    assert ev["line"] >= 1
+            assert trace["forks"], d.format()
+
+    def test_clean_fixture_zero_hvd5xx(self):
+        """Acceptance: the balanced/laundered/member-guarded shapes
+        stay silent on the simulator too."""
+        path = os.path.join(FIXTURES, "good_verify_clean.py")
+        assert simulate.verify_and_simulate_paths([path]) == []
+
+    def test_proven_supersedes_401_on_same_event(self):
+        """Ownership contract (mirrors 201-vs-401): the proven finding
+        owns the event; no double report."""
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    is_root = hvd.rank() == 0\n"
+               "    if is_root:\n"
+               "        hvd.allreduce(x, name='a')\n")
+        diags = simulate.verify_and_simulate_source(src, "own401.py")
+        assert rules_of(diags) == ["HVD501"]
+
+    def test_proven_supersedes_402_on_same_loop(self):
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    for _ in range(hvd.rank() + 1):\n"
+               "        x = hvd.allgather(x, name='r')\n"
+               "    return x\n")
+        diags = simulate.verify_and_simulate_source(src, "own402.py")
+        assert rules_of(diags) == ["HVD501"]
+
+    def test_unprovable_shapes_keep_the_heuristic(self):
+        """The tainted-argument-steers-callee-guard shape is a
+        documented simulator approximation: HVD401 stays the owner,
+        and the data-dependent convergence while stays HVD402 (no
+        HVD503 double report on either)."""
+        diags = simulate.verify_and_simulate_paths(
+            [os.path.join(FIXTURES, "bad_tainted_schedule.py")])
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD501", 20), ("HVD501", 24), ("HVD401", 34)]
+        diags = simulate.verify_and_simulate_paths(
+            [os.path.join(FIXTURES, "bad_divergent_loop.py")])
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD501", 16), ("HVD501", 24), ("HVD402", 31)]
+
+    def test_hvd403_keeps_the_exit_501_names_the_collective(self):
+        """HVD403 (the exit line) and HVD501 (the skipped collective)
+        are complementary locations, both reported."""
+        diags = simulate.verify_and_simulate_paths(
+            [os.path.join(FIXTURES, "bad_skipped_collective.py")])
+        assert [(d.rule, d.line) for d in diags] == \
+            [("HVD403", 15), ("HVD501", 16), ("HVD403", 22),
+             ("HVD501", 23), ("HVD403", 29), ("HVD501", 30)]
+
+    def test_suppressed_heuristic_carries_over_to_proven(self):
+        """A `# hvd-lint: disable=HVD402` on the divergent loop waives
+        the proven HVD501 for the same fork too — the human already
+        reviewed that exact divergence."""
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    # padded upstream\n"
+               "    # hvd-lint: disable=HVD402\n"
+               "    for _ in range(hvd.rank() + 1):\n"
+               "        x = hvd.allgather(x, name='p')\n"
+               "    return x\n")
+        assert simulate.verify_and_simulate_source(src, "sup.py") == []
+
+    def test_balanced_incompatible_arms_proven(self):
+        """The headline precision gain: balanced branches (HVD401
+        exempt) with incompatible slots are a PROVEN deadlock."""
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    if hvd.rank() == 0:\n"
+               "        hvd.allreduce(x, name='alpha')\n"
+               "    else:\n"
+               "        hvd.allreduce(x, name='beta')\n")
+        diags = simulate.verify_and_simulate_source(src, "bal.py")
+        assert rules_of(diags) == ["HVD501"]
+        assert "alpha" in diags[0].message
+        assert "beta" in diags[0].message
+
+    def test_three_way_fork_found_by_n3_cohort(self):
+        """Both inner divergences of an elif chain are proven (the
+        n=3 cohort is what reaches the deepest arm)."""
+        diags = simulate.simulate_paths(
+            [os.path.join(FIXTURES, "bad_sim_deadlock.py")])
+        lines = [d.line for d in diags if d.rule == "HVD501"]
+        assert 29 in lines and 31 in lines
+
+    def test_trace_format_golden(self):
+        """Satellite pin: the HVD501 counterexample text format is
+        golden — tooling parses it."""
+        src = ("import horovod_tpu as hvd\n"
+               "def exchange(x):\n"
+               "    if hvd.rank() == 0:\n"
+               "        hvd.allreduce(x, name='alpha')\n"
+               "    else:\n"
+               "        hvd.allreduce(x, name='beta')\n")
+        diags = simulate.simulate_source(src, "golden/train.py")
+        assert rules_of(diags) == ["HVD501"]
+        assert simulate.render_trace(diags[0]) == (
+            "    counterexample (cohort: any n >= 2)\n"
+            "      rank r:\n"
+            "        1. allreduce(name='alpha')  golden/train.py:4"
+            "  [blocked]\n"
+            "      rank rest:\n"
+            "        1. allreduce(name='beta')  golden/train.py:6"
+            "  [blocked]\n"
+            "      forks:\n"
+            "        - golden/train.py:3: condition tests "
+            "rank()/membership directly — arms differ per rank")
+
+    def test_exhausted_rank_in_trace(self):
+        src = ("import horovod_tpu as hvd\n"
+               "def main(x):\n"
+               "    skip = hvd.rank() > 0\n"
+               "    if not skip:\n"
+               "        hvd.barrier()\n")
+        diags = simulate.simulate_source(src, "exh.py")
+        assert rules_of(diags) == ["HVD501"]
+        ends = {e["rank"]: e["end"]
+                for e in diags[0].trace["ranks"]}
+        assert "exhausted" in ends.values()
+        assert "blocked" in ends.values()
+
+    def test_fstring_names_never_proven(self):
+        diags = simulate.verify_and_simulate_paths(
+            [os.path.join(FIXTURES, "bad_sim_mismatch.py")])
+        # the fstring_names_are_unprovable negative contributes nothing
+        assert all(d.line < 50 for d in diags
+                   if d.rule.startswith("HVD5")), \
+            [(d.rule, d.line) for d in diags]
+
+    def test_dogfood_sweeps_stay_clean(self):
+        """Acceptance: no new false positives at fail-on-warning —
+        the package itself, examples/, bench.py, and the serving
+        plane produce zero HVD5xx findings."""
+        pkg = os.path.join(REPO, "horovod_tpu")
+        diags = simulate.verify_and_simulate_paths(
+            [os.path.join(pkg, "serving"), os.path.join(pkg, "spark"),
+             os.path.join(REPO, "examples"),
+             os.path.join(REPO, "bench.py")])
+        hvd5 = [d for d in diags if d.rule.startswith("HVD5")]
+        assert hvd5 == [], "\n".join(d.format() for d in hvd5)
+
+    def test_parse_cache_shared_across_layers(self, tmp_path):
+        """Satellite pin: one parse per file per invocation — the AST
+        layer and the verifier corpus reuse the same tree object."""
+        path = tmp_path / "cached.py"
+        path.write_text("import horovod_tpu as hvd\n"
+                        "def f(x):\n"
+                        "    return hvd.allreduce(x, name='c')\n")
+        src1, tree1 = ast_lint.parse_cached(str(path))
+        src2, tree2 = ast_lint.parse_cached(str(path))
+        assert tree1 is tree2
+        verifier = schedule.Verifier()
+        verifier.add_path(str(path))
+        mod = verifier.corpus.modules[os.path.abspath(str(path))]
+        assert mod.tree is tree1
+        # an edit invalidates the cache entry
+        time.sleep(0.01)
+        path.write_text("import horovod_tpu as hvd\n")
+        os.utime(str(path))
+        _, tree3 = ast_lint.parse_cached(str(path))
+        assert tree3 is not tree1
+
+    def test_cli_reports_wall_time(self, tmp_path):
+        path = tmp_path / "t.py"
+        path.write_text("x = 1\n")
+        proc = _run_cli(str(path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        import re as _re
+        assert _re.search(r"in \d+\.\d\ds", proc.stdout), proc.stdout
+
+    def test_rules_in_catalog_and_cli_listing(self):
+        for rule in ("HVD501", "HVD502", "HVD503"):
+            assert rule in analysis.RULES
+        listing = _run_cli("--list-rules")
+        assert "HVD501" in listing.stdout
+        assert "HVD503" in listing.stdout
+
+
+# ==========================================================================
+# hvd-lint explain (analysis/explain.py): postmortem → source line
+# ==========================================================================
+class TestExplain:
+    BUNDLE = os.path.join(FIXTURES, "postmortem_bundle")
+    PROGRAM = os.path.join(FIXTURES, "sim_explain_program.py")
+
+    def test_golden_bundle_roundtrip(self):
+        """Satellite pin: the golden bundle (generated from the
+        chaos-matrix stall row's output shape) names the
+        never-submitted op AND its source line."""
+        report = explain_mod.explain_bundle(self.BUNDLE,
+                                            [self.PROGRAM])
+        assert report["ranks"] == [0, 1]
+        assert report["reason"] == "collective_abort"
+        div = report["divergence"]
+        assert div["type"] == "missing_submission"
+        assert div["rule"] == "HVD501"
+        assert div["name"] == "step3" and div["occurrence"] == 1
+        assert div["submitted_by"] == [0]
+        assert div["involved_ranks"] == [1]
+        # the f-string pattern `step{...}` maps back to the call site
+        assert len(div["sources"]) == 1
+        site = div["sources"][0]
+        assert site["file"].endswith("sim_explain_program.py")
+        assert site["line"] == 17
+        assert site["kind"] == "allreduce"
+
+    def test_render_report_text(self):
+        report = explain_mod.explain_bundle(self.BUNDLE,
+                                            [self.PROGRAM])
+        text = explain_mod.render_report(report)
+        assert "first divergent slot: `step3` occurrence 1" in text
+        assert "NEVER submitted by rank(s) [1]" in text
+        assert "diagnosis: HVD501" in text
+        assert "sim_explain_program.py:17" in text
+
+    def test_without_program_still_names_the_slot(self):
+        report = explain_mod.explain_bundle(self.BUNDLE)
+        assert report["divergence"]["name"] == "step3"
+        assert report["divergence"]["sources"] == []
+        text = explain_mod.render_report(report)
+        assert "--program" in text
+
+    def test_field_mismatch_bundle(self, tmp_path):
+        (tmp_path / "postmortem.r0.p1.v0.jsonl").write_text(
+            '{"e":"meta","t":1.0,"kind":"postmortem","rank":0,'
+            '"size":2,"ver":0,"off":0.0,"reason":"mismatch"}\n'
+            '{"e":"sub","t":1.1,"n":"g","k":"allreduce","o":1}\n')
+        (tmp_path / "postmortem.r1.p2.v0.jsonl").write_text(
+            '{"e":"meta","t":1.0,"kind":"postmortem","rank":1,'
+            '"size":2,"ver":0,"off":0.0,"reason":"mismatch"}\n'
+            '{"e":"sub","t":1.1,"n":"g","k":"allgather","o":1}\n')
+        report = explain_mod.explain_bundle(str(tmp_path))
+        div = report["divergence"]
+        assert div["type"] == "field_mismatch"
+        assert div["rule"] == "HVD502"
+        assert div["kinds"] == ["allgather", "allreduce"]
+
+    def test_runtime_stall_is_hvd503(self, tmp_path):
+        """All ranks submitted compatibly, nothing finished: a runtime
+        stall, not a schedule divergence."""
+        for rank in (0, 1):
+            (tmp_path / f"postmortem.r{rank}.p{rank}.v0.jsonl"
+             ).write_text(
+                '{"e":"meta","t":1.0,"kind":"postmortem",'
+                f'"rank":{rank},'
+                '"size":2,"ver":0,"off":0.0,"reason":"stall"}\n'
+                '{"e":"sub","t":1.1,"n":"s","k":"allreduce","o":1}\n')
+        report = explain_mod.explain_bundle(str(tmp_path))
+        div = report["divergence"]
+        assert div["type"] == "never_finished"
+        assert div["rule"] == "HVD503"
+
+    def test_clean_bundle_reports_no_divergence(self, tmp_path):
+        for rank in (0, 1):
+            (tmp_path / f"postmortem.r{rank}.p{rank}.v0.jsonl"
+             ).write_text(
+                '{"e":"meta","t":1.0,"kind":"postmortem",'
+                f'"rank":{rank},'
+                '"size":2,"ver":0,"off":0.0,"reason":"external"}\n'
+                '{"e":"sub","t":1.1,"n":"s","k":"allreduce","o":1}\n'
+                '{"e":"fin","t":1.2,"n":"s","o":1}\n')
+        report = explain_mod.explain_bundle(str(tmp_path))
+        assert report["divergence"] is None
+        assert "no divergent slot" in \
+            explain_mod.render_report(report)
+
+    def test_newest_elastic_version_wins(self, tmp_path):
+        """Two aborts in one directory: explain analyzes the newest
+        cohort's bundle (bundle_by_rank contract)."""
+        for ver, name in ((0, "old"), (2, "new")):
+            for rank in (0, 1):
+                events = (
+                    f'{{"e":"sub","t":1.1,"n":"{name}",'
+                    '"k":"allreduce","o":1}\n')
+                if rank == 0 or ver == 0:
+                    pass
+                (tmp_path / f"postmortem.r{rank}.p{rank}.v{ver}.jsonl"
+                 ).write_text(
+                    '{"e":"meta","t":1.0,"kind":"postmortem",'
+                    f'"rank":{rank},"size":2,"ver":{ver},"off":0.0,'
+                    '"reason":"collective_abort"}\n'
+                    + (events if rank == 0 else ""))
+        report = explain_mod.explain_bundle(str(tmp_path))
+        assert report["version"] == 2
+        assert report["divergence"]["name"] == "new"
+
+    def test_ring_evicted_sub_with_surviving_fin_not_hvd501(
+            self, tmp_path):
+        """A rank whose `sub` fell off the bounded flight ring but
+        whose `fin` survived DID submit that slot: the completion
+        proves it. The window artifact must not shadow the genuinely
+        never-submitted slot."""
+        (tmp_path / "postmortem.r0.p1.v0.jsonl").write_text(
+            '{"e":"meta","t":1.0,"kind":"postmortem","rank":0,'
+            '"size":2,"ver":0,"off":0.0,"reason":"collective_abort"}\n'
+            '{"e":"sub","t":1.0,"n":"w","k":"allreduce","o":1}\n'
+            '{"e":"fin","t":1.1,"n":"w","o":1}\n'
+            '{"e":"sub","t":1.5,"n":"step3","k":"allreduce","o":1}\n')
+        # rank 1: the older `sub` for `w` was evicted, its fin kept;
+        # `step3` genuinely never submitted
+        (tmp_path / "postmortem.r1.p2.v0.jsonl").write_text(
+            '{"e":"meta","t":1.0,"kind":"postmortem","rank":1,'
+            '"size":2,"ver":0,"off":0.0,"reason":"collective_abort"}\n'
+            '{"e":"fin","t":1.1,"n":"w","o":1}\n')
+        report = explain_mod.explain_bundle(str(tmp_path))
+        div = report["divergence"]
+        assert div["name"] == "step3", report
+        assert div["type"] == "missing_submission"
+        assert div["involved_ranks"] == [1]
+
+    def test_missing_program_path_fails_loudly(self, tmp_path):
+        """A typo'd --program must not silently degrade to 'no source
+        mapping' with exit 0 — even when the bundle itself has no
+        divergence (the early no-divergence return must not skip the
+        path check)."""
+        with pytest.raises(explain_mod.ExplainError,
+                           match="program path not found"):
+            explain_mod.explain_bundle(
+                self.BUNDLE, [str(tmp_path / "no_such_train.py")])
+        proc = _run_cli("explain", self.BUNDLE,
+                        "--program", str(tmp_path / "nope.py"))
+        assert proc.returncode == 2
+        assert "program path not found" in proc.stderr
+        # clean bundle + bad program: still rc 2
+        for rank in (0, 1):
+            (tmp_path / f"postmortem.r{rank}.p{rank}.v0.jsonl"
+             ).write_text(
+                '{"e":"meta","t":1.0,"kind":"postmortem",'
+                f'"rank":{rank},'
+                '"size":2,"ver":0,"off":0.0,"reason":"external"}\n'
+                '{"e":"sub","t":1.1,"n":"s","k":"allreduce","o":1}\n'
+                '{"e":"fin","t":1.2,"n":"s","o":1}\n')
+        proc = _run_cli("explain", str(tmp_path),
+                        "--program", str(tmp_path / "nope.py"))
+        assert proc.returncode == 2
+        assert "program path not found" in proc.stderr
+
+    def test_empty_dir_raises(self, tmp_path):
+        with pytest.raises(explain_mod.ExplainError):
+            explain_mod.explain_bundle(str(tmp_path))
+
+    def test_cli_explain_text_and_json(self):
+        proc = _run_cli("explain", self.BUNDLE,
+                        "--program", self.PROGRAM)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "first divergent slot: `step3`" in proc.stdout
+        assert "sim_explain_program.py:17" in proc.stdout
+        proc = _run_cli("explain", self.BUNDLE, "--format", "json")
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["divergence"]["name"] == "step3"
+
+    def test_cli_explain_missing_bundle_exits_2(self, tmp_path):
+        proc = _run_cli("explain", str(tmp_path / "nope"))
+        assert proc.returncode == 2
+        proc = _run_cli("explain", str(tmp_path))
+        assert proc.returncode == 2
+        assert "no postmortem shards" in proc.stderr
 
 
 # ==========================================================================
