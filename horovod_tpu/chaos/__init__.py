@@ -213,6 +213,8 @@ def _execute(rule, point):
         raise _failure_for(rule, point)
     elif action == "hang":
         os.kill(os.getpid(), signal.SIGSTOP)
+    elif action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
     elif action == "preempt":
         os.kill(os.getpid(), signal.SIGTERM)
     elif action == "exit":

@@ -43,6 +43,12 @@ POINTS = {
     "heartbeat": "runner/heartbeat.py — each worker heartbeat beat",
     "checkpoint": "checkpoint.py save() — after the checkpoint file "
                   "lands (matchers: name = final file basename)",
+    "driver": "runner/elastic_driver.py main loop + runner/standby.py "
+              "poll loop — driver-process faults (actions: kill = "
+              "SIGKILL the driver, partition = black-hole its KV/"
+              "journal routes for ms=N; matcher wid=primary|standby "
+              "selects the role; docs/fault_tolerance.md Control-plane "
+              "HA)",
 }
 
 # action -> what firing does.
@@ -64,6 +70,12 @@ ACTIONS = {
              "territory)",
     "corrupt": "flip bytes inside the just-written checkpoint payload "
                "so its checksum fails on restore",
+    "kill": "SIGKILL the whole process — an abrupt driver-host death "
+            "(no cleanup, no journal flush beyond what already "
+            "fsync'd; the warm-standby takeover scenario)",
+    "partition": "driver only: the KV store stops answering (requests "
+                 "dropped without a response) for ms=N (default "
+                 "5000) — a symmetric control-plane network partition",
 }
 
 # Signal actions are consumed by the injection site itself (the site
@@ -74,6 +86,7 @@ SIGNAL_ACTION_POINTS = {
     "mismatch": ("collective",),
     "stall": ("collective", "backend_submit"),
     "corrupt": ("checkpoint",),
+    "partition": ("driver",),
 }
 
 _FLAGS = {"once"}

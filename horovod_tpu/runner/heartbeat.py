@@ -33,6 +33,10 @@ from ..utils.logging_util import get_logger
 HEARTBEAT_SCOPE = "heartbeat"
 DEFAULT_INTERVAL_S = 2.0
 DEFAULT_TIMEOUT_S = 30.0
+#: Consecutive beat failures before ONE warning names the endpoint —
+#: a partitioned worker becomes diagnosable from its own log before
+#: the driver declares it dead (errors stay swallowed regardless).
+ERROR_WARN_STREAK = 5
 
 
 def heartbeat_interval():
@@ -65,6 +69,11 @@ class HeartbeatThread:
         self._m_beats = telemetry.counter(
             "hvd_heartbeat_beats_total",
             "Worker heartbeat lease renewals", labelnames=("outcome",))
+        self._m_errors = telemetry.counter(
+            "hvd_heartbeat_errors_total",
+            "Worker beat failures (error) and streak-ending successes "
+            "(recovered)", labelnames=("outcome",))
+        self._consec_errors = 0
 
     def start(self):
         if self._thread is not None:
@@ -95,10 +104,35 @@ class HeartbeatThread:
                     token=self._token, retries=1,
                     deadline=max(self._interval, 1.0))
                 self._m_beats.labels(outcome="ok").inc()
+                if self._consec_errors:
+                    self._m_errors.labels(outcome="recovered").inc()
+                    self._log.info(
+                        "heartbeat: beat landed again after %d "
+                        "consecutive failures", self._consec_errors)
+                    self._consec_errors = 0
             except Exception as e:  # noqa: BLE001 — never kill the worker
                 self._m_beats.labels(outcome="error").inc()
-                self._log.debug("heartbeat: beat %d failed: %s",
-                                self._count, e)
+                self._m_errors.labels(outcome="error").inc()
+                self._consec_errors += 1
+                if self._consec_errors == ERROR_WARN_STREAK:
+                    # Previously these were swallowed at debug level
+                    # FOREVER — a worker partitioned from the control
+                    # plane looked healthy in its own log right up to
+                    # the moment the driver killed it as hung. One
+                    # warning per streak, naming where the beats were
+                    # going.
+                    addr, port = http_client.active_endpoint(
+                        self._addr, self._port)
+                    self._log.warning(
+                        "heartbeat: %d consecutive beat failures "
+                        "against %s:%d (last: %s) — this worker may be "
+                        "partitioned from the control plane; the "
+                        "driver will declare it hung after "
+                        "HVDTPU_HEARTBEAT_TIMEOUT", self._consec_errors,
+                        addr, port, e)
+                else:
+                    self._log.debug("heartbeat: beat %d failed: %s",
+                                    self._count, e)
             self._stop.wait(self._interval)
 
 

@@ -22,9 +22,17 @@ worker host their HTTP surface through this one handler.
 
 import secrets
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 AUTH_HEADER = "X-Hvdtpu-Job-Token"
+#: Control-plane HA headers (docs/fault_tolerance.md "Control-plane
+#: HA"): every response advertises the store's current term and, when
+#: known, the primary endpoint workers should prefer; PUT/DELETE
+#: requests may carry the writer's term, and a term older than the
+#: store's is rejected 409 instead of applied (split-brain fencing).
+TERM_HEADER = "X-Hvd-Term"
+PRIMARY_HEADER = "X-Hvd-Primary"
 
 
 def new_job_token():
@@ -41,11 +49,53 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
         return parts[0], parts[1]
 
     def _authorized(self):
+        if time.monotonic() < getattr(self.server, "paused_until", 0.0):
+            # Simulated network partition (chaos `driver:partition`):
+            # drop the request on the floor — the client sees a closed
+            # connection, exactly what a partitioned store looks like.
+            self.close_connection = True
+            return False
         token = self.server.job_token
         if token and self.headers.get(AUTH_HEADER) != token:
             self.send_response(403)
             self.send_header("Content-Length", "0")
             self.end_headers()
+            return False
+        return True
+
+    def _ha_headers(self):
+        """Advertise the store's term + primary hint on every reply."""
+        self.send_header(TERM_HEADER, str(self.server.term))
+        hint = getattr(self.server, "primary_hint", None)
+        if hint:
+            self.send_header(PRIMARY_HEADER, hint)
+
+    def _fence_term(self):
+        """Apply the request's term header against the store's term.
+        Returns True when the mutation may proceed; replies 409 (with
+        both terms) and returns False when the writer is stale. A
+        NEWER term is adopted — that is how a failed-over worker's
+        first write teaches a resurrected stale store that the world
+        moved on."""
+        raw = self.headers.get(TERM_HEADER)
+        if raw is None:
+            return True
+        try:
+            req_term = int(raw)
+        except ValueError:
+            return True
+        with self.server.store_lock:
+            cur = self.server.term
+            if req_term < cur:
+                stale = True
+            else:
+                stale = False
+                if req_term > cur:
+                    self.server.term = req_term
+        if stale:
+            self._reply_json(409, {"error": "term_fenced",
+                                   "request_term": req_term,
+                                   "server_term": cur})
             return False
         return True
 
@@ -59,6 +109,7 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
         import json as _json
         body = _json.dumps(obj).encode()
         self.send_response(code)
+        self._ha_headers()
         self.send_header("Content-Type", "application/json")
         if code == 429:
             # Backpressure contract (docs/serving.md): clients are told
@@ -103,6 +154,8 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
             if target is None:
                 return self._reply(404, b"")
             return self._reply_json(200, target.stats())
+        if self.path.split("?")[0] == "/journal":
+            return self._serve_journal()
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 1 and parts[0] in ("metrics", "metrics.json"):
             return self._serve_metrics(parts[0] == "metrics.json")
@@ -129,8 +182,11 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
             return self._reply(400, b"")
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if not self._fence_term():
+            return
         with self.server.store_lock:
             self.server.store.setdefault(scope, {})[key] = value
+            self._journal_write("kv_put", scope, key, value)
         self._reply(200, b"")
 
     def do_DELETE(self):  # noqa: N802
@@ -142,12 +198,55 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         if scope is None:
             return self._reply(400, b"")
+        if not self._fence_term():
+            return
         with self.server.store_lock:
             if key == "_all":
                 self.server.store.pop(scope, None)
             else:
                 self.server.store.get(scope, {}).pop(key, None)
+            self._journal_write(
+                "kv_clear" if key == "_all" else "kv_delete", scope,
+                key, None)
         self._reply(200, b"")
+
+    def _journal_write(self, op, scope, key, value):
+        """Journal a worker's write when the scope is durable (commits,
+        exit markers — docs/fault_tolerance.md). Called UNDER the store
+        lock so journal order can never invert store order for racing
+        same-key writes (a replayed replica must land on the same final
+        value as the live store); durable writes are rare — one per
+        worker per membership event — so the fsync under the lock does
+        not sit on any hot path."""
+        journal = getattr(self.server, "journal", None)
+        if journal is None:
+            return
+        from .journal import durable_key
+        if not durable_key(scope, key):
+            return
+        if op == "kv_put":
+            journal.record("kv_put", scope=scope, key=key,
+                           value=value.decode("latin-1"))
+        elif op == "kv_delete":
+            journal.record("kv_delete", scope=scope, key=key)
+        else:
+            journal.record("kv_clear", scope=scope)
+
+    def _serve_journal(self):
+        """Token-gated standby sync route: ``GET /journal?since=N`` →
+        ``{"term", "seq", "snapshot", "entries"}`` (journal.py
+        sync_payload). 404 when this store has no journal attached —
+        the disabled-mode contract leaves no trace of the route."""
+        journal = getattr(self.server, "journal", None)
+        if journal is None:
+            return self._reply(404, b"")
+        from urllib.parse import parse_qs, urlparse
+        query = parse_qs(urlparse(self.path).query)
+        try:
+            since = int(query.get("since", ["0"])[0])
+        except ValueError:
+            return self._reply(400, b"")
+        self._reply_json(200, journal.sync_payload(since))
 
     def _serve_metrics(self, json_mode):
         """Token-gated metrics exposition (docs/metrics.md): the local
@@ -181,6 +280,7 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
 
     def _reply(self, code, body):
         self.send_response(code)
+        self._ha_headers()
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if body:
@@ -194,18 +294,75 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
 class KVStoreServer:
     """Threaded HTTP KV store; binds an ephemeral port on start()."""
 
-    def __init__(self, job_token="", verbose=False, addr="0.0.0.0"):
+    def __init__(self, job_token="", verbose=False, addr="0.0.0.0",
+                 port=0):
         self._addr = addr
+        self._port = port  # 0 = ephemeral; HA standbys bind fixed ports
         self._httpd = None
         self._thread = None
         self.job_token = job_token
         self.verbose = verbose
         self.serving_worker = None
         self.serving_router = None
+        # Control-plane HA state (docs/fault_tolerance.md): the highest
+        # term this store has observed, an optional journal (enables
+        # the /journal route + durable-write journaling), and the
+        # primary endpoint hint advertised on every response.
+        self._term = 0
+        self.journal = None
+        self.primary_hint = None
 
     @property
     def port(self):
         return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def term(self):
+        return self._httpd.term if self._httpd is not None else self._term
+
+    def set_term(self, term):
+        """Raise the store's observed term (never lowers it)."""
+        if self._httpd is None:
+            self._term = max(self._term, int(term))
+            return
+        with self._httpd.store_lock:
+            self._httpd.term = max(self._httpd.term, int(term))
+
+    def set_primary_hint(self, hint):
+        self.primary_hint = hint
+        if self._httpd is not None:
+            self._httpd.primary_hint = hint
+
+    def attach_journal(self, journal):
+        """Attach a DriverJournal: enables ``GET /journal`` and the
+        durable-scope write-through (callable before or after start)."""
+        self.journal = journal
+        if self._httpd is not None:
+            self._httpd.journal = journal
+
+    def pause_for(self, seconds):
+        """Black-hole every request for ``seconds`` — the chaos
+        ``driver:partition`` effect (clients see closed connections)."""
+        self._httpd.paused_until = time.monotonic() + seconds
+
+    def paused(self):
+        return (self._httpd is not None
+                and time.monotonic() < self._httpd.paused_until)
+
+    def _check_write_term(self, mutation, writer_term):
+        """In-process analog of the HTTP fence: the driver stamps its
+        own writes with its term; once the store has observed a newer
+        one (a failed-over worker wrote through), the stale driver's
+        mutation raises instead of applying. ``None`` = unfenced
+        (HA off)."""
+        if writer_term is None:
+            return
+        cur = self._httpd.term
+        if writer_term < cur:
+            from .journal import StaleTermError
+            raise StaleTermError(mutation, writer_term, cur)
+        if writer_term > cur:
+            self._httpd.term = writer_term
 
     def attach_serving(self, worker=None, router=None):
         """Attach a serving worker/router; enables the /v1 routes
@@ -219,13 +376,18 @@ class KVStoreServer:
             self._httpd.serving_router = self.serving_router
 
     def start(self):
-        self._httpd = ThreadingHTTPServer((self._addr, 0), _KVStoreHandler)
+        self._httpd = ThreadingHTTPServer((self._addr, self._port),
+                                          _KVStoreHandler)
         self._httpd.store = {}
         self._httpd.store_lock = threading.Lock()
         self._httpd.job_token = self.job_token
         self._httpd.verbose = self.verbose
         self._httpd.serving_worker = self.serving_worker
         self._httpd.serving_router = self.serving_router
+        self._httpd.term = self._term
+        self._httpd.journal = self.journal
+        self._httpd.primary_hint = self.primary_hint
+        self._httpd.paused_until = 0.0
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="hvdtpu-kvstore")
@@ -236,23 +398,42 @@ class KVStoreServer:
         with self._httpd.store_lock:
             return self._httpd.store.get(scope, {}).get(key)
 
-    def put(self, scope, key, value):
+    def put(self, scope, key, value, term=None):
         if isinstance(value, str):
             value = value.encode()
         with self._httpd.store_lock:
+            self._check_write_term(f"put {scope}/{key}", term)
             self._httpd.store.setdefault(scope, {})[key] = value
 
-    def delete(self, scope, key):
+    def delete(self, scope, key, term=None):
         with self._httpd.store_lock:
+            self._check_write_term(f"delete {scope}/{key}", term)
             self._httpd.store.get(scope, {}).pop(key, None)
 
     def scope_keys(self, scope):
         with self._httpd.store_lock:
             return sorted(self._httpd.store.get(scope, {}).keys())
 
-    def clear_scope(self, scope):
+    def scopes(self):
         with self._httpd.store_lock:
+            return sorted(self._httpd.store.keys())
+
+    def clear_scope(self, scope, term=None):
+        with self._httpd.store_lock:
+            self._check_write_term(f"clear {scope}", term)
             self._httpd.store.pop(scope, None)
+
+    def load_state(self, kv_state):
+        """Pre-load durable KV scopes (a journal replica's ``kv``
+        partition) — the promotion path re-serving a dead primary's
+        commits and assignment table. Existing keys win: anything a
+        worker wrote here directly after the primary died is NEWER
+        than the replica's journal-replayed value."""
+        with self._httpd.store_lock:
+            for scope, keys in kv_state.items():
+                bucket = self._httpd.store.setdefault(scope, {})
+                for key, value in keys.items():
+                    bucket.setdefault(key, value.encode("latin-1"))
 
     def stop(self):
         if self._httpd is not None:

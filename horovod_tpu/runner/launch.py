@@ -66,6 +66,27 @@ def parse_args(argv=None):
                              "enables elastic mode")
     parser.add_argument("--reset-limit", type=int, default=None,
                         help="max elastic resets before the job aborts")
+    # Control-plane HA flags (docs/fault_tolerance.md "Control-plane
+    # HA"): journaled driver state + warm-standby failover.
+    parser.add_argument("--journal-dir", default=None,
+                        help="directory for the driver's control-plane "
+                             "journal (sets HVDTPU_DRIVER_JOURNAL; "
+                             "enables the /journal standby-sync route)")
+    parser.add_argument("--standby", default=None, metavar="HOST:PORT",
+                        help="run as a warm STANDBY tailing the primary "
+                             "driver at HOST:PORT; promotes itself when "
+                             "the primary's lease expires (requires the "
+                             "shared HVDTPU_JOB_TOKEN)")
+    parser.add_argument("--standby-endpoints", default=None,
+                        metavar="HOST:PORT[,...]",
+                        help="primary: ordered standby endpoints exported "
+                             "to workers as HVDTPU_RENDEZVOUS_ADDRS for "
+                             "KV failover (sets "
+                             "HVDTPU_DRIVER_STANDBY_ADDRS)")
+    parser.add_argument("--driver-port", type=int, default=None,
+                        help="fixed KV-store listen port (default: "
+                             "ephemeral; standbys need one workers can "
+                             "be told in advance)")
     # Runtime knobs -> env.
     parser.add_argument("--fusion-threshold-mb", type=float, default=None)
     parser.add_argument("--cycle-time-ms", type=float, default=None)
@@ -280,7 +301,8 @@ def run_commandline(argv=None):
         rendezvous_addr=_iface_addr(args.network_interface),
         ssh_port=args.ssh_port,
         ssh_identity_file=args.ssh_identity_file)
-    if args.host_discovery_script or args.min_np or args.max_np:
+    if (args.host_discovery_script or args.min_np or args.max_np
+            or args.standby):
         from .elastic_driver import ElasticSettings, launch_elastic_job
         elastic = ElasticSettings(
             settings,
@@ -289,8 +311,15 @@ def run_commandline(argv=None):
             # None = uncapped: -np is the *starting* size, not a growth
             # limit (matching horovodrun, where --max-np is optional).
             max_np=args.max_np,
-            reset_limit=args.reset_limit)
-        rc = launch_elastic_job(elastic, args.command)
+            reset_limit=args.reset_limit,
+            journal_dir=args.journal_dir,
+            standby_addrs=args.standby_endpoints,
+            driver_port=args.driver_port)
+        if args.standby:
+            from .standby import launch_standby
+            rc = launch_standby(elastic, args.command, args.standby)
+        else:
+            rc = launch_elastic_job(elastic, args.command)
     else:
         rc = launch_job(settings, args.command)
     sys.exit(rc)

@@ -204,10 +204,24 @@ class ServingWorker:
         from ..runner import http_client
         self._kv = (kv_addr, int(kv_port), token)
         if advertise:
+            member_key = f"member.{self.cohort}.{self.wid}"
             http_client.put_kv(
-                kv_addr, kv_port, SERVING_SCOPE,
-                f"member.{self.cohort}.{self.wid}", advertise,
+                kv_addr, kv_port, SERVING_SCOPE, member_key, advertise,
                 token=token)
+
+            def _reregister():
+                # Serving membership is EPHEMERAL on the HA contract
+                # (docs/fault_tolerance.md): after a control-plane
+                # failover the journal deliberately carries no member
+                # keys, so each worker re-announces itself against the
+                # new primary (the stats pump self-heals on its own).
+                addr, port, tok = self._kv
+                http_client.put_kv(addr, port, SERVING_SCOPE,
+                                   member_key, advertise, token=tok,
+                                   retries=2, deadline=5.0)
+
+            http_client.on_new_primary(
+                f"serving.member.{self.cohort}.{self.wid}", _reregister)
         if self._pump_thread is None:
             self._pump_thread = threading.Thread(
                 target=self._stats_pump, daemon=True,

@@ -266,6 +266,34 @@ CHECKPOINT_KEEP = register(
     "CHECKPOINT_KEEP", "0",
     "Keep only the newest N step_<N> checkpoints; 0 keeps everything")
 
+# -- control-plane HA (docs/fault_tolerance.md "Control-plane HA") ---------
+DRIVER_JOURNAL = register(
+    "DRIVER_JOURNAL", "",
+    "Directory for the driver's append-only fsync'd control-plane "
+    "journal (membership, blacklist, durable KV scopes) + periodic "
+    "snapshot; enables the /journal standby-sync route. Unset: no "
+    "journal I/O at all")
+DRIVER_JOURNAL_SNAPSHOT_EVERY = register(
+    "DRIVER_JOURNAL_SNAPSHOT_EVERY", "256",
+    "Journal entries between full-state snapshots (journal rotation)")
+DRIVER_STANDBY_ADDRS = register(
+    "DRIVER_STANDBY_ADDRS", "",
+    "Primary driver: comma-separated host:port standby endpoints, "
+    "exported to workers as HVDTPU_RENDEZVOUS_ADDRS (primary first) "
+    "so their KV client can fail over")
+DRIVER_LEASE_INTERVAL = register(
+    "DRIVER_LEASE_INTERVAL", "1",
+    "Standby: seconds between /journal polls against the primary "
+    "(each successful poll renews the primary's lease)")
+DRIVER_LEASE_TIMEOUT = register(
+    "DRIVER_LEASE_TIMEOUT", "10",
+    "Standby: promote to primary after the primary has been "
+    "unreachable this long (term bump + takeover)")
+DRIVER_PORT = register(
+    "DRIVER_PORT", "0",
+    "Fixed KV-store listen port for the driver/standby (0 = "
+    "ephemeral; standbys need a port workers can be told in advance)")
+
 # -- gradient compression (docs/compression.md) ----------------------------
 COMPRESSION = register(
     "COMPRESSION", "",
@@ -395,6 +423,7 @@ CROSS_SIZE = "CROSS_SIZE"
 PEERS = "PEERS"                                # "host:port,..." one per rank
 RENDEZVOUS_ADDR = "RENDEZVOUS_ADDR"            # analog of HOROVOD_GLOO_RENDEZVOUS_ADDR
 RENDEZVOUS_PORT = "RENDEZVOUS_PORT"
+RENDEZVOUS_ADDRS = "RENDEZVOUS_ADDRS"          # ordered host:port failover list (HA)
 CONTROLLER = "CONTROLLER"                      # 'tcp' | 'loopback'
 WORKER_ID = "WORKER_ID"                        # elastic slot identity
 ELASTIC_VERSION = "ELASTIC_VERSION"            # membership version joined

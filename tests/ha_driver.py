@@ -1,0 +1,43 @@
+"""Primary elastic driver subprocess for the control-plane HA chaos
+rows (tests/test_chaos_matrix.py): runs a real ElasticDriver whose HA
+knobs (HVDTPU_DRIVER_JOURNAL / HVDTPU_DRIVER_STANDBY_ADDRS /
+HVDTPU_DRIVER_PORT / HVDTPU_JOB_TOKEN) come straight from the
+environment, so the test can SIGKILL or chaos-partition a genuine
+separate driver process while the standby (in the test process)
+tails its journal."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.runner import spawn  # noqa: E402
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,  # noqa: E402
+                                               ElasticSettings)
+from horovod_tpu.runner.job import Settings  # noqa: E402
+
+
+def main():
+    worker_env = json.loads(os.environ["HA_WORKER_ENV"])
+    settings = Settings(num_proc=2, start_timeout=60, env=worker_env,
+                        rendezvous_addr="127.0.0.1")
+    es = ElasticSettings(
+        settings,
+        discovery_script=os.environ["HA_DISCOVERY"],
+        min_np=1, max_np=8, discovery_interval=0.2,
+        heartbeat_timeout=float(os.environ.get("HA_HEARTBEAT_TIMEOUT",
+                                               "30")))
+    spawn.reset_capture_dir(None)
+    driver = ElasticDriver(es, [sys.executable,
+                                os.environ["HA_WORKER"]])
+    print(f"HA_PRIMARY_UP port={driver.port} term={driver.term}",
+          flush=True)
+    return driver.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -606,6 +606,297 @@ def test_collective_failure_injection_recovers(tmp_path):
 
 
 # ==========================================================================
+# Control-plane HA rows (ISSUE 15, docs/fault_tolerance.md
+# "Control-plane HA")
+# ==========================================================================
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _ha_worker_env(log_path, **extra):
+    env = _worker_env(log_path, **extra)
+    env["HVDTPU_HEARTBEAT_INTERVAL"] = "0.25"
+    return env
+
+
+def _wait_for(predicate, timeout, message):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(message)
+
+
+def test_driver_sigkill_standby_promotes_workers_finish_untouched(
+        tmp_path, monkeypatch):
+    """HA row (a): the primary driver is SIGKILLed mid-training (chaos
+    `driver:kill` inside a REAL separate driver process) with a warm
+    standby tailing its journal. The standby must promote, adopt the
+    running cohort, and the workers must complete every epoch with
+    ZERO process deaths and ZERO elastic resets — the takeover is
+    invisible to the data plane. Ephemeral keys (peer addresses,
+    heartbeats) re-register against the new primary; the standby's
+    journal-replayed state digest matches the dead primary's on-disk
+    journal exactly."""
+    import json as _json
+    import subprocess
+    import threading
+
+    from horovod_tpu.runner import http_client
+    from horovod_tpu.runner import journal as journal_mod
+    from horovod_tpu.runner.standby import StandbyController
+
+    token = "ha-matrix-token"
+    journal_dir = tmp_path / "journal"
+    phase_file = tmp_path / "phase"
+    phase_file.write_text("0")
+    log_path = tmp_path / "log"
+    discovery = _write_discovery(tmp_path, phase_file, [["localhost:2"]])
+    worker_env = _ha_worker_env(log_path, ELASTIC_TEST_EPOCHS=12,
+                                ELASTIC_TEST_EPOCH_SLEEP=0.4)
+    p_port = _free_port()
+
+    # Standby first (needs only the primary's fixed endpoint); the
+    # primary is then told the standby's bound port.
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", token)
+    http_client.reset_failover()
+    es_standby = ElasticSettings(
+        Settings(num_proc=2, start_timeout=60, env=worker_env,
+                 rendezvous_addr="127.0.0.1"),
+        discovery_script=discovery, min_np=1, max_np=8,
+        discovery_interval=0.2, heartbeat_timeout=10.0,
+        journal_dir=str(tmp_path / "standby_journal"),
+        standby_addrs="", driver_port=0)
+    ctrl = StandbyController(es_standby, [sys.executable, WORKER],
+                             f"127.0.0.1:{p_port}",
+                             advertise="127.0.0.1",
+                             lease_interval=0.3, lease_timeout=2.0)
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": worker_env["PYTHONPATH"],
+        "HA_WORKER_ENV": _json.dumps(worker_env),
+        "HA_DISCOVERY": discovery,
+        "HA_WORKER": WORKER,
+        "HVDTPU_JOB_TOKEN": token,
+        "HVDTPU_DRIVER_JOURNAL": str(journal_dir),
+        "HVDTPU_DRIVER_STANDBY_ADDRS": f"127.0.0.1:{ctrl.port}",
+        "HVDTPU_DRIVER_PORT": str(p_port),
+        # Driver-loss is scriptable like every other fault: the new
+        # `driver` chaos point SIGKILLs the primary ~2s in (after=40
+        # main-loop iterations), mid-training by construction.
+        "HVDTPU_CHAOS": "driver:kill:wid=primary:after=40:once",
+    })
+    ha_driver = os.path.join(os.path.dirname(__file__), "ha_driver.py")
+    primary = subprocess.Popen([sys.executable, ha_driver], env=env)
+
+    result = {}
+
+    def run_standby():
+        result["rc"] = ctrl.run()
+
+    t = threading.Thread(target=run_standby, daemon=True)
+    t.start()
+    try:
+        # The chaos kill fires inside the driver's own main loop.
+        primary.wait(timeout=120)
+        assert primary.returncode == -9, primary.returncode
+
+        # Pre-kill snapshot: replay the dead primary's on-disk journal.
+        _wait_for(lambda: ctrl.promoted is not None, 60,
+                  "standby never promoted after the primary SIGKILL")
+        state, _ = journal_mod.replay(str(journal_dir))
+        assert ctrl.promoted_digest == journal_mod.state_digest(state)
+        promoted = ctrl.promoted
+        assert promoted.term == 2
+
+        # Ephemeral re-registration: the workers' failover hooks re-put
+        # their peer keys, and their heartbeats land on the new primary.
+        _wait_for(
+            lambda: len(ctrl.server.scope_keys("peers.0")) == 2, 60,
+            "peer keys never re-registered against the new primary")
+        _wait_for(
+            lambda: len(ctrl.server.scope_keys("heartbeat")) == 2, 60,
+            "heartbeats never failed over to the new primary")
+
+        t.join(timeout=180)
+        assert not t.is_alive(), "standby-driven job never completed"
+        assert result["rc"] == 0
+
+        # ZERO elastic resets, ZERO worker deaths: the takeover alone
+        # never moved the version or counted a failure.
+        assert promoted.version == 0
+        assert promoted.resets == 0
+        assert promoted.fail_counts == {}, promoted.fail_counts
+        assert promoted.blacklist == set()
+
+        content = _log_content(log_path)
+        done = [line for line in content.splitlines() if "DONE" in line]
+        assert len(done) == 2, content
+        entries = _parse_log(log_path)
+        assert max(e[1] for e in entries) == 11
+        # Zero process deaths => zero replays: every worker's epoch
+        # sequence is strictly increasing straight through the kill.
+        for wid in ("localhost:0", "localhost:1"):
+            epochs = [e[1] for e in entries if e[0] == wid]
+            assert epochs == sorted(set(epochs)), entries
+            assert max(epochs) == 11
+    finally:
+        if primary.poll() is None:
+            primary.kill()
+            primary.wait(timeout=10)
+        if ctrl.promoted is None:
+            ctrl.stop()
+        elif ctrl.promoted.journal is not None:
+            ctrl.promoted.journal.close()
+        http_client.reset_failover()
+
+
+def test_partition_then_heal_old_primary_is_term_fenced(tmp_path,
+                                                        monkeypatch):
+    """HA row (b): the primary is chaos-partitioned (`driver:partition`
+    — its KV/journal routes drop every request) long enough for the
+    standby's lease to expire. The standby promotes at term 2 and the
+    cohort fails over; when the partition heals, the old primary's
+    term probe finds the takeover and it demotes LOUDLY (StaleTermError
+    carrying both terms, DEMOTED_RC, workers untouched) — its post-heal
+    writes are fenced, never silently applied. Cohort state at
+    promotion matches the primary's journal."""
+    import logging
+    import threading
+
+    from horovod_tpu.runner import http_client
+    from horovod_tpu.runner import journal as journal_mod
+    from horovod_tpu.runner.elastic_driver import DEMOTED_RC
+    from horovod_tpu.runner.standby import StandbyController
+    from horovod_tpu.utils.logging_util import get_logger
+    from horovod_tpu import chaos
+
+    class _Spy(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.messages = []
+
+        def emit(self, record):
+            self.messages.append(record.getMessage())
+
+    token = "ha-matrix-token-b"
+    journal_dir = tmp_path / "journal"
+    phase_file = tmp_path / "phase"
+    phase_file.write_text("0")
+    log_path = tmp_path / "log"
+    discovery = _write_discovery(tmp_path, phase_file, [["localhost:2"]])
+    worker_env = _ha_worker_env(log_path, ELASTIC_TEST_EPOCHS=16,
+                                ELASTIC_TEST_EPOCH_SLEEP=0.4)
+    marker = tmp_path / "partition.marker"
+
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", token)
+    monkeypatch.setenv(
+        "HVDTPU_CHAOS",
+        f"driver:partition:ms=4000:wid=primary:after=20:once:"
+        f"marker={marker}")
+    chaos.reset()
+    http_client.reset_failover()
+    spawn.reset_capture_dir(None)
+
+    es_standby = ElasticSettings(
+        Settings(num_proc=2, start_timeout=60, env=worker_env,
+                 rendezvous_addr="127.0.0.1"),
+        discovery_script=discovery, min_np=1, max_np=8,
+        discovery_interval=0.2, heartbeat_timeout=10.0,
+        journal_dir="", standby_addrs="", driver_port=0)
+    ctrl = StandbyController(es_standby, [sys.executable, WORKER],
+                             "127.0.0.1:1",  # repointed below
+                             advertise="127.0.0.1",
+                             lease_interval=0.3, lease_timeout=1.5)
+    es_primary = ElasticSettings(
+        Settings(num_proc=2, start_timeout=60, env=worker_env,
+                 rendezvous_addr="127.0.0.1"),
+        discovery_script=discovery, min_np=1, max_np=8,
+        discovery_interval=0.2, heartbeat_timeout=30.0,
+        journal_dir=str(journal_dir),
+        standby_addrs=f"127.0.0.1:{ctrl.port}", driver_port=0)
+    primary = ElasticDriver(es_primary, [sys.executable, WORKER])
+    ctrl.primary = ("127.0.0.1", primary.port)
+
+    spy = _Spy()
+    spy.setLevel(logging.ERROR)
+    get_logger().addHandler(spy)
+    res = {}
+
+    def run_primary():
+        res["primary_rc"] = primary.run()
+
+    def run_standby():
+        res["standby_rc"] = ctrl.run()
+
+    t_p = threading.Thread(target=run_primary, daemon=True)
+    t_s = threading.Thread(target=run_standby, daemon=True)
+    t_p.start()
+    t_s.start()
+    try:
+        # Partition fires ~1s in; the standby promotes ~1.5-2s later.
+        _wait_for(lambda: marker.exists(), 60,
+                  "driver partition never fired")
+        _wait_for(lambda: ctrl.promoted is not None, 60,
+                  "standby never promoted during the partition")
+        digest_at_promotion = ctrl.promoted_digest
+
+        # The healed stale primary must fence itself, loudly, without
+        # touching the workers (they finish under the new primary).
+        t_p.join(timeout=120)
+        assert not t_p.is_alive(), "stale primary never demoted"
+        assert res["primary_rc"] == DEMOTED_RC
+        fenced = [m for m in spy.messages
+                  if "STALE PRIMARY FENCED" in m]
+        assert fenced, spy.messages
+        assert "term 1" in fenced[0] and "term 2" in fenced[0]
+
+        t_s.join(timeout=240)
+        assert not t_s.is_alive(), "standby-driven job never completed"
+        assert res["standby_rc"] == 0
+        promoted = ctrl.promoted
+        assert promoted.term == 2
+        assert promoted.resets == 0
+        assert promoted.fail_counts == {}, promoted.fail_counts
+
+        # Cohort state at promotion == the primary's journal (the
+        # primary journaled nothing after the takeover: its one
+        # attempted mutation was fenced before any effect).
+        state, _ = journal_mod.replay(str(journal_dir))
+        assert digest_at_promotion == journal_mod.state_digest(state)
+
+        content = _log_content(log_path)
+        done = [line for line in content.splitlines() if "DONE" in line]
+        assert len(done) == 2, content
+        entries = _parse_log(log_path)
+        assert max(e[1] for e in entries) == 15
+        for wid in ("localhost:0", "localhost:1"):
+            epochs = [e[1] for e in entries if e[0] == wid]
+            assert epochs == sorted(set(epochs)), entries
+    finally:
+        get_logger().removeHandler(spy)
+        monkeypatch.delenv("HVDTPU_CHAOS")
+        chaos.reset()
+        http_client.reset_failover()
+        if primary.journal is not None:
+            primary.journal.close()
+        if ctrl.promoted is None:
+            ctrl.stop()
+        elif ctrl.promoted.journal is not None:
+            ctrl.promoted.journal.close()
+
+
+# ==========================================================================
 # Serving-plane rows (ISSUE 13, docs/serving.md "Chaos semantics")
 # ==========================================================================
 

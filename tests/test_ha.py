@@ -1,0 +1,848 @@
+"""Control-plane HA unit tests (fast tier-1, docs/fault_tolerance.md
+"Control-plane HA"): journal append/snapshot/replay round-trips with
+torn-tail recovery, the term-fencing rejection matrix (HTTP + in-
+process), KV endpoint-list parsing/failover order, promotion-without-
+membership-change keeping the elastic version fixed, the peer-key
+republish regression, the heartbeat error-streak warning, the chaos
+`driver` point, and the disabled-mode guard (no knobs = the pre-HA
+code path, zero journal I/O)."""
+
+import io
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runner import http_client
+from horovod_tpu.runner import journal as journal_mod
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                               ElasticSettings,
+                                               _AdoptedProc, _Worker)
+from horovod_tpu.runner.http_server import (AUTH_HEADER, PRIMARY_HEADER,
+                                            TERM_HEADER, KVStoreServer)
+from horovod_tpu.runner.job import Settings
+from horovod_tpu.runner.standby import StandbyController
+
+TOKEN = "ha-test-token"
+
+
+@pytest.fixture(autouse=True)
+def _clean_client_state():
+    """The KV client's failover/term state is process-global by design;
+    tests must not leak it into each other."""
+    http_client.reset_failover()
+    yield
+    http_client.reset_failover()
+
+
+class _FakeProc:
+    def __init__(self):
+        self.terminated = False
+        self.proc = self
+
+    def poll(self):
+        return None
+
+    def wait(self, *a):
+        return 0
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        pass
+
+
+def _fake_spawn(driver):
+    def spawn_fn(worker_id, host, idx):
+        driver.workers[worker_id] = _Worker(worker_id, host, idx,
+                                            _FakeProc())
+    return spawn_fn
+
+
+def _free_closed_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method, url, token=TOKEN, data=None, headers=()):
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header(AUTH_HEADER, token)
+    for k, v in headers:
+        req.add_header(k, v)
+    return urllib.request.urlopen(req, timeout=5)
+
+
+# ==========================================================================
+# Journal: append / snapshot / replay
+# ==========================================================================
+
+def _record_some(j):
+    j.record("membership", version=0,
+             rank_order=["localhost:0", "localhost:1"],
+             workers={"localhost:0": {"host": "localhost", "slot": 0},
+                      "localhost:1": {"host": "localhost", "slot": 1}},
+             resets=0,
+             assign={"localhost:0": "0,2,0,2,0,1",
+                     "localhost:1": "1,2,1,2,0,1"})
+    j.record("kv_put", scope="elastic.state", key="localhost:0",
+             value="blob0")
+    j.record("fail_count", host="otherhost", count=1, blacklisted=False)
+
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    j = journal_mod.DriverJournal(str(tmp_path))
+    _record_some(j)
+    digest = j.digest()
+    j.close()
+    state, seq = journal_mod.replay(str(tmp_path))
+    assert seq == 3
+    assert state["version"] == 0
+    assert state["rank_order"] == ["localhost:0", "localhost:1"]
+    assert state["kv"]["elastic.state"]["localhost:0"] == "blob0"
+    assert state["kv"]["elastic"]["version"] == "0"
+    assert state["fail_counts"] == {"otherhost": 1}
+    assert journal_mod.state_digest(state) == digest
+
+
+def test_journal_snapshot_rotation_and_replay(tmp_path):
+    j = journal_mod.DriverJournal(str(tmp_path), snapshot_every=2)
+    _record_some(j)  # 3 entries: snapshot fires at the 2nd
+    j.record("kv_put", scope="elastic.state", key="localhost:1",
+             value="blob1")
+    digest = j.digest()
+    j.close()
+    assert os.path.exists(tmp_path / journal_mod.SNAPSHOT_FILE)
+    state, seq = journal_mod.replay(str(tmp_path))
+    assert seq == 4
+    assert journal_mod.state_digest(state) == digest
+    # A new incarnation resumes seq/term from disk.
+    j2 = journal_mod.DriverJournal(str(tmp_path), snapshot_every=2)
+    assert j2.seq == 4 and j2.digest() == digest
+    j2.close()
+
+
+def test_journal_membership_drops_stale_assign_scopes(tmp_path):
+    j = journal_mod.DriverJournal(str(tmp_path))
+    _record_some(j)
+    j.record("membership", version=1, rank_order=["localhost:1"],
+             workers={"localhost:1": {"host": "localhost", "slot": 1}},
+             resets=1, assign={"localhost:1": "0,1,0,1,0,1"})
+    assert "assign.0" not in j.state["kv"]
+    assert j.state["kv"]["assign.1"] == {"localhost:1": "0,1,0,1,0,1"}
+    assert j.state["resets"] == 1
+    j.close()
+
+
+def test_journal_torn_final_line_truncated_on_recovery(tmp_path):
+    j = journal_mod.DriverJournal(str(tmp_path))
+    _record_some(j)
+    digest = j.digest()
+    j.close()
+    jpath = tmp_path / journal_mod.JOURNAL_FILE
+    with open(jpath, "ab") as f:
+        f.write(b'{"seq": 4, "term": 1, "op": "kv_pu')  # crash mid-append
+    # Read-only replay ignores the torn tail…
+    state, seq = journal_mod.replay(str(tmp_path))
+    assert seq == 3 and journal_mod.state_digest(state) == digest
+    # …and a recovering writer truncates it, then appends cleanly.
+    j2 = journal_mod.DriverJournal(str(tmp_path))
+    assert j2.seq == 3
+    j2.record("kv_put", scope="elastic.state", key="k", value="v")
+    j2.close()
+    state, seq = journal_mod.replay(str(tmp_path))
+    assert seq == 4 and state["kv"]["elastic.state"]["k"] == "v"
+
+
+def test_journal_mid_file_corruption_is_loud(tmp_path):
+    j = journal_mod.DriverJournal(str(tmp_path))
+    _record_some(j)
+    j.close()
+    jpath = tmp_path / journal_mod.JOURNAL_FILE
+    lines = jpath.read_bytes().splitlines(keepends=True)
+    lines[0] = b"garbage not json\n"
+    jpath.write_bytes(b"".join(lines))
+    with pytest.raises(journal_mod.JournalError):
+        journal_mod.replay(str(tmp_path))
+
+
+def test_journal_sync_payload_snapshot_catchup(tmp_path):
+    j = journal_mod.DriverJournal(str(tmp_path), snapshot_every=2)
+    _record_some(j)
+    # A replica at seq 0 predates the rotation: it must get the
+    # snapshot + the post-snapshot entries, and land on the digest.
+    replica = journal_mod.JournalReplica()
+    replica.apply_payload(j.sync_payload(replica.seq))
+    assert replica.seq == j.seq
+    assert replica.digest() == j.digest()
+    # Incremental: one more entry, payload since replica.seq is tiny.
+    j.record("kv_put", scope="elastic.state", key="z", value="v")
+    payload = j.sync_payload(replica.seq)
+    assert payload["snapshot"] is None and len(payload["entries"]) == 1
+    replica.apply_payload(payload)
+    assert replica.digest() == j.digest()
+    j.close()
+
+
+# ==========================================================================
+# Term fencing
+# ==========================================================================
+
+def test_inprocess_stale_write_raises_with_both_terms():
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    server.start()
+    try:
+        server.set_term(2)
+        server.put("elastic", "version", "0", term=2)  # current term ok
+        server.put("elastic", "version", "1")          # unfenced (HA off)
+        with pytest.raises(journal_mod.StaleTermError) as exc:
+            server.put("elastic", "version", "2", term=1)
+        assert "term 1" in str(exc.value) and "term 2" in str(exc.value)
+        with pytest.raises(journal_mod.StaleTermError):
+            server.clear_scope("elastic", term=1)
+        # Higher term adopts.
+        server.put("elastic", "version", "3", term=5)
+        assert server.term == 5
+    finally:
+        server.stop()
+
+
+def test_http_fence_409_carries_both_terms_and_adopts_newer():
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    port = server.start()
+    try:
+        server.set_term(5)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _http("PUT", f"http://127.0.0.1:{port}/s/k", data=b"v",
+                  headers=[(TERM_HEADER, "3")])
+        assert exc.value.code == 409
+        body = json.loads(exc.value.read().decode())
+        assert body == {"error": "term_fenced", "request_term": 3,
+                        "server_term": 5}
+        # The stale write was NEVER applied.
+        assert server.get("s", "k") is None
+        # A newer-term write is adopted and applied.
+        with _http("PUT", f"http://127.0.0.1:{port}/s/k", data=b"v2",
+                   headers=[(TERM_HEADER, "7")]):
+            pass
+        assert server.get("s", "k") == b"v2" and server.term == 7
+    finally:
+        server.stop()
+
+
+def test_client_lagging_term_adopts_and_retry_succeeds():
+    """A worker that merely lagged a failover (stamping the OLD term)
+    must succeed against the new primary: one 409, adopt, retry."""
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    port = server.start()
+    try:
+        server.set_term(5)
+        http_client.note_term(3)
+        http_client.put_kv("127.0.0.1", port, "elastic.state", "w0",
+                           "blob", token=TOKEN)
+        assert server.get("elastic.state", "w0") == b"blob"
+        assert http_client.known_term() == 5
+    finally:
+        server.stop()
+
+
+def test_client_persistent_fence_raises_term_fenced_error():
+    """A writer fenced AGAIN after adopting the advertised term is
+    authoritatively stale: TermFencedError, loud, never silent."""
+    calls = {"n": 0}
+
+    def attempt(addr, port):
+        calls["n"] += 1
+        body = json.dumps({"error": "term_fenced", "request_term": 1,
+                           "server_term": 2}).encode()
+        raise urllib.error.HTTPError(
+            "http://x/s/k", 409, "Conflict", {}, io.BytesIO(body))
+
+    with pytest.raises(http_client.TermFencedError) as exc:
+        http_client._call("put", "s", "k", attempt, "x", 1,
+                          retries=0, deadline=5.0)
+    assert calls["n"] == 2  # fence → adopt+retry → fence → loud error
+    assert exc.value.request_term == 1 and exc.value.server_term == 2
+    assert "term 1" in str(exc.value) and "term 2" in str(exc.value)
+
+
+def test_responses_advertise_term_header():
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    port = server.start()
+    try:
+        server.set_term(4)
+        with _http("GET", f"http://127.0.0.1:{port}/clock") as resp:
+            assert resp.headers.get(TERM_HEADER) == "4"
+        # The client adopts it as a side effect of any call.
+        http_client.put_kv("127.0.0.1", port, "s", "k", "v", token=TOKEN)
+        assert http_client.known_term() == 4
+    finally:
+        server.stop()
+
+
+# ==========================================================================
+# Endpoint-list parsing + failover order
+# ==========================================================================
+
+def test_parse_endpoints():
+    assert http_client.parse_endpoints("a:1,b:2") == [("a", 1), ("b", 2)]
+    assert http_client.parse_endpoints(" a:1 , b:2 ") == [("a", 1),
+                                                         ("b", 2)]
+    assert http_client.parse_endpoints("") == []
+    with pytest.raises(ValueError):
+        http_client.parse_endpoints("a")
+    with pytest.raises(ValueError):
+        http_client.parse_endpoints("a:x")
+
+
+def test_failover_order_and_reregistration_hook(monkeypatch):
+    """Primary dead → the call lands on the standby (in list order),
+    the active endpoint sticks, and on_new_primary hooks fire."""
+    s2 = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    p2 = s2.start()
+    p1 = _free_closed_port()  # primary: nothing listening
+    monkeypatch.setenv("HVDTPU_RENDEZVOUS_ADDRS",
+                       f"127.0.0.1:{p1},127.0.0.1:{p2}")
+    http_client.reset_failover()
+    fired = []
+    http_client.on_new_primary("test.hook", lambda: fired.append(1))
+    try:
+        http_client.put_kv("127.0.0.1", p1, "s", "k", "v", token=TOKEN,
+                           retries=0, deadline=10.0)
+        assert s2.get("s", "k") == b"v"
+        assert http_client.active_endpoint("127.0.0.1", p1) == \
+            ("127.0.0.1", p2)
+        assert fired == [1]
+        # Later calls start at the active endpoint (no dead-primary
+        # probe): a fresh GET is fast and lands on the standby.
+        t0 = time.monotonic()
+        assert http_client.get_kv("127.0.0.1", p1, "s", "k",
+                                  token=TOKEN, retries=0,
+                                  deadline=10.0) == b"v"
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        s2.stop()
+
+
+def test_primary_hint_switches_active_endpoint(monkeypatch):
+    """X-Hvd-Primary on a response re-points the client — how a
+    pre-promotion standby bounces stray callers back to the living
+    primary."""
+    s1 = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    p1 = s1.start()
+    s2 = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    p2 = s2.start()
+    monkeypatch.setenv("HVDTPU_RENDEZVOUS_ADDRS",
+                       f"127.0.0.1:{p2},127.0.0.1:{p1}")
+    http_client.reset_failover()
+    try:
+        s2.set_primary_hint(f"127.0.0.1:{p1}")
+        http_client.put_kv("127.0.0.1", p2, "s", "k", "v", token=TOKEN)
+        assert http_client.active_endpoint("127.0.0.1", p2) == \
+            ("127.0.0.1", p1)
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_rendezvous_config_resolves_addrs_list(monkeypatch):
+    from horovod_tpu.runner import rendezvous as rdv
+    monkeypatch.delenv("HVDTPU_RENDEZVOUS_ADDR", raising=False)
+    monkeypatch.delenv("HVDTPU_RENDEZVOUS_PORT", raising=False)
+    monkeypatch.setenv("HVDTPU_RENDEZVOUS_ADDRS", "10.0.0.1:7001")
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", "t")
+    http_client.reset_failover()
+    assert rdv.rendezvous_config() == ("10.0.0.1", 7001, "t")
+
+
+# ==========================================================================
+# Promotion: journaled primary → standby replica → live driver
+# ==========================================================================
+
+def _primary_with_cohort(tmp_path, monkeypatch, standby_addrs=""):
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", TOKEN)
+    es = ElasticSettings(Settings(num_proc=2), min_np=1,
+                         journal_dir=str(tmp_path / "journal"),
+                         standby_addrs=standby_addrs, driver_port=0)
+    driver = ElasticDriver(es, ["true"])
+    monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+    driver.addr = "127.0.0.1"
+    driver.version = 0
+    driver._reconcile(driver._discover_targets())
+    driver._publish()
+    return driver
+
+
+def test_promotion_keeps_version_and_replays_identical_state(
+        tmp_path, monkeypatch):
+    driver = _primary_with_cohort(tmp_path, monkeypatch)
+    promoted = None
+    try:
+        # A worker commit lands over HTTP → durable → journaled.
+        http_client.put_kv("127.0.0.1", driver.port, "elastic.state",
+                           "localhost:0", "commit-blob", token=TOKEN)
+        # An ephemeral write is NOT journaled (peers republish instead).
+        http_client.put_kv("127.0.0.1", driver.port, "peers.0", "0",
+                           "1.2.3.4:5", token=TOKEN)
+        assert "peers.0" not in driver.journal.state["kv"]
+        pre_digest = driver.journal.digest()
+
+        es2 = ElasticSettings(Settings(num_proc=2), min_np=1,
+                              journal_dir="", driver_port=0)
+        ctrl = StandbyController(es2, ["true"],
+                                 f"127.0.0.1:{driver.port}",
+                                 advertise="127.0.0.1")
+        assert ctrl.poll_once()
+        driver.server.stop()  # the primary dies
+
+        promoted = ctrl.promote()
+        # Acceptance: journal-replayed digest identical on old-standby
+        # vs the dead primary's on-disk journal.
+        assert ctrl.promoted_digest == pre_digest
+        state, _ = journal_mod.replay(str(tmp_path / "journal"))
+        assert journal_mod.state_digest(state) == pre_digest
+
+        # No elastic-version bump on a pure takeover.
+        assert promoted.version == 0
+        assert promoted.term == 2 and promoted.server.term == 2
+        assert promoted.rank_order == ["localhost:0", "localhost:1"]
+        # Durable KV re-served: the commit and the assignment table.
+        assert promoted.server.get("elastic.state", "localhost:0") \
+            == b"commit-blob"
+        line = promoted.server.get("assign.0", "localhost:1")
+        assert line is not None and line.decode().startswith("1,2,")
+        assert promoted.server.get("elastic", "version") == b"0"
+        # The cohort was adopted, not respawned.
+        assert all(isinstance(w.proc, _AdoptedProc)
+                   for w in promoted.workers.values())
+
+        # Exit-marker reaping: a worker publishing rc=0 is reaped as
+        # SUCCEEDED through the adopted shim.
+        promoted.server.put("elastic.exit", "localhost:0", "0")
+        changed = promoted._sweep_exits()
+        assert changed is False
+        assert promoted.succeeded == ["localhost:0"]
+        assert "localhost:0" not in promoted.workers
+    finally:
+        driver.journal.close()
+        if promoted is not None:
+            promoted.server.stop()
+            if promoted.journal is not None:
+                promoted.journal.close()
+
+
+def test_stale_primary_probe_and_write_are_fenced(tmp_path,
+                                                  monkeypatch):
+    """The two-launcher fence matrix, in-process: after a standby
+    promotes, (a) the healed primary's term probe raises loudly, and
+    (b) its own store — once any newer-term write has touched it —
+    rejects the stale driver's mutation with both terms named."""
+    es2 = ElasticSettings(Settings(num_proc=2), min_np=1,
+                          journal_dir="", driver_port=0)
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", TOKEN)
+    ctrl = StandbyController(es2, ["true"], "127.0.0.1:1",
+                             advertise="127.0.0.1")
+    driver = _primary_with_cohort(
+        tmp_path, monkeypatch,
+        standby_addrs=f"127.0.0.1:{ctrl.port}")
+    promoted = None
+    try:
+        ctrl.primary = ("127.0.0.1", driver.port)
+        assert ctrl.poll_once()
+        promoted = ctrl.promote()
+        assert promoted.term == 2
+
+        # (a) the healed stale primary's probe sees the higher term.
+        with pytest.raises(journal_mod.StaleTermError) as exc:
+            driver._check_term_fence(time.monotonic())
+        assert "term 1" in str(exc.value) and "term 2" in str(exc.value)
+
+        # (b) a failed-over worker (knowing term 2) writes through the
+        # healed primary's store; the stale driver's next in-process
+        # mutation is fenced — never silently applied.
+        http_client.note_term(2)
+        http_client.put_kv("127.0.0.1", driver.port, "elastic.state",
+                           "localhost:1", "newer", token=TOKEN)
+        assert driver.server.term == 2
+        with pytest.raises(journal_mod.StaleTermError):
+            driver._publish()
+    finally:
+        driver.server.stop()
+        driver.journal.close()
+        if promoted is not None:
+            promoted.server.stop()
+
+
+def test_respawn_journals_exit_marker_delete(tmp_path, monkeypatch):
+    """Regression (review finding): a worker's durable exit marker is
+    journaled on arrival, so the respawn path must journal the DELETE
+    too — otherwise a journal replica resurrects the stale marker and
+    a promoted standby reaps the live respawn at birth."""
+    driver = _primary_with_cohort(tmp_path, monkeypatch)
+    try:
+        http_client.put_kv("127.0.0.1", driver.port, "elastic.exit",
+                           "localhost:0", "82", token=TOKEN)
+        assert driver.journal.state["kv"]["elastic.exit"][
+            "localhost:0"] == "82"
+        # Real _spawn — the class method, around the fixture's fake
+        # (the command is `true`): the delete must land in the
+        # journal, not just the live store.
+        ElasticDriver._spawn(driver, "localhost:0", "localhost", 0)
+        driver.workers["localhost:0"].proc.kill()
+        assert "localhost:0" not in \
+            driver.journal.state["kv"].get("elastic.exit", {})
+        state, _ = journal_mod.replay(str(tmp_path / "journal"))
+        assert "localhost:0" not in state["kv"].get("elastic.exit", {})
+    finally:
+        driver.server.stop()
+        driver.journal.close()
+
+
+def test_promotion_rejournals_durable_kv_for_chained_ha(tmp_path,
+                                                        monkeypatch):
+    """Regression (review finding): the promoted primary's OWN journal
+    must carry the durable KV scopes (commits, exit markers), not just
+    membership — a second-generation standby syncing from it would
+    otherwise lose every worker commit."""
+    driver = _primary_with_cohort(tmp_path, monkeypatch)
+    promoted = None
+    try:
+        http_client.put_kv("127.0.0.1", driver.port, "elastic.state",
+                           "localhost:0", "commit-blob", token=TOKEN)
+        es2 = ElasticSettings(Settings(num_proc=2), min_np=1,
+                              journal_dir=str(tmp_path / "j2"),
+                              driver_port=0)
+        ctrl = StandbyController(es2, ["true"],
+                                 f"127.0.0.1:{driver.port}",
+                                 advertise="127.0.0.1")
+        assert ctrl.poll_once()
+        driver.server.stop()
+        # A worker write that lands on the standby DURING the takeover
+        # window (pre-promotion, journal not yet attached) must be
+        # re-journaled at promotion too — it is newer than the replica.
+        ctrl.server.put("elastic.exit", "localhost:1", "0")
+        promoted = ctrl.promote()
+        # Replay the PROMOTED driver's journal dir from disk: the
+        # commit and the membership must both be there.
+        state, _ = journal_mod.replay(str(tmp_path / "j2"))
+        assert state["kv"]["elastic.state"]["localhost:0"] \
+            == "commit-blob"
+        assert state["kv"]["elastic.exit"]["localhost:1"] == "0"
+        assert state["version"] == 0
+        assert state["term"] == 2
+        assert state["rank_order"] == ["localhost:0", "localhost:1"]
+    finally:
+        driver.journal.close()
+        if promoted is not None:
+            promoted.server.stop()
+            if promoted.journal is not None:
+                promoted.journal.close()
+
+
+def test_standby_hint_tracks_primary_liveness(tmp_path, monkeypatch):
+    """Regression (review finding): a worker that defects to the
+    standby during a TRANSIENT primary blip must be pointed back while
+    the lease view says the primary is alive — otherwise its writes
+    strand on a store the primary never reads and the healthy primary
+    eventually kills it as hung. The hint is withdrawn once the lease
+    looks expired and names the standby itself after promotion."""
+    driver = _primary_with_cohort(tmp_path, monkeypatch)
+    promoted = None
+    es2 = ElasticSettings(Settings(num_proc=2), min_np=1,
+                          journal_dir="", driver_port=0)
+    ctrl = StandbyController(es2, ["true"], f"127.0.0.1:{driver.port}",
+                             advertise="127.0.0.1")
+    primary_ep = f"127.0.0.1:{driver.port}"
+    try:
+        assert ctrl.server.primary_hint is None
+        assert ctrl.poll_once()
+        ctrl._update_hint(True)
+        assert ctrl.server.primary_hint == primary_ep
+        # The hint rides every response off the standby's store.
+        with _http("GET", f"http://127.0.0.1:{ctrl.port}/clock") as r:
+            assert r.headers.get(PRIMARY_HEADER) == primary_ep
+        ctrl._update_hint(False)   # lease expired: hint withdrawn
+        assert ctrl.server.primary_hint is None
+        promoted = ctrl.promote()  # promoted: hint names ourselves
+        assert ctrl.server.primary_hint == f"127.0.0.1:{ctrl.port}"
+    finally:
+        driver.server.stop()
+        driver.journal.close()
+        if promoted is not None:
+            promoted.server.stop()
+
+
+def test_empty_replica_promotion_runs_job_fresh(tmp_path, monkeypatch):
+    """Regression (review finding): a primary that dies BEFORE
+    publishing membership leaves an empty replica; the standby must
+    start the job fresh instead of 'adopting' nothing and reporting a
+    phantom failure."""
+    import threading
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", TOKEN)
+    # A primary that journaled nothing but exists (empty journal dir).
+    j = journal_mod.DriverJournal(str(tmp_path / "journal"))
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    server.attach_journal(j)
+    port = server.start()
+    es = ElasticSettings(Settings(num_proc=2), min_np=1,
+                         journal_dir="", driver_port=0)
+    # The command is `true`: a fresh run spawns it per slot, every
+    # slot exits 0, and the job completes successfully.
+    ctrl = StandbyController(es, ["true"], f"127.0.0.1:{port}",
+                             advertise="127.0.0.1",
+                             lease_interval=0.1, lease_timeout=0.5)
+    result = {}
+
+    def run():
+        result["rc"] = ctrl.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.4)  # a few successful (empty) syncs
+    server.stop()    # the primary dies with nothing published
+    j.close()
+    t.join(timeout=60)
+    assert not t.is_alive(), "standby never finished the fresh run"
+    assert result["rc"] == 0
+    assert ctrl.promoted is not None
+    assert ctrl.promoted.succeeded  # the fresh cohort actually ran
+
+
+def test_adopted_proc_reads_exit_marker_and_heartbeat_pid():
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    server.start()
+    try:
+        proc = _AdoptedProc(server, "h:0", host="h")
+        assert proc.poll() is None
+        server.put("heartbeat", "h:0", "4242:17")
+        assert proc._pid() == 4242
+        server.put("elastic.exit", "h:0", "83")
+        assert proc.poll() == 83 and proc.wait() == 83
+    finally:
+        server.stop()
+
+
+# ==========================================================================
+# Rendezvous: republish after a restored/failed-over store
+# ==========================================================================
+
+def test_bootstrap_peers_republishes_after_store_restore(monkeypatch):
+    """Regression (satellite): a KV store that lost the ephemeral peer
+    scope (restart/failover) used to leave every worker waiting out
+    the full deadline for a key it believed it had published; the
+    waiter must detect its own missing key and re-put it."""
+    from horovod_tpu.runner import rendezvous as rdv
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    port = server.start()
+    monkeypatch.setenv("HVDTPU_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HVDTPU_RENDEZVOUS_PORT", str(port))
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", TOKEN)
+    monkeypatch.delenv("HVDTPU_ELASTIC_VERSION", raising=False)
+
+    class _Topo:
+        rank, size = 0, 2
+
+    result = {}
+
+    def bootstrap():
+        result["peers"] = rdv.bootstrap_peers(
+            _Topo(), deadline_s=30, my_addr="9.9.9.9:1111")
+
+    t = threading.Thread(target=bootstrap, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while server.get("peers", "0") is None:
+            assert time.monotonic() < deadline, "own key never published"
+            time.sleep(0.02)
+        # The store "restarts": the ephemeral peer scope vanishes.
+        server.clear_scope("peers")
+        deadline = time.monotonic() + 10
+        while server.get("peers", "0") is None:
+            assert time.monotonic() < deadline, \
+                "own peer key never republished after the store restore"
+            time.sleep(0.02)
+        assert server.get("peers", "0") == b"9.9.9.9:1111"
+        server.put("peers", "1", "8.8.8.8:2222")
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert result["peers"] == "9.9.9.9:1111,8.8.8.8:2222"
+    finally:
+        server.stop()
+        os.environ.pop("HVDTPU_PEERS", None)
+        t.join(timeout=1)
+
+
+# ==========================================================================
+# Heartbeat: error-streak warning (satellite)
+# ==========================================================================
+
+class _LogSpy(logging.Handler):
+    """The horovod_tpu logger doesn't propagate (handler of its own),
+    so 'loud' contracts are pinned with a direct spy."""
+
+    def __init__(self):
+        super().__init__()
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+def test_heartbeat_error_streak_warns_once_naming_endpoint():
+    from horovod_tpu.runner.heartbeat import (ERROR_WARN_STREAK,
+                                              HeartbeatThread)
+    from horovod_tpu.utils.logging_util import get_logger
+    port = _free_closed_port()
+    hb = HeartbeatThread("127.0.0.1", port, "t", "w0", interval_s=0.01)
+    spy = _LogSpy()
+    spy.setLevel(logging.WARNING)
+    get_logger().addHandler(spy)
+    try:
+        hb.start()
+        deadline = time.monotonic() + 30
+        while hb._consec_errors < ERROR_WARN_STREAK + 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        hb.stop()
+    finally:
+        get_logger().removeHandler(spy)
+    assert hb._consec_errors >= ERROR_WARN_STREAK
+    warnings = [m for m in spy.messages
+                if "consecutive beat failures" in m]
+    # ONE warning per streak — at the threshold, not per failure.
+    assert len(warnings) == 1, warnings
+    assert f"127.0.0.1:{port}" in warnings[0]
+    assert str(ERROR_WARN_STREAK) in warnings[0]
+
+
+# ==========================================================================
+# Chaos plane: the `driver` injection point (satellite)
+# ==========================================================================
+
+def test_chaos_spec_driver_point_and_actions():
+    from horovod_tpu.chaos import spec
+    rules = spec.parse_spec("driver:kill:after=3;driver:partition:ms=50")
+    assert [r.action for r in rules] == ["kill", "partition"]
+    assert rules[1].ms == 50
+    # partition is consumed by the driver site only.
+    with pytest.raises(spec.ChaosSpecError):
+        spec.parse_spec("kv_get:partition")
+    with pytest.raises(spec.ChaosSpecError):
+        spec.parse_spec("worker:partition")
+    assert "driver" in spec.POINTS
+    assert "kill" in spec.ACTIONS and "partition" in spec.ACTIONS
+
+
+def test_chaos_points_cli_lists_driver(capsys):
+    from horovod_tpu.chaos import cli
+    assert cli.main(["points"]) == 0
+    out = capsys.readouterr().out
+    assert "driver" in out and "partition" in out and "kill" in out
+
+
+def test_chaos_driver_partition_pauses_store(tmp_path, monkeypatch):
+    from horovod_tpu import chaos
+    monkeypatch.setenv("HVDTPU_CHAOS", "driver:partition:ms=300:once")
+    chaos.reset()
+    es = ElasticSettings(Settings(num_proc=1), min_np=1)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        driver._chaos_driver()
+        # Mid-partition every request is dropped on the floor…
+        with pytest.raises((urllib.error.URLError, OSError,
+                            ConnectionError)):
+            _http("GET", f"http://127.0.0.1:{driver.port}/clock")
+        # …and the store answers again once the window passes.
+        time.sleep(0.35)
+        with _http("GET", f"http://127.0.0.1:{driver.port}/clock",
+                   token=driver.token) as resp:
+            assert resp.status == 200
+    finally:
+        monkeypatch.delenv("HVDTPU_CHAOS")
+        chaos.reset()
+        driver.server.stop()
+
+
+# ==========================================================================
+# Disabled-mode contract + knob registry
+# ==========================================================================
+
+def test_disabled_mode_takes_existing_code_path(monkeypatch):
+    """No standby/journal knobs → no journal object, no term fencing,
+    no /journal route, no endpoint-failover state — pinned with a
+    bombed DriverJournal like the telemetry/chaos/guardian guards."""
+    for knob in ("HVDTPU_DRIVER_JOURNAL", "HVDTPU_DRIVER_STANDBY_ADDRS",
+                 "HVDTPU_RENDEZVOUS_ADDRS"):
+        monkeypatch.delenv(knob, raising=False)
+    http_client.reset_failover()
+
+    def bomb(*a, **k):
+        raise AssertionError("journal engaged with HA off")
+
+    monkeypatch.setattr(journal_mod, "DriverJournal", bomb)
+    es = ElasticSettings(Settings(num_proc=1), min_np=1)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        assert driver.journal is None and driver.term is None
+        assert driver.server.journal is None
+        assert driver._endpoint_csv() == ""
+        # Writes are unfenced and un-journaled.
+        driver.server.put("elastic", "version", "0")
+        # The /journal route does not exist.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _http("GET", f"http://127.0.0.1:{driver.port}/journal",
+                  token=driver.token)
+        assert exc.value.code == 404
+        # The KV client carries no failover state.
+        assert http_client._failover_state() is None
+        assert http_client.active_endpoint("x", 1) == ("x", 1)
+    finally:
+        driver.server.stop()
+
+
+def test_exit_marker_silent_without_ha_endpoints(monkeypatch):
+    """Workers publish durable exit markers ONLY when a standby
+    endpoint list was exported — with HA off the driver reaps real
+    exit codes and the contract promises zero extra KV traffic."""
+    from horovod_tpu import elastic
+
+    def bomb(*a, **k):
+        raise AssertionError("exit marker KV traffic with HA off")
+
+    monkeypatch.delenv("HVDTPU_RENDEZVOUS_ADDRS", raising=False)
+    monkeypatch.setattr(http_client, "put_kv", bomb)
+    elastic._publish_exit_marker(0)  # must not touch the KV client
+
+    # With the endpoint list exported, the marker lands.
+    server = KVStoreServer(job_token=TOKEN, addr="127.0.0.1")
+    port = server.start()
+    monkeypatch.undo()
+    monkeypatch.setenv("HVDTPU_RENDEZVOUS_ADDRS", f"127.0.0.1:{port}")
+    monkeypatch.setenv("HVDTPU_JOB_TOKEN", TOKEN)
+    monkeypatch.setenv("HVDTPU_WORKER_ID", "h:0")
+    monkeypatch.delenv("HVDTPU_RENDEZVOUS_ADDR", raising=False)
+    monkeypatch.delenv("HVDTPU_RENDEZVOUS_PORT", raising=False)
+    http_client.reset_failover()
+    try:
+        elastic._publish_exit_marker(83)
+        assert server.get("elastic.exit", "h:0") == b"83"
+    finally:
+        server.stop()
+
+
+def test_ha_knobs_registered():
+    from horovod_tpu.utils import envparse
+    for knob in ("DRIVER_JOURNAL", "DRIVER_JOURNAL_SNAPSHOT_EVERY",
+                 "DRIVER_STANDBY_ADDRS", "DRIVER_LEASE_INTERVAL",
+                 "DRIVER_LEASE_TIMEOUT", "DRIVER_PORT"):
+        assert knob in envparse.KNOBS, knob
