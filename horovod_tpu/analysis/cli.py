@@ -5,6 +5,8 @@ findings with ``file:line`` + fix hints:
 
     hvd-lint train.py examples/
     hvd-lint verify train.py             # + HVD4xx + simulated HVD5xx
+    hvd-lint perf train.py               # + α–β cost model + HVD6xx
+    hvd-lint perf --calibrate ./hvd_traces --write-table model.json
     hvd-lint explain ./traces --program train.py   # postmortem → line
     hvd-lint --format json --fail-on warning src/
     hvd-lint --format sarif src/ > lint.sarif
@@ -26,6 +28,18 @@ mismatches (HVD502) are emitted with per-rank counterexample traces
 proven finding supersedes the heuristic one on the same event. Both
 layers share one parsed corpus and one call-graph fixpoint per
 invocation.
+
+``perf`` is everything ``verify`` does plus the calibrated α–β cost
+model (analysis/costmodel.py) over the SAME parsed corpus and
+call-graph fixpoint: every extracted schedule gets a predicted
+per-step critical path and comm/compute split at the probed cohort
+sizes (``--target-ranks`` / ``HVDTPU_PERF_TARGET_RANKS``), and the
+static HVD6xx performance rules (bucket pessimality, serialization
+points, scale cliffs) join the finding stream. ``--calibrate DIR``
+fits the model table from PR 8 trace shards first
+(``--write-table FILE`` persists it; ``--table FILE`` /
+``HVDTPU_COSTMODEL_TABLE`` loads one); without a table the checked-in
+default covers the cold case.
 
 ``explain`` is the postmortem loop (analysis/explain.py): point it at
 a flight-recorder postmortem bundle directory (and the program via
@@ -59,8 +73,8 @@ import os
 import sys
 import time
 
-from . import (ast_lint, baseline as baseline_mod, explain as
-               explain_mod, sarif, simulate)
+from . import (ast_lint, baseline as baseline_mod, costmodel, explain
+               as explain_mod, sarif, simulate)
 from .diagnostics import ERROR, RULES, dedupe, Diagnostic
 
 
@@ -122,17 +136,50 @@ def _build_parser():
                              "accepted baseline and exit 0")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    perf = parser.add_argument_group(
+        "perf (the `perf` subcommand / --self)")
+    perf.add_argument("--calibrate", default="", metavar="DIR",
+                      help="fit the α–β model table from the trace "
+                           "shards under DIR before analyzing "
+                           "(unreadable/torn shards are skipped with "
+                           "a warning)")
+    perf.add_argument("--write-table", default="", metavar="FILE",
+                      help="persist the calibrated table as JSON "
+                           "(with no paths: calibrate-and-write only)")
+    perf.add_argument("--table", default="", metavar="FILE",
+                      help="model table to predict with (default: "
+                           "the HVDTPU_COSTMODEL_TABLE knob, else the "
+                           "built-in default table)")
+    perf.add_argument("--target-ranks", default="", metavar="LIST",
+                      help="comma-separated cohort sizes to probe "
+                           "(default: the HVDTPU_PERF_TARGET_RANKS "
+                           "knob, else 8,64,256,1024)")
     return parser
 
 
-def _collect(paths, verify):
+def _collect(paths, verify, perf=False, table=None, ranks=None,
+             want_report=False):
+    """One invocation, one parsed corpus: the AST layer shares file
+    reads with the verifier through the parse cache, and the verify
+    and perf legs share ONE Verifier (one corpus load, one call-graph
+    fixpoint — the perf leg's ``Verifier.fixpoint()`` is idempotent).
+    Returns ``(diags, perf_report_or_None)``."""
     diags = ast_lint.lint_paths(paths)
-    if verify:
-        # heuristic HVD4xx + simulated HVD5xx over ONE shared corpus
-        # and call-graph fixpoint (the parse cache already de-dupes
-        # the file reads against the AST leg above)
-        diags.extend(simulate.verify_and_simulate_paths(paths))
-    return dedupe(sorted(diags, key=Diagnostic.sort_key))
+    report = None
+    if verify or perf:
+        verifier = simulate.Verifier()
+        for path in ast_lint.iter_python_files(paths):
+            verifier.add_path(path)
+        if verify:
+            # heuristic HVD4xx + simulated HVD5xx
+            diags.extend(simulate.run_combined(verifier))
+        if perf:
+            diags.extend(costmodel.perf_diagnostics(
+                verifier, table=table, target_ranks=ranks))
+            if want_report:
+                report = costmodel.analyze_corpus(
+                    verifier, table=table, target_ranks=ranks)
+    return dedupe(sorted(diags, key=Diagnostic.sort_key)), report
 
 
 def _explain_main(argv):
@@ -183,8 +230,10 @@ def main(argv=None):
     if argv and argv[0] == "explain":
         return _explain_main(argv[1:])
     verify = bool(argv) and argv[0] == "verify"
-    if verify:
+    perf = bool(argv) and argv[0] == "perf"
+    if verify or perf:
         argv = argv[1:]
+    verify = verify or perf  # perf = verify + the cost-model layer
     parser = _build_parser()
     args = parser.parse_args(argv)
     t_start = time.perf_counter()
@@ -203,16 +252,58 @@ def main(argv=None):
     if args.self_sweep:
         paths = [_package_dir()]
         verify = True
+        perf = True   # the perf leg rides the same corpus — HVD6xx
         if fail_on == "error":
             fail_on = "warning"
-    elif not paths and not check_knobs:
+    elif not paths and not check_knobs and not args.calibrate:
         paths = ["."]
     # `hvd-lint --check-knobs` with no paths runs ONLY the cross-check.
 
-    diags = []
+    table, ranks = None, None
+    if perf:
+        if args.calibrate:
+            try:
+                table = costmodel.fit_paths([args.calibrate])
+            except (OSError, ValueError) as exc:
+                print(f"hvd-lint perf: {exc}", file=sys.stderr)
+                return 2
+            worlds = "/".join(str(w) for w in table["worlds"])
+            compute = ("none" if table["compute_s"] is None
+                       else f"{table['compute_s'] * 1e3:.3f} ms")
+            print(f"hvd-lint perf: calibrated {table['spans']} span(s) "
+                  f"at world size(s) {worlds or '?'} "
+                  f"(compute baseline: {compute})")
+            if args.write_table:
+                try:
+                    costmodel.save_table(table, args.write_table)
+                except OSError as exc:
+                    print(f"hvd-lint perf: cannot write table: {exc}",
+                          file=sys.stderr)
+                    return 2
+                print("hvd-lint perf: model table -> "
+                      f"{args.write_table}")
+            if not paths:
+                return 0
+        elif args.table:
+            try:
+                table = costmodel.load_table(args.table)
+            except (OSError, ValueError) as exc:
+                print(f"hvd-lint perf: cannot read table: {exc}",
+                      file=sys.stderr)
+                return 2
+        if args.target_ranks:
+            ranks = sorted({int(p) for p in
+                            args.target_ranks.split(",")
+                            if p.strip().isdigit() and int(p) >= 2}) \
+                or None
+
+    diags, perf_report = [], None
     try:
         if paths:
-            diags = _collect(paths, verify)
+            diags, perf_report = _collect(
+                paths, verify, perf=perf, table=table, ranks=ranks,
+                want_report=(perf and not args.self_sweep
+                             and args.format == "text"))
     except OSError as exc:
         print(f"hvd-lint: {exc}", file=sys.stderr)
         return 2
@@ -268,6 +359,10 @@ def main(argv=None):
         print(json.dumps(sarif.to_sarif(diags, suppressed=suppressed),
                          indent=1, sort_keys=True))
     else:
+        if perf_report is not None:
+            report_text = costmodel.render_report(perf_report)
+            if report_text:
+                print(report_text)
         for d in diags:
             print(d.format())
             trace_text = simulate.render_trace(d)

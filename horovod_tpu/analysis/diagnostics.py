@@ -88,6 +88,19 @@ RULES = {
                         "(scenario caps, loop widening, inline depth) "
                         "could neither prove nor refute divergence "
                         "under rank-tainted control flow"),
+    # -- cost-model layer: static performance (hvd-lint perf) --------------
+    "HVD601": (WARNING, "bucket size pessimal at target scale: a "
+                        "literal bucket-bytes knob sits >=2x away "
+                        "from the cost model's predicted optimum at "
+                        "the largest probed cohort"),
+    "HVD602": (WARNING, "serialization point on the predicted "
+                        "critical path: a per-step barrier or "
+                        "synchronous per-tensor submits with zero "
+                        "overlap opportunity"),
+    "HVD603": (WARNING, "predicted scale cliff: the modeled comm "
+                        "fraction crosses 50% between two probed "
+                        "cohort sizes (the step goes "
+                        "communication-bound)"),
     # -- AST layer: concurrency & liveness (hvd-sanitize) ------------------
     "HVD301": (WARNING, "mutable attribute shared between a thread "
                         "target and other methods written without a "

@@ -758,17 +758,29 @@ class Verifier:
     def __init__(self):
         self.corpus = _Corpus()
         self.entries = []
+        self._fixpointed = False
 
     def add_path(self, path):
         mod = self.corpus.load(path)
         if mod is not None:
             self.entries.append(mod)
+            self._fixpointed = False
         return mod
 
     def add_source(self, src, filename="<string>"):
         mod = self.corpus.add_source(src, filename)
         self.entries.append(mod)
+        self._fixpointed = False
         return mod
+
+    def fixpoint(self):
+        """Idempotent fixpoint: consumers that run AFTER the rules
+        (the perf cost model) share the invocation's one call-graph
+        fixpoint instead of re-walking the corpus."""
+        if not self._fixpointed:
+            self._fixpoint()
+            self._compute_balance()
+            self._fixpointed = True
 
     def _all_funcs(self):
         for path in sorted(self.corpus.modules):
@@ -827,8 +839,7 @@ class Verifier:
 
     # -- rules -------------------------------------------------------------
     def run(self):
-        self._fixpoint()
-        self._compute_balance()
+        self.fixpoint()
         diags = []
         diags_404, cross_set_events = self._rule_404()
         diags += diags_404

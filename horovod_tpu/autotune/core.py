@@ -142,6 +142,15 @@ class ParameterManager:
         # -- sweep state ----------------------------------------------
         self._arm_idx = 0
         self._active = list(range(len(self._grid)))
+        # Cost-model warm-start prior (HVDTPU_COSTMODEL): probe the
+        # host grid in the model's predicted order. Pure prior —
+        # measured scores still decide, and the order is a pure
+        # function of (table, world, grid), so every rank derives the
+        # same sequence and the broadcast determinism pin holds.
+        self._prior_table = None
+        order = self._costmodel_priors(self._arms[0])
+        if order is not None:
+            self._active = order
         self._budget = self._round_budget(len(self._active))
         self._pos = -1               # index into _active; -1 = no cand
         self._cycle = 0
@@ -609,6 +618,52 @@ class ParameterManager:
                        self.best_config)
 
     # -- sweep mechanics ---------------------------------------------------
+    def _costmodel_priors(self, arm):
+        """Candidate probe order from the α–β cost model, or None when
+        ``HVDTPU_COSTMODEL`` is off (the knob check is the ONLY thing
+        that runs then — disabled mode constructs no model, guard-
+        tested) or the model is unusable (grid order is always a safe
+        fallback — the prior only reorders, never filters)."""
+        if not envparse.get_bool(envparse.COSTMODEL):
+            return None
+        try:
+            from ..analysis import costmodel
+            if self._prior_table is None:
+                self._prior_table = costmodel.resolve_table()
+            order = costmodel.rank_candidates(
+                arm.name, arm.candidates, self._world,
+                self._prior_table)
+        except Exception as exc:  # noqa: BLE001 — prior is optional
+            self._log.warning(
+                "autotune: cost-model prior unavailable for arm %r "
+                "(%s); probing in grid order", arm.name, exc)
+            return None
+        if order != list(range(len(arm.candidates))):
+            self._log.info(
+                "autotune: arm %r probe order seeded from cost-model "
+                "prior: %s", arm.name,
+                [arm.fmt(arm.candidates[i]) for i in order])
+        return order
+
+    def _predicted_costs(self):
+        """Per-arm predicted cost of the converged winners (the store
+        entry's ``predicted`` audit field); None when the model is
+        off."""
+        if not envparse.get_bool(envparse.COSTMODEL):
+            return None
+        try:
+            from ..analysis import costmodel
+            table = self._prior_table or costmodel.resolve_table()
+            out = {}
+            for arm in self._arms:
+                if arm.name in self._winners:
+                    out[arm.name] = costmodel.predicted_cost(
+                        arm.name, self._winners[arm.name],
+                        self._world, table)
+            return out or None
+        except Exception:  # noqa: BLE001 — audit data only
+            return None
+
     def _round_budget(self, n_active):
         """Scoring window for a round with n_active candidates: the LAST
         round (2 survivors) runs at exactly AUTOTUNE_CYCLES_PER_CANDIDATE;
@@ -696,6 +751,9 @@ class ParameterManager:
         if self._arm_idx < len(self._arms):
             nxt = self._arms[self._arm_idx]
             self._active = list(range(len(nxt.candidates)))
+            order = self._costmodel_priors(nxt)
+            if order is not None:
+                self._active = order
             self._round = 0
             self._round_scores = {}
             self._budget = self._round_budget(len(self._active))
@@ -752,7 +810,7 @@ class ParameterManager:
             self._last_score, self._score_label, self._signature,
             self._world, store.codec_signature(self.runtime),
             envparse.get_str(envparse.ELASTIC_VERSION, "0"),
-            history)
+            history, predicted=self._predicted_costs())
         try:
             store.save_entry(self._store_path, self._store_key, entry)
             self._log.info("autotune: winner cached under key %s in %s",

@@ -159,9 +159,13 @@ def clear(path, key=None):
 
 
 def make_entry(config, score, source, signature, world, codec_sig,
-               elastic_version, history):
-    """The JSON shape one converged sweep persists."""
-    return {
+               elastic_version, history, predicted=None):
+    """The JSON shape one converged sweep persists. ``predicted``
+    (optional) carries the α–β cost model's per-arm predicted cost of
+    the winner when the sweep ran with ``HVDTPU_COSTMODEL`` priors —
+    audit data for prediction-vs-measured drift, ignored by
+    validate_entry so old readers and old entries interoperate."""
+    entry = {
         "config": dict(config),
         "score": float(score),
         "score_source": source,
@@ -173,3 +177,6 @@ def make_entry(config, score, source, signature, world, codec_sig,
         "history": [[arm, int(rnd), cand, float(mean)]
                     for arm, rnd, cand, mean in history],
     }
+    if predicted is not None:
+        entry["predicted"] = predicted
+    return entry

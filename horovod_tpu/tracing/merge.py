@@ -72,7 +72,21 @@ def shard_paths(paths, kinds=(SHARD_PREFIX, POSTMORTEM_PREFIX)):
 
 
 def load_paths(paths, kinds=(SHARD_PREFIX, POSTMORTEM_PREFIX)):
-    return [load_shard(p) for p in shard_paths(paths, kinds)]
+    """Load every shard under ``paths``. An unreadable file (crashed
+    rank holding the handle, permissions, mid-collection truncation to
+    a directory...) is warned about and SKIPPED — one bad shard must
+    not kill a merge or a cost-model calibration over the survivors;
+    torn tails inside a readable shard are already handled line-wise
+    by :func:`load_shard`."""
+    from ..utils.logging_util import get_logger
+    out = []
+    for p in shard_paths(paths, kinds):
+        try:
+            out.append(load_shard(p))
+        except OSError as exc:
+            get_logger().warning(
+                "hvd-trace: skipping unreadable shard %s (%s)", p, exc)
+    return out
 
 
 def bundle_by_rank(shards, version=None):
@@ -118,9 +132,12 @@ def collective_spans(shard, align=True):
         key = (rec.get("n"), rec.get("o", 0))
         t = aligned(rec.get("t", 0.0), meta, align)
         s = spans.setdefault(key, {"sub": None, "fin": None,
-                                   "kind": rec.get("k"), "err": False})
+                                   "kind": rec.get("k"), "err": False,
+                                   "bytes": None})
         if e == "sub":
             s["sub"] = t
+            if rec.get("b"):
+                s["bytes"] = rec["b"]
         else:
             s["fin"] = t
             s["err"] = bool(rec.get("err"))

@@ -51,6 +51,22 @@ def trace_scope(version):
     return f"{TRACE_SCOPE}.{version}"
 
 
+def _payload_bytes(entry):
+    """Total payload bytes of a submission's arrays, or 0 when the
+    shapes are unavailable (host objects, barrier entries). Feeds the
+    ``b`` field the α–β cost-model calibration fits bandwidth from
+    (analysis/costmodel.py); best-effort by design — a weird array
+    type must never break the submit path."""
+    try:
+        import math
+        total = 0
+        for a in getattr(entry, "arrays", None) or ():
+            total += int(math.prod(a.shape)) * int(a.dtype.itemsize)
+        return total
+    except Exception:  # noqa: BLE001 — tracing is never load-bearing
+        return 0
+
+
 class FlightRecorder:
     """Bounded ring of recent trace records. Append-only from the hot
     path; ``snapshot()`` copies under the GIL (deque iteration is
@@ -174,8 +190,12 @@ class Tracer:
             occ = self._occ.get(name, 0) + 1
             self._occ[name] = occ
         entry.corr = occ
-        self._emit({"e": "sub", "t": time.time(), "n": name,
-                    "k": entry.kind, "o": occ})
+        rec = {"e": "sub", "t": time.time(), "n": name,
+               "k": entry.kind, "o": occ}
+        nbytes = _payload_bytes(entry)
+        if nbytes:
+            rec["b"] = nbytes
+        self._emit(rec)
 
     def on_complete(self, entry, ok=True):
         name = entry.name or entry.kind

@@ -366,6 +366,22 @@ FLIGHT_RECORDER_EVENTS = register(
     "FLIGHT_RECORDER_EVENTS", "4096",
     "Flight-recorder ring capacity, events per rank")
 
+# -- static performance model (docs/lint.md HVD6xx) -------------------------
+COSTMODEL = register(
+    "COSTMODEL", "0",
+    "Calibrated α–β cost model as an autotuner warm-start prior: the "
+    "sweep probes candidates in the model's predicted order (pure "
+    "prior — measured scores still decide; analysis/costmodel.py)")
+COSTMODEL_TABLE = register(
+    "COSTMODEL_TABLE", "",
+    "Path to a calibrated cost-model table JSON (hvd-lint perf "
+    "--calibrate --write-table); unset falls back to the built-in "
+    "default table")
+PERF_TARGET_RANKS = register(
+    "PERF_TARGET_RANKS", "8,64,256,1024",
+    "Cohort sizes hvd-lint perf probes for predicted scaling curves "
+    "and the HVD603 scale-cliff rule")
+
 # -- serving plane (docs/serving.md) ---------------------------------------
 SERVING = register(
     "SERVING", "0",

@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
 # CI lint gate (tier-1: tests/test_lint.py::test_ci_lint_script).
 #
-# Three legs, all of which must hold or the gate fails:
+# Four legs, all of which must hold or the gate fails:
 #   1. self-analysis  — hvd-lint --self --check-knobs: every rule
 #      (HVD2xx + HVD3xx + the interprocedural HVD4xx + the simulated
-#      HVD5xx) over horovod_tpu/ itself plus the knob-registry/docs
-#      cross-check, failing on warnings.
+#      HVD5xx + the perf HVD6xx) over horovod_tpu/ itself plus the
+#      knob-registry/docs cross-check, failing on warnings.
 #   2. dogfood sweep  — hvd-lint verify over examples/ and bench.py,
 #      failing on warnings: the shipped entry points stay clean (the
 #      schedule simulator included — zero HVD5xx).
@@ -15,20 +15,26 @@
 #      HVD503, and its findings are emitted as lint.sarif (SARIF
 #      2.1.0, counterexample traces as codeFlows) for the CI
 #      artifact/code-scanning upload.
+#   4. perf canary    — hvd-lint perf stays zero-false-positive over
+#      examples/ + bench.py at fail-on-warning, while the perf fixture
+#      corpus (with its checked-in calibration table) still trips
+#      every HVD6xx rule; findings land in perf.sarif.
 #
 # Each leg reports its analysis wall time; within one hvd-lint
-# invocation the AST, verify, and simulate layers share one parsed
-# corpus and one call-graph fixpoint (analysis/ast_lint.py
+# invocation the AST, verify, simulate, and cost-model layers share
+# one parsed corpus and one call-graph fixpoint (analysis/ast_lint.py
 # parse_cached), so the gate's cost is one corpus build per leg, not
 # one per layer.
 #
-# Env: LINT_SARIF_OUT overrides the artifact path (default: lint.sarif
-# in the repo root). HVDTPU_LINT_BASELINE is honored by hvd-lint itself
-# (see docs/lint.md "Baselines").
+# Env: LINT_SARIF_OUT / PERF_SARIF_OUT override the artifact paths
+# (defaults: lint.sarif / perf.sarif in the repo root).
+# HVDTPU_LINT_BASELINE is honored by hvd-lint itself (see docs/lint.md
+# "Baselines").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 sarif_out="${LINT_SARIF_OUT:-lint.sarif}"
+perf_sarif_out="${PERF_SARIF_OUT:-perf.sarif}"
 python="${PYTHON:-python3}"
 command -v "${python}" >/dev/null 2>&1 || python=python
 run_lint() { "${python}" -m horovod_tpu.analysis.cli "$@"; }
@@ -80,4 +86,34 @@ print(f"canary ok: {len(results)} finding(s), "
       f"{len(rules)} rule(s), families {sorted(families)}")
 EOF
 
-echo "ci_lint: all gates green (artifact: ${sarif_out})"
+echo "== hvd-lint perf: examples/ + bench.py (zero HVD6xx FPs) =="
+leg_start
+run_lint perf examples bench.py --fail-on warning
+leg_done
+
+echo "== hvd-lint perf: fixture corpus -> ${perf_sarif_out} =="
+# --fail-on never: the perf corpus is SUPPOSED to trip HVD6xx; the
+# canary below asserts every rule in the family is still being caught.
+leg_start
+run_lint perf tests/lint_fixtures/perf \
+    --table tests/lint_fixtures/perf/costmodel_table.json \
+    --format sarif --fail-on never > "${perf_sarif_out}"
+leg_done
+
+"${python}" - "${perf_sarif_out}" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["version"] == "2.1.0", doc["version"]
+results = doc["runs"][0]["results"]
+rules = {r["ruleId"] for r in results}
+missing = {"HVD601", "HVD602", "HVD603"} - rules
+assert not missing, f"perf fixture corpus no longer trips {sorted(missing)}"
+suppressed = [r for r in results
+              if "good_perf" in json.dumps(r.get("locations", []))]
+assert not suppressed, f"clean/suppressed perf fixtures fired: {suppressed}"
+print(f"perf canary ok: {len(results)} finding(s), rules {sorted(rules)}")
+EOF
+
+echo "ci_lint: all gates green (artifacts: ${sarif_out}, ${perf_sarif_out})"

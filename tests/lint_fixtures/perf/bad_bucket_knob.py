@@ -1,0 +1,26 @@
+"""HVD601 fixture (never executed): literal bucket-knob exports that
+the calibrated model places ≥2x away from the bucket optimum at the
+largest target cohort. Expected: HVD601 x3 (lines 12, 15, 17 — keep
+in sync with tests/test_costmodel.py pins)."""
+
+import os
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+os.environ["HVDTPU_BUCKET_BYTES"] = "4096"
+
+# setdefault spelling, human-readable size literal.
+os.environ.setdefault("HVDTPU_ZERO_BUCKET_BYTES", "8 KiB")
+
+os.environ["HOROVOD_BUCKET_BYTES"] = "2k"
+
+
+def train_step(grad):
+    return hvd.allreduce(grad, name="grad", op=hvd.Average)
+
+
+if __name__ == "__main__":
+    hvd.init()
+    train_step(jnp.zeros((8, 128)))

@@ -1,0 +1,47 @@
+"""HVD603 fixture (never executed, needs the checked-in fixture table
+— ``costmodel_table.json`` carries a 5 ms calibrated compute baseline;
+the default table has none and keeps HVD603 silent). Expected: HVD603
+x3, one per step function below, each crossing the 50% predicted comm
+fraction at a different probed cohort size."""
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+def cliff_early(steps):
+    # One synchronous ring allreduce per step: the model's exposed comm
+    # passes the 5 ms compute baseline between n=8 and n=64.
+    loss = jnp.zeros(())
+    for _ in range(steps):
+        loss = hvd.allreduce(loss, name="loss", op=hvd.Average)
+    return loss
+
+
+def cliff_late(steps):
+    # Four synchronous allgather legs: latency terms pile up slower —
+    # the crossing lands between the larger probed cohorts.
+    for _ in range(steps):
+        a = hvd.allgather(jnp.zeros((4,)), name="a")
+        b = hvd.allgather(jnp.zeros((4,)), name="b")
+        c = hvd.allgather(jnp.zeros((4,)), name="c")
+        d = hvd.allgather(jnp.zeros((4,)), name="d")
+        _ = (a, b, c, d)
+
+
+def cliff_async(steps):
+    # Async pipeline: comm hides under compute until the latency sum
+    # outgrows the baseline twice over — the cliff arrives later but
+    # still arrives.
+    from horovod_tpu.ops.collectives import allreduce_async
+    for _ in range(steps):
+        h0 = allreduce_async(jnp.zeros((8,)), name="g0")
+        h1 = allreduce_async(jnp.zeros((8,)), name="g1")
+        h2 = allreduce_async(jnp.zeros((8,)), name="g2")
+        h3 = allreduce_async(jnp.zeros((8,)), name="g3")
+        h4 = allreduce_async(jnp.zeros((8,)), name="g4")
+        h5 = allreduce_async(jnp.zeros((8,)), name="g5")
+        h6 = allreduce_async(jnp.zeros((8,)), name="g6")
+        h7 = allreduce_async(jnp.zeros((8,)), name="g7")
+        for h in (h0, h1, h2, h3, h4, h5, h6, h7):
+            hvd.synchronize(h)

@@ -1,0 +1,44 @@
+"""HVD602 fixture (never executed): serialization points inside step
+loops. Expected: HVD602 x3 — barrier co-resident with a collective
+(line 15), a second barrier loop (line 23), and three hand-unrolled
+synchronous per-tensor allreduce sites (lines 31-33; the finding pins
+the first). Keep line pins in sync with tests/test_costmodel.py."""
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+def step_with_barrier(steps):
+    out = []
+    for _ in range(steps):
+        hvd.barrier()
+        out.append(hvd.allreduce(jnp.zeros((4,)), name="g",
+                                 op=hvd.Average))
+    return out
+
+
+def epoch_with_barrier(batches, params):
+    for batch in batches:
+        hvd.barrier()
+        params = hvd.allreduce(params, name="p", op=hvd.Average)
+        _ = batch
+    return params
+
+
+def unrolled_layers(steps):
+    for _ in range(steps):
+        w0 = hvd.allreduce(jnp.zeros((4, 4)), name="layer0")
+        w1 = hvd.allreduce(jnp.zeros((4, 4)), name="layer1")
+        w2 = hvd.allreduce(jnp.zeros((4, 4)), name="layer2")
+        _ = (w0, w1, w2)
+
+
+def two_metric_reductions(batches):
+    # NEGATIVE for the unrolled-site leg: two synchronous scalar
+    # reductions per iteration (epoch loss + val loss) is a real
+    # program shape and stays below the three-site threshold.
+    for batch in batches:
+        loss = hvd.allreduce(jnp.zeros(()), name="loss")
+        val = hvd.allreduce(jnp.zeros(()), name="val_loss")
+        _ = (batch, loss, val)

@@ -1,0 +1,42 @@
+"""HVD6xx negative fixture (never executed): every pattern below is
+the *clean* twin of a perf finding and must stay silent under both the
+fixture table (``costmodel_table.json``) and the built-in default.
+
+- bucket knob within 2x of the predicted optimum (HVD601 silent)
+- computed (non-literal) bucket export — invisible by design
+- a barrier alone in a loop (no co-resident collective to serialize)
+- one- and two-site async pipelines: async submits never count toward
+  the HVD602 unrolled-site threshold, and their predicted comm
+  fraction stays under 50% at every probed cohort (HVD603 silent)
+"""
+
+import os
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+os.environ["HVDTPU_BUCKET_BYTES"] = "256 MiB"
+
+os.environ["HVDTPU_ZERO_BUCKET_BYTES"] = str(192 * 1024 * 1024)
+
+
+def train(steps, grads):
+    for _ in range(steps):
+        h = hvd.allreduce_async(jnp.zeros((64,)), name="grad")
+        hvd.synchronize(h)
+        _ = grads
+
+
+def epoch_metrics(batches):
+    for batch in batches:
+        h_loss = hvd.allreduce_async(jnp.zeros(()), name="loss")
+        h_acc = hvd.allreduce_async(jnp.zeros(()), name="acc")
+        hvd.synchronize(h_loss)
+        hvd.synchronize(h_acc)
+        _ = batch
+
+
+def paced_wait(rounds):
+    for _ in range(rounds):
+        hvd.barrier()

@@ -1,0 +1,24 @@
+"""HVD6xx suppression fixture (never executed): one positive of each
+perf rule, each with an explicit same-line disable comment — the
+author has reasoned about every one. Expected findings: none."""
+
+import os
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+# Deliberately tiny buckets: single-host debug deployment.
+os.environ["HVDTPU_BUCKET_BYTES"] = "4096"  # hvd-lint: disable=HVD601
+
+
+def lockstep_probe(steps):
+    for _ in range(steps):
+        hvd.barrier()  # hvd-lint: disable=HVD602 — chaos-drill lockstep
+        _ = hvd.allreduce(jnp.zeros((4,)), name="g", op=hvd.Average)
+
+
+def tiny_cohort_step(steps):
+    # hvd-lint: disable=HVD603 — capped at n=4, cliff unreachable
+    for _ in range(steps):
+        _ = hvd.allreduce(jnp.zeros(()), name="loss")
