@@ -1214,6 +1214,135 @@ def _bench_simulate_lane():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _bench_reshard():
+    """--reshard: the redistribution planner lane (ISSUE 17,
+    docs/resharding.md). Times planner-emitted programs against the
+    naive gather-all baseline (every destination rank stages every
+    source shard — the pre-planner shape of an elastic reshard) across
+    the three canonical transitions: ZeRO 4→2, ZeRO 2→4, and
+    dense→2D (replicated tree onto a dp × tp composed layout).
+    Archives BENCH_r13.json with bytes moved, wall time, peak staging
+    bytes vs the shard + 2×bucket budget, and the α–β cost model's
+    predicted-vs-measured ratio per program."""
+    import time
+
+    import numpy as np
+
+    import jax
+
+    from horovod_tpu import resharding
+    from horovod_tpu.ops.zero import plan_zero
+
+    rng = np.random.RandomState(0)
+    # Transformer-block-ish leaves, ~2.6 MB total, shapes chosen so
+    # the tensor dims divide tp=2 but the flat sizes stay pad-heavy.
+    meta = [((256, 512), "float32"), ((512,), "float32"),
+            ((512, 256), "float32"), ((1024, 64), "float32"),
+            ((37,), "float32")]
+    leaves = [rng.randn(*s).astype(d) for s, d in meta]
+    structs = [jax.ShapeDtypeStruct(s, d) for s, d in meta]
+    bucket = 256 * 1024  # small enough to force multi-step windows
+
+    def zero_spec(n, axis="z"):
+        return resharding.zero_flat_spec(
+            plan_zero(structs, n), axis=axis)
+
+    # dense -> 2D: a replicated tree onto dp=2 x tp=2 — tensor stages
+    # mirror parallel.sharding's column/row rules, ZeRO legs over dp.
+    tp_layouts = [resharding.Sharded("tp", 1),
+                  resharding.Sharded("tp", 0),
+                  resharding.Sharded("tp", 0),
+                  resharding.Replicated(),
+                  resharding.Replicated()]
+    tp_structs = []
+    for (shape, dtype), lay in zip(meta, tp_layouts):
+        shape = list(shape)
+        if isinstance(lay, resharding.Sharded):
+            shape[lay.dim] //= 2
+        tp_structs.append(jax.ShapeDtypeStruct(tuple(shape), dtype))
+    twod_spec = resharding.Spec(
+        {"dp": 2, "tp": 2}, tp_layouts,
+        zero=resharding.ZeroFlat("dp", plan_zero(tp_structs, 2)))
+
+    transitions = [
+        ("zero_4_to_2", zero_spec(4), zero_spec(2)),
+        ("zero_2_to_4", zero_spec(2), zero_spec(4)),
+        ("dense_to_2d",
+         resharding.replicated_spec(len(meta), {"m": 4}), twod_spec),
+    ]
+    rows = []
+    for tag, src, dst in transitions:
+        t0 = time.perf_counter()
+        program = resharding.plan_redistribution(
+            src, dst, meta, bucket_bytes=bucket)
+        plan_s = time.perf_counter() - t0
+        assert program.prove() == [], f"{tag}: program not proven clean"
+        bufs = {r: resharding.buffers_of_tree(src, meta, leaves, r)
+                for r in range(src.world)}
+        ledger = resharding.MemoryLedger()
+        t0 = time.perf_counter()
+        _, report = resharding.execute_host(
+            program, resharding.reader_for_buffers(bufs),
+            ledger=ledger)
+        wall_s = time.perf_counter() - t0
+
+        # Naive baseline: every dst rank stages EVERY source shard
+        # before slicing its part — the full replica per rank.
+        t0 = time.perf_counter()
+        naive_bytes = 0
+        naive_peak = 0
+        for _ in range(dst.world):
+            staged = [np.array(v) for b in bufs.values()
+                      for v in b.values()]
+            nb = sum(v.nbytes for v in staged)
+            naive_bytes += nb
+            naive_peak = max(naive_peak, nb)
+            del staged
+        naive_s = time.perf_counter() - t0
+
+        shard = max(
+            sum(n * np.dtype(d).itemsize
+                for n, d in spec.local_buffers(meta, r).values())
+            for spec in (src, dst) for r in range(spec.world))
+        budget = shard + 2 * bucket
+        assert report["peak_bytes"] <= budget, (
+            f"{tag}: peak {report['peak_bytes']} exceeds "
+            f"shard + 2 x bucket = {budget}")
+        rows.append({
+            "metric": f"reshard_{tag}",
+            "strategy": program.strategy,
+            "steps": len(program.steps),
+            "plan_seconds": round(plan_s, 6),
+            "wall_seconds": round(wall_s, 6),
+            "naive_wall_seconds": round(naive_s, 6),
+            "wire_bytes": program.bytes_moved(),
+            "naive_bytes": naive_bytes,
+            "bytes_saved_vs_naive":
+                naive_bytes - program.bytes_moved(),
+            "peak_bytes": report["peak_bytes"],
+            "naive_peak_bytes": naive_peak,
+            "peak_budget_bytes": budget,
+            "peak_within_budget": report["peak_bytes"] <= budget,
+            "predicted_seconds": round(program.predicted_s, 9),
+            "predicted_over_measured":
+                round(program.predicted_s / max(wall_s, 1e-9), 4),
+        })
+    total_wire = sum(r["wire_bytes"] for r in rows)
+    total_naive = sum(r["naive_bytes"] for r in rows)
+    summary = {
+        "transitions": len(rows),
+        "total_wire_bytes": total_wire,
+        "total_naive_bytes": total_naive,
+        "wire_fraction_of_naive": round(
+            total_wire / max(total_naive, 1), 4),
+        "all_peaks_within_budget": all(
+            r["peak_within_budget"] for r in rows),
+        "all_programs_proven": True,
+    }
+    return {"cmd": "python bench.py --reshard", "rows": rows,
+            "summary": summary}
+
+
 def main():
     if "--simulate-worker" in sys.argv:
         _simulate_worker()
@@ -1398,6 +1527,24 @@ def main():
                   file=sys.stderr, flush=True)
         except Exception as e:  # noqa: BLE001 — evidence is best-effort
             print(f"# bench: BENCH_r08.json write failed: {e}",
+                  file=sys.stderr, flush=True)
+    # --reshard: planner-emitted redistribution programs vs the naive
+    # gather-all baseline (4→2, 2→4, dense→2D), peak staging vs the
+    # shard + 2×bucket budget, predicted-vs-measured ratio per program.
+    # Archives BENCH_r13.json (docs/resharding.md "Bench").
+    if "--reshard" in sys.argv:
+        try:
+            doc = _bench_reshard()
+            for row in doc["rows"]:
+                print(json.dumps(row), flush=True)
+            with open("BENCH_r13.json", "w") as f:
+                json.dump(doc, f, indent=1)
+            print("# bench: reshard lane archived to BENCH_r13.json",
+                  file=sys.stderr, flush=True)
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — best-effort lane
+            print(f"# bench: reshard lane failed: {e!r}",
                   file=sys.stderr, flush=True)
     # --sparse: the sparse/embedding gradient plane lane (ISSUE 11,
     # docs/sparse.md): density × path × codec sweep on a DLRM/NMT

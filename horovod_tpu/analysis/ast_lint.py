@@ -1157,6 +1157,110 @@ def _const_str(node):
     return None
 
 
+class _HandRollResharding:
+    """HVD211 over one module: a ``device_get(...)`` result that flows
+    — through any chain of reshape / ravel / asarray / concatenate /
+    pad / stack / split / indexing hops — into a ``device_put(...)``
+    call is a hand-rolled reshard: it materializes the fully-replicated
+    leaf on host and bypasses the redistribution planner
+    (``horovod_tpu/resharding/``), whose programs are windowed to
+    ``HVDTPU_RESHARD_BUCKET_BYTES``, digest-verified across ranks, and
+    proven deadlock-free under hvd-sim. device_get alone (telemetry,
+    checkpoint writers, test asserts) and device_put of fresh data are
+    both fine — only the get→transform→put chain is the smell.
+
+    Files under a ``resharding`` directory component are exempt (the
+    planner's own executor legitimately stages host windows)."""
+
+    _HOP_FUNCS = {"asarray", "array", "reshape", "ravel", "concatenate",
+                  "pad", "stack", "hstack", "vstack", "split",
+                  "ascontiguousarray", "flatten", "transpose", "copy",
+                  "astype", "squeeze", "expand_dims"}
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        parts = os.path.normpath(filename).split(os.sep)
+        self._exempt = "resharding" in parts
+        self._tainted = set()
+
+    @staticmethod
+    def _call_name(call):
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        return None
+
+    def _is_tainted(self, node):
+        """Does this expression carry device_get-derived data?"""
+        if isinstance(node, ast.Name):
+            return node.id in self._tainted
+        if isinstance(node, ast.Attribute):
+            return self._is_tainted(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._is_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._is_tainted(e) for e in node.elts)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                             ast.SetComp)):
+            return (self._is_tainted(node.elt)
+                    or any(self._is_tainted(g.iter)
+                           for g in node.generators))
+        if isinstance(node, ast.BinOp):
+            return (self._is_tainted(node.left)
+                    or self._is_tainted(node.right))
+        if isinstance(node, ast.Call):
+            name = self._call_name(node)
+            if name == "device_get":
+                return True
+            if name in self._HOP_FUNCS:
+                if isinstance(node.func, ast.Attribute) \
+                        and self._is_tainted(node.func.value):
+                    return True  # tainted.reshape(...) method hop
+                return any(self._is_tainted(a) for a in node.args)
+        return False
+
+    def run(self, tree):
+        if self._exempt:
+            return self.diags
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                if self._is_tainted(node.value):
+                    for tgt in node.targets:
+                        for leaf in ast.walk(tgt):
+                            if isinstance(leaf, ast.Name):
+                                self._tainted.add(leaf.id)
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                if self._is_tainted(node.value) \
+                        and isinstance(node.target, ast.Name):
+                    self._tainted.add(node.target.id)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and self._call_name(node) == "device_put"):
+                continue
+            payloads = list(node.args[:1]) + [
+                kw.value for kw in node.keywords if kw.arg in
+                (None, "x", "arrays")]
+            if any(self._is_tainted(a) for a in payloads):
+                self.diags.append(Diagnostic.make(
+                    "HVD211",
+                    "device_get-derived data flows into device_put: a "
+                    "hand-rolled reshard that materializes the full "
+                    "replica on host, outside the planner's "
+                    "HVDTPU_RESHARD_BUCKET_BYTES window, digest "
+                    "checks, and hvd-sim deadlock proofs",
+                    file=self.filename, line=node.lineno,
+                    hint="express the transition as (src Spec, dst "
+                         "Spec) and run resharding.plan_redistribution "
+                         "+ execute_host / make_jit_executor (docs/"
+                         "resharding.md); suppress with `# hvd-lint: "
+                         "disable=HVD211` only for bounded scalar/"
+                         "debug moves; " + _DOC_HINT))
+        return self.diags
+
+
 def _is_thread_ctor(node):
     if not isinstance(node, ast.Call):
         return False
@@ -1610,6 +1714,7 @@ def _lint_tree(src, tree, filename):
     diags = analyzer.finish()
     diags.extend(_RawTimingAnalyzer(filename).run(tree))
     diags.extend(_RequestBufferAnalyzer(filename).run(tree))
+    diags.extend(_HandRollResharding(filename).run(tree))
     diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)
     return dedupe(sorted(diags, key=Diagnostic.sort_key))

@@ -56,6 +56,13 @@ RULES = {
                         "queue.Queue()/deque()/list-append) in serving "
                         "scheduler/router/handler code — backpressure "
                         "requires bounded queues that reject when full"),
+    "HVD211": (WARNING, "hand-rolled resharding: device_get of a "
+                        "sharded tree flowing (through reshape/concat "
+                        "hops) into device_put outside "
+                        "horovod_tpu/resharding/ — materializes the "
+                        "full replica on host and skips the planner's "
+                        "memory bound, digest verification, and "
+                        "hvd-sim proofs"),
     # -- interprocedural schedule verifier (hvd-lint verify) ---------------
     "HVD401": (ERROR, "collective reachable under rank-tainted control "
                       "flow through any call depth (the whole-program "

@@ -633,37 +633,116 @@ def unshard_moments(state, runtime):
     return per_leaf, scalars, treedefs[0]
 
 
+def _shard_reader(bucket_states, old_runtime, slot):
+    """Windowed ``read_window`` over the old cohort's sharded moment
+    vectors for one inner-state slot: resolves (rank, bucket) to the
+    rank's addressable device shard and slices the requested window —
+    at most one shard is ever resident host-side (cached between
+    consecutive windows), so the fully-replicated flat vector the old
+    gather-everything path materialized never exists."""
+    devices = list(old_runtime.mesh.devices.flat)
+    dev_rank = {id(d): r for r, d in enumerate(devices)}
+    shard_by = {}  # (bucket k) -> {rank: jax shard}
+    for k, bs in enumerate(bucket_states):
+        leaf = jax.tree.leaves(bs)[slot]
+        shard_by[k] = {dev_rank[id(sh.device)]: sh
+                       for sh in leaf.addressable_shards
+                       if id(sh.device) in dev_rank}
+    cache = {}
+
+    def read_window(rank, buf, start, length):
+        _, k = buf
+        key = (k, rank)
+        if key not in cache:
+            cache.clear()
+            cache[key] = np.asarray(
+                shard_by[k][rank].data).reshape(-1)
+        return cache[key][start:start + length]
+
+    return read_window
+
+
 def reshard_state(state, old_runtime, new_runtime, params):
     """Deterministic optimizer-state redistribution for an elastic
-    world-size change: unshard the old cohort's moments to per-leaf
-    vectors, re-bucket + pad + split per the NEW plan, and place the
-    shards on the new mesh. Error-feedback residuals are ZEROED — the
-    old cohort's quantization debt does not line up with the new shard
-    geometry (same contract as the eager ResidualStore's version-keyed
-    reset). Observed into ``hvd_zero_reshard_seconds``."""
+    world-size change, emitted by the redistribution planner
+    (``horovod_tpu/resharding/``): the old and new ``ZeroPlan``\\ s
+    become flat-shard :class:`~horovod_tpu.resharding.Spec`\\ s, the
+    planner derives the bounded-window program (cheapest legal
+    candidate under the α–β cost model, guardian-verified and proven
+    HVD501/HVD502-clean), and the host executor assembles each NEW
+    rank's shard from windowed reads of the OLD ranks' addressable
+    shards — peak host memory stays within one shard + 2×
+    ``HVDTPU_RESHARD_BUCKET_BYTES`` instead of the full flat vector.
+    Error-feedback residuals are ZEROED — the old cohort's
+    quantization debt does not line up with the new shard geometry
+    (same contract as the eager ResidualStore's version-keyed reset).
+    Observed into ``hvd_zero_reshard_seconds``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from .. import resharding
     from ..telemetry import span as tele_span
     with tele_span(["zero"], "ZERO_RESHARD",
                    histogram=_m_reshard_hist()):
         new_plan = new_runtime.ensure_plan(params)
-        per_leaf, scalars, treedef = unshard_moments(state, old_runtime)
+        old_plan = old_runtime.plan
+        bucket_states = state[0]
+        treedefs = [jax.tree.structure(bs) for bs in bucket_states]
+        if any(td != treedefs[0] for td in treedefs[1:]):
+            raise ValueError(
+                "per-bucket inner states diverge in structure")
+        treedef = treedefs[0]
+        for leaf in jax.tree.leaves(bucket_states):
+            if np.ndim(leaf) >= 1 \
+                    and not getattr(leaf, "is_fully_addressable", True):
+                # Multi-process global mesh: this process cannot read
+                # the peers' shards, so an in-place reshard is
+                # impossible — the exit-restart elastic path (restore
+                # from checkpoint at the new world size) is the
+                # supported route there.
+                raise RuntimeError(
+                    "zero: cannot reshard optimizer state in place — "
+                    "a state shard lives on non-addressable devices "
+                    "(multi-process global mesh). Restore from a "
+                    "checkpoint after the elastic restart instead "
+                    "(docs/performance.md \"ZeRO-1\").")
+        meta = list(zip(old_plan.leaf_shapes, old_plan.leaf_dtypes))
+        src_spec = resharding.zero_flat_spec(
+            old_plan, axis=old_runtime.axis_name)
+        dst_spec = resharding.zero_flat_spec(
+            new_plan, axis=new_runtime.axis_name)
+        program = resharding.plan_redistribution(src_spec, dst_spec,
+                                                 meta)
+        program.verify_consistency()
         axis = new_runtime.axis_name
         mesh = new_runtime.mesh
-        vec_sharding = NamedSharding(mesh, P(axis))
+        new_devices = list(mesh.devices.flat)
         rep_sharding = NamedSharding(mesh, P())
-        new_bucket_states = []
-        for b, s in zip(new_plan.buckets, new_plan.shards):
-            flat = []
-            for j in range(len(per_leaf)):
-                if scalars[j] is not None:
-                    flat.append(jax.device_put(scalars[j], rep_sharding))
-                    continue
-                vec = np.concatenate([np.ravel(per_leaf[j][i])
-                                      for i in b.indices])
-                if vec.size != s.padded:
-                    vec = np.pad(vec, (0, s.padded - vec.size))
-                flat.append(jax.device_put(vec, vec_sharding))
-            new_bucket_states.append(jax.tree.unflatten(treedef, flat))
+        slot0 = jax.tree.leaves(bucket_states[0])
+        nslots = len(slot0)
+        # per bucket: the flat list of new inner-state leaves
+        new_flat = [[None] * nslots
+                    for _ in range(len(new_plan.buckets))]
+        for j in range(nslots):
+            if np.ndim(slot0[j]) == 0:
+                scalar = np.asarray(slot0[j])
+                for k in range(len(new_plan.buckets)):
+                    new_flat[k][j] = jax.device_put(scalar,
+                                                    rep_sharding)
+                continue
+            dtypes = {str(jax.tree.leaves(bs)[j].dtype)
+                      for bs in bucket_states}
+            override = dtypes.pop() if len(dtypes) == 1 else None
+            results, _ = resharding.execute_host(
+                program, _shard_reader(bucket_states, old_runtime, j),
+                dtype_override=override)
+            for k, s in enumerate(new_plan.shards):
+                vec_sharding = NamedSharding(mesh, P(axis))
+                new_flat[k][j] = \
+                    jax.make_array_from_single_device_arrays(
+                        (s.padded,), vec_sharding,
+                        [jax.device_put(results[r][("bucket", k)], d)
+                         for r, d in enumerate(new_devices)])
+        new_bucket_states = [jax.tree.unflatten(treedef, flat)
+                             for flat in new_flat]
         if new_runtime.error_feedback:
             n = new_runtime.n
             res_s = tuple(

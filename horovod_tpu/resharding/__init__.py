@@ -1,0 +1,31 @@
+"""Memory-bounded (mesh, layout) → (mesh, layout) redistribution.
+
+The planner (docs/resharding.md) unifies the three hand-rolled
+transitions — ZeRO elastic reshard (``ops.zero.reshard_state``),
+train→serve range programs (``serving.state``), and 2D data × tensor
+composition (``parallel.twod``) — into one algebra: describe both
+sides as :class:`Spec`, call :func:`plan_redistribution`, execute the
+resulting :class:`Program` host-side (:func:`execute_host`) or in-jit
+(:func:`make_jit_executor`). Programs are chunked to
+``HVDTPU_RESHARD_BUCKET_BYTES``, priced by the α–β cost model, carry
+guardian digests, and prove themselves deadlock-free under hvd-sim
+(``Program.prove``).
+"""
+
+from .spec import (Interval, Replicated, Sharded, Spec, ZeroFlat,
+                   leaf_offsets, replicated_spec, tree_meta_of,
+                   zero_flat_spec)
+from .planner import (Copy, DEFAULT_RESHARD_BUCKET_BYTES, PlanError,
+                      Program, Step, check_streams,
+                      plan_redistribution)
+from .execute import (MemoryLedger, buffers_of_tree, execute_host,
+                      make_jit_executor, reader_for_buffers)
+
+__all__ = [
+    "Interval", "Replicated", "Sharded", "Spec", "ZeroFlat",
+    "leaf_offsets", "replicated_spec", "tree_meta_of",
+    "zero_flat_spec", "Copy", "DEFAULT_RESHARD_BUCKET_BYTES",
+    "PlanError", "Program", "Step", "check_streams",
+    "plan_redistribution", "MemoryLedger", "buffers_of_tree",
+    "execute_host", "make_jit_executor", "reader_for_buffers",
+]

@@ -54,19 +54,6 @@ class _Range:
                 f"-> dst[{self.dst_offset}])")
 
 
-def _leaf_flat_offsets(plan):
-    """leaf index -> (bucket index, flat offset inside the packed
-    bucket buffer). Packing order is the bucket's ``indices`` order
-    (ops.bucketing._pack)."""
-    out = {}
-    for k, b in enumerate(plan.buckets):
-        off = 0
-        for i in b.indices:
-            out[i] = (k, off)
-            off += int(np.prod(plan.leaf_shapes[i]))
-    return out
-
-
 def row_slice(dim0, world, host):
     """Contiguous near-even row range [lo, hi) of host ``host``."""
     dim0, world, host = int(dim0), int(world), int(host)
@@ -76,38 +63,54 @@ def row_slice(dim0, world, host):
 def plan_inference_ranges(plan, serving_world, layout=REPLICATED):
     """The redistribution program: ``ranges[host][leaf]`` = list of
     :class:`_Range`, plus ``gather_free[host][leaf]`` flags (True when
-    the leaf assembles from a single source shard)."""
+    the leaf assembles from a single source shard).
+
+    A thin wrapper over the redistribution planner
+    (``horovod_tpu/resharding/``): source = the ZeRO flat-shard layout
+    of ``plan``, destination = replicated or near-even dim-0 rows over
+    ``serving_world`` hosts; the planner's copies — adjacent windows
+    re-merged, since serving consumes whole ranges — ARE the ranges
+    this module used to derive by hand."""
+    from .. import resharding
     serving_world = int(serving_world)
     if serving_world < 1:
         raise ValueError("serving_world must be >= 1")
     if layout not in (REPLICATED, ROWS):
         raise ValueError(f"unknown inference layout {layout!r}")
-    offsets = _leaf_flat_offsets(plan)
+    meta = list(zip(plan.leaf_shapes, plan.leaf_dtypes))
+    src = resharding.zero_flat_spec(plan, axis="z")
+    if layout == ROWS:
+        dst = resharding.Spec(
+            {"s": serving_world},
+            [resharding.Sharded("s", 0, even=False) for _ in meta])
+    else:
+        dst = resharding.replicated_spec(len(meta),
+                                         {"s": serving_world})
+    program = resharding.plan_redistribution(src, dst, meta)
+    per_host = [[[] for _ in meta] for _ in range(serving_world)]
+    for step in program.steps:
+        for c in step.copies:
+            per_host[c.dst_rank][c.leaf].append(c)
     ranges, gather_free = [], []
     for host in range(serving_world):
         host_ranges, host_free = [], []
-        for i, shape in enumerate(plan.leaf_shapes):
-            k, off = offsets[i]
-            shard_len = plan.shards[k].shard_len
-            size = int(np.prod(shape))
-            if layout == ROWS and len(shape) >= 1 and shape[0] >= 1:
-                rowsz = size // shape[0] if shape[0] else size
-                lo, hi = row_slice(shape[0], serving_world, host)
-                start, length = off + lo * rowsz, (hi - lo) * rowsz
-            else:
-                start, length = off, size
-            # Split [start, start+length) across the source ranks'
-            # contiguous shard_len slices of the padded bucket.
+        for i in range(len(meta)):
             leaf_ranges = []
-            pos = start
-            end = start + length
-            while pos < end:
-                r = pos // shard_len
-                in_shard = pos - r * shard_len
-                take = min(end - pos, shard_len - in_shard)
-                leaf_ranges.append(_Range(k, r, in_shard, take,
-                                          pos - start))
-                pos += take
+            for c in sorted(per_host[host][i],
+                            key=lambda c: c.dst_off):
+                k = c.src_buf[1]
+                prev = leaf_ranges[-1] if leaf_ranges else None
+                if prev is not None and prev.bucket == k \
+                        and prev.src_rank == c.src_rank \
+                        and prev.src_offset + prev.length \
+                        == c.src_off \
+                        and prev.dst_offset + prev.length \
+                        == c.dst_off:
+                    prev.length += c.length
+                else:
+                    leaf_ranges.append(_Range(k, c.src_rank,
+                                              c.src_off, c.length,
+                                              c.dst_off))
             host_ranges.append(leaf_ranges)
             host_free.append(len({rg.src_rank for rg in leaf_ranges})
                              <= 1)

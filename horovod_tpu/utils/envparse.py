@@ -348,6 +348,12 @@ ZERO_BUCKET_BYTES = register(
     "ZERO_BUCKET_BYTES", "16 MiB",
     "Payload bytes per ZeRO fusion bucket (reduce-scatter/allgather "
     "legs); defaults to the overlap plane's bucket budget")
+RESHARD_BUCKET_BYTES = register(
+    "RESHARD_BUCKET_BYTES", "4 MiB",
+    "Window budget of redistribution-planner collective steps "
+    "(horovod_tpu/resharding/): no step stages more than this many "
+    "bytes per rank, so an elastic reshard or train->serve transform "
+    "never materializes a fully-replicated leaf")
 
 # -- cross-rank tracing (docs/tracing.md) ----------------------------------
 TRACE = register(

@@ -1,0 +1,46 @@
+"""Fixture: hand-rolled resharding — device_get of a sharded tree
+flowing into device_put outside horovod_tpu/resharding/ (HVD211 x3,
+docs/lint.md)."""
+import jax
+import numpy as np
+
+
+def reshard_by_hand(state, new_sharding):
+    # HVD211: the classic chain — gather the full replica to host,
+    # reslice, push back. Skips the planner's memory bound entirely.
+    full = jax.device_get(state)
+    chunks = np.reshape(full, (4, -1))
+    return jax.device_put(chunks, new_sharding)
+
+
+def reslice_leaf(leaf, sharding):
+    # HVD211: one-liner variant, taint through nested hops.
+    return jax.device_put(
+        np.asarray(jax.device_get(leaf)).ravel(), sharding)
+
+
+def regroup(parts, sharding):
+    # HVD211: taint survives concatenate across multiple gathered
+    # shards — still the full replica on host.
+    host = [jax.device_get(p) for p in parts]
+    merged = np.concatenate([np.ravel(h) for h in host])
+    staged = np.pad(merged, (0, 3))
+    return jax.device_put(staged, sharding)
+
+
+def checkpoint_write(tree, path):
+    # Fine: device_get with no device_put — checkpoint writers and
+    # telemetry legitimately read to host.
+    host = jax.device_get(tree)
+    np.save(path, host)
+
+
+def place_fresh(shape, sharding):
+    # Fine: device_put of fresh data never materialized a replica.
+    return jax.device_put(np.zeros(shape), sharding)
+
+
+def scalar_move(counter, sharding):
+    # Fine when suppressed: a bounded scalar/debug move.
+    val = jax.device_get(counter)
+    return jax.device_put(val, sharding)  # hvd-lint: disable=HVD211

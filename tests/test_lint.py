@@ -306,6 +306,58 @@ class TestAstRules:
         assert severity == WARNING
         assert "backpressure" in title
 
+    # -- HVD211: hand-rolled resharding -----------------------------------
+    def test_hand_resharding_fixture(self):
+        assert rules_of(self.lint("bad_hand_resharding.py")) == \
+            ["HVD211", "HVD211", "HVD211"]
+
+    def test_hand_resharding_direct_chain(self):
+        src = ("import jax\n"
+               "import numpy as np\n"
+               "def move(tree, sharding):\n"
+               "    full = jax.device_get(tree)\n"
+               "    return jax.device_put(full.reshape(4, -1),\n"
+               "                          sharding)\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD211"]
+
+    def test_device_get_alone_is_clean(self):
+        # Checkpoint writers / telemetry reads never device_put back.
+        src = ("import jax\n"
+               "import numpy as np\n"
+               "def snapshot(tree, path):\n"
+               "    np.save(path, jax.device_get(tree))\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_device_put_of_fresh_data_is_clean(self):
+        src = ("import jax\n"
+               "import numpy as np\n"
+               "def seed(shape, sharding):\n"
+               "    return jax.device_put(np.zeros(shape), sharding)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_resharding_package_is_exempt(self):
+        src = ("import jax\n"
+               "def window(buf, sharding):\n"
+               "    host = jax.device_get(buf)\n"
+               "    return jax.device_put(host, sharding)\n")
+        diags = ast_lint.lint_source(
+            src, filename="horovod_tpu/resharding/execute.py")
+        assert diags == []
+
+    def test_hand_resharding_suppressible(self):
+        src = ("import jax\n"
+               "def move(x, sharding):\n"
+               "    v = jax.device_get(x)\n"
+               "    return jax.device_put(v, sharding)"
+               "  # hvd-lint: disable=HVD211\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_hvd211_in_catalog(self):
+        from horovod_tpu.analysis.diagnostics import RULES, WARNING
+        severity, title = RULES["HVD211"]
+        assert severity == WARNING
+        assert "resharding" in title
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"
