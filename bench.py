@@ -844,6 +844,254 @@ def _bench_serving(hvd, on_tpu):
     return rows, summary
 
 
+def _bench_fleet(hvd, on_tpu):
+    """`--fleet` lane (docs/fault_tolerance.md "Fleet arbitration"):
+    replay a scripted traffic-spike profile against the two-plane rig
+    — a simulated training loop (deterministic cohort-size-invariant
+    updates, one "commit" per step) sharing a slot budget with a real
+    serving stack (continuous-batching workers + router) under the
+    fleet arbiter — and measure what the arbitration costs each plane:
+    recovery time from spike onset to lease completion, training steps
+    lost (MUST be 0: the trajectory is compared step-for-step against
+    an uninterrupted reference), and accepted requests lost (MUST be
+    0: rejections are backpressure, errors are loss).
+
+    METHODOLOGY (CPU stand-in): decode steps padded to DECODE_DELAY_S,
+    training steps to TRAIN_STEP_S, exactly like the serving lane; the
+    numbers scale with the padding but the arbitration path — breach
+    detection, lease state machine, preempt-at-commit-boundary, scale
+    -out — is the production code. The process-level version (real
+    SIGTERM/exit-83 workers) is pinned by tests/test_fleet_matrix.py;
+    this lane is the measurable replay. Archived to BENCH_r14.json."""
+    import json as _json
+    import threading
+    import time
+
+    import numpy as np
+
+    from horovod_tpu.fleet.arbiter import FleetArbiter
+    from horovod_tpu.fleet.ledger import LeaseLedger, MemoryBackend
+    from horovod_tpu.fleet.policy import FleetPolicy
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.router import InProcClient, Router
+    from horovod_tpu.serving.worker import ServingWorker
+
+    DECODE_DELAY_S = 0.01
+    TRAIN_STEP_S = 0.05
+    STEPS = 120
+    SLO_P99 = 0.2
+    # Scripted profile: (seconds, offered requests per second). The
+    # spike is sized past one worker's capacity (~50 req/s at this
+    # decode padding and page budget) so the SLO genuinely breaches.
+    PROFILE = ((1.5, 4), (3.0, 120), (2.5, 4))
+    DIM, LR = 8, 0.1
+
+    class PacedToyLM(ToyLM):
+        def decode(self, contexts):
+            time.sleep(DECODE_DELAY_S)
+            return super().decode(contexts)
+
+    def reference_trajectory():
+        params = np.zeros(DIM, np.float32)
+        losses = []
+        for step in range(STEPS):
+            g = params * np.float32(0.3) + np.sin(
+                0.5 * step + np.arange(DIM)).astype(np.float32)
+            params = params - np.float32(LR) * g
+            losses.append(float(np.sum(params ** 2)))
+        return losses
+
+    class SimTrainer(threading.Thread):
+        """The training plane: deterministic updates, one commit per
+        step, cohort size applied at the commit boundary — the same
+        contract the elastic driver gives real workers (preemption
+        lands between steps, never inside one)."""
+
+        def __init__(self, slots):
+            super().__init__(daemon=True)
+            self.slots = slots          # applied at the next boundary
+            self.size_log = []
+            self.losses = []
+            self.params = np.zeros(DIM, np.float32)
+            self.step = 0
+
+        def run(self):
+            while self.step < STEPS:
+                size = self.slots      # commit-boundary snapshot
+                g = self.params * np.float32(0.3) + np.sin(
+                    0.5 * self.step + np.arange(DIM)).astype(
+                        np.float32)
+                # Cohort average of identical per-rank gradients ==
+                # the gradient itself at any size: the invariance the
+                # real allreduce provides.
+                self.params = self.params - np.float32(LR) * g
+                self.losses.append(float(np.sum(self.params ** 2)))
+                self.size_log.append(size)
+                self.step += 1
+                time.sleep(TRAIN_STEP_S)
+
+    class SimActuators:
+        def __init__(self, trainer, plane):
+            self.trainer = trainer
+            self.plane = plane
+
+        def pick_train_victims(self, old, new):
+            return [f"sim:{i}" for i in range(new, old)]
+
+        def pick_serve_victims(self, old, new):
+            return [f"sim:{i}" for i in range(new, old)]
+
+        def set_train_slots(self, n):
+            self.trainer.slots = n
+
+        def set_serve_slots(self, n):
+            self.plane.set_slots(n)
+
+        def drain(self, wid):
+            pass
+
+    class SimProbes:
+        def __init__(self, trainer, plane):
+            self.trainer = trainer
+            self.plane = plane
+
+        def train_size(self):
+            return self.trainer.slots
+
+        def train_victims_gone(self, victims):
+            return True
+
+        def serve_size(self):
+            return len(self.plane.workers)
+
+        def serve_drained(self, victims):
+            return True
+
+        def cohort_stats(self):
+            return {f"serve.{w.wid}": w.stats()
+                    for w in self.plane.workers}
+
+    class ServePlane:
+        def __init__(self):
+            self.workers = []
+            self.router = Router(members={"serve": []})
+
+        def set_slots(self, n):
+            while len(self.workers) < n:
+                w = ServingWorker(
+                    PacedToyLM(), cohort="serve",
+                    wid=len(self.workers), num_pages=24, page_size=2,
+                    queue_limit=32, max_batch_tokens=64).start()
+                self.workers.append(w)
+            self.router.members["serve"] = [InProcClient(w)
+                                            for w in self.workers]
+
+        def stop(self):
+            for w in self.workers:
+                w.stop()
+
+    oracle = ToyLM()
+    plane = ServePlane()
+    plane.set_slots(1)
+    trainer = SimTrainer(slots=2)
+    arbiter = FleetArbiter(
+        LeaseLedger(MemoryBackend()), SimActuators(trainer, plane),
+        SimProbes(trainer, plane),
+        policy=FleetPolicy(min_train_slots=1, min_serve_slots=1,
+                           window=2, cooldown_s=600.0,
+                           ebb_idle_s=600.0, scale_up_depth=8,
+                           slo_p99=SLO_P99),
+        train_slots=2, serve_slots=1, drain_timeout=10.0,
+        tick_s=0.2)
+
+    phase_records = [[] for _ in PROFILE]
+    request_threads = []
+
+    def one_request(i, record):
+        prompt = [2, 3 + i % 5]
+        t0 = time.monotonic()
+        status, body = plane.router.generate(
+            {"prompt": prompt, "max_new_tokens": 8})
+        dt = time.monotonic() - t0
+        if status == 200:
+            good = body["tokens"] == oracle.reference_completion(
+                prompt, 8)
+            record.append(("ok" if good else "corrupt", dt))
+        elif status in (429, 503):
+            record.append(("rejected", dt))
+        else:
+            record.append(("error", dt))
+
+    rows = []
+    try:
+        trainer.start()
+        arbiter.start()
+        spike_t0 = None
+        reqno = 0
+        for phase, (dur, rps) in enumerate(PROFILE):
+            if phase == 1:
+                spike_t0 = time.monotonic()
+            t_end = time.monotonic() + dur
+            while time.monotonic() < t_end:
+                th = threading.Thread(
+                    target=one_request,
+                    args=(reqno, phase_records[phase]))
+                th.start()
+                request_threads.append(th)
+                reqno += 1
+                time.sleep(1.0 / rps)
+        for th in request_threads:
+            th.join(timeout=60)
+        # Recovery time: spike onset -> lease complete.
+        deadline = time.monotonic() + 30
+        while arbiter.ledger.active() is not None \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        recovery_s = None
+        if arbiter.split.get("leased", 0) > 0 and spike_t0 is not None:
+            recovery_s = time.monotonic() - spike_t0
+        arbiter.stop()
+        trainer.join(timeout=STEPS * TRAIN_STEP_S + 30)
+    finally:
+        plane.stop()
+
+    reference = reference_trajectory()
+    lost_steps = STEPS - len(trainer.losses)
+    trajectory_equal = trainer.losses == reference
+    for phase, (dur, rps) in enumerate(PROFILE):
+        rec = phase_records[phase]
+        lat = sorted(t for kind, t in rec if kind == "ok")
+        q = (lambda p: round(lat[min(len(lat) - 1,
+                                     int(p * len(lat)))], 4)) \
+            if lat else (lambda p: None)
+        rows.append({
+            "benchmark": "fleet_spike_replay",
+            "phase": ("warmup", "spike", "after")[phase],
+            "offered_rps": rps,
+            "offered": len(rec),
+            "completed": len(lat),
+            "rejected": sum(1 for k, _ in rec if k == "rejected"),
+            "errors": sum(1 for k, _ in rec
+                          if k in ("error", "corrupt")),
+            "p50_latency_s": q(0.50),
+            "p99_latency_s": q(0.99),
+        })
+    summary = {
+        "profile_s_rps": [list(p) for p in PROFILE],
+        "slo_p99_s": SLO_P99,
+        "train_steps": STEPS,
+        "split_after": arbiter.split,
+        "transfer_completed": arbiter.split.get("leased", 0) > 0,
+        "recovery_time_s": (round(recovery_s, 2)
+                            if recovery_s is not None else None),
+        "lost_steps": lost_steps,
+        "trajectory_equal_to_reference": trajectory_equal,
+        "train_sizes_seen": sorted(set(trainer.size_log)),
+        "accepted_request_loss": sum(r["errors"] for r in rows),
+    }
+    return rows, summary
+
+
 def _bench_keras(hvd, on_tpu):
     """Keras-3 frontend with model math compiled onto the chip
     (set_data_parallel: one XLA program per train step, batch sharded over
@@ -1594,6 +1842,40 @@ def main():
             raise
         except Exception as e:  # noqa: BLE001 — best-effort lane
             print(f"# bench: serving lane failed: {e!r}",
+                  file=sys.stderr, flush=True)
+    # --fleet: scripted traffic-spike replay through the chip-budget
+    # arbiter (training sim + real serving stack under one slot
+    # budget); recovery time, lost steps (must be 0) and accepted
+    # -request loss (must be 0) archived as BENCH_r14.json
+    # (docs/fault_tolerance.md "Fleet arbitration").
+    if "--fleet" in sys.argv:
+        try:
+            rows, summary = _bench_fleet(hvd, on_tpu)
+            for row in rows:
+                print(json.dumps(row), flush=True)
+            with open("BENCH_r14.json", "w") as f:
+                json.dump({"cmd": "python bench.py --fleet",
+                           "rows": rows, "summary": summary}, f,
+                          indent=1)
+            print("# bench: fleet spike replay archived to "
+                  "BENCH_r14.json", file=sys.stderr, flush=True)
+            assert summary["transfer_completed"], (
+                "fleet lane spike never completed a lease transfer — "
+                "no arbitration was measured (BENCH_r14.json)")
+            assert summary["lost_steps"] == 0, (
+                "fleet lane lost training steps across the transfer "
+                "(BENCH_r14.json has the replay)")
+            assert summary["trajectory_equal_to_reference"], (
+                "fleet lane training trajectory diverged from the "
+                "uninterrupted reference (BENCH_r14.json)")
+            assert summary["accepted_request_loss"] == 0, (
+                "fleet lane lost accepted serving requests — "
+                "rejection is backpressure, an error is loss "
+                "(BENCH_r14.json has the replay)")
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — best-effort lane
+            print(f"# bench: fleet lane failed: {e!r}",
                   file=sys.stderr, flush=True)
     # --autotune: default vs converged vs warm-started A/B of the
     # trace-driven online tuner (ISSUE 12, docs/autotune.md), archived

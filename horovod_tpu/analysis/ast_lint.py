@@ -54,6 +54,14 @@ a static finding. Three rules:
   plane's backpressure contract is bounded-queues-or-429
   (docs/serving.md); an unbounded buffer absorbs overload into memory
   and tail latency where nothing can shed it.
+- **HVD212** (warning) — direct worker spawn/terminate outside the
+  driver/actuator modules: a hand-constructed
+  ``spawn.SlotProcess(...)`` or ``terminate``/``kill``/``send_signal``
+  on a worker process handle (``.proc``, ``workers[...]``, or a name
+  bound to either). Cohort mutation is a desired-state write the
+  elastic drivers reconcile (target files, drain flags, the fleet
+  lease ledger); a bypass mutates membership with no journal entry,
+  no lease, and no blacklist accounting.
 
 The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
 analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
@@ -1157,6 +1165,140 @@ def _const_str(node):
     return None
 
 
+# ==========================================================================
+# HVD212: hand-rolled cohort mutation (worker lifecycle outside the
+# driver/actuator modules)
+# ==========================================================================
+
+#: Modules allowed to spawn/terminate worker processes: the elastic
+#: drivers that reconcile desired state (and the launcher/ray shims
+#: that implement the SlotProcess surface), plus the fleet actuator
+#: module, which is the only legal cohort-mutation surface outside
+#: them (docs/fault_tolerance.md "Fleet arbitration").
+_LIFECYCLE_OWNER_SUFFIXES = (
+    "runner/spawn.py", "runner/elastic_driver.py", "runner/standby.py",
+    "runner/job.py", "ray/elastic.py", "fleet/actuators.py")
+
+_KILL_METHODS = frozenset({"terminate", "kill", "send_signal"})
+
+
+class _WorkerLifecycleAnalyzer:
+    """HVD212 over one module: direct worker spawn/terminate outside
+    the lifecycle-owner modules. Constructing a
+    ``spawn.SlotProcess(...)`` by hand, or calling
+    ``terminate``/``kill``/``send_signal`` on a worker process (a
+    ``.proc`` attribute, a ``workers[...]`` entry, or a name bound to
+    either), mutates a cohort behind the back of the elastic driver —
+    no journal entry, no fleet lease, no blacklist accounting, and
+    the next discovery tick fights the change. Cohort mutation is a
+    desired-state write (target files, drain flags) the drivers
+    reconcile; only the modules in ``_LIFECYCLE_OWNER_SUFFIXES`` own
+    process handles."""
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        norm = os.path.normpath(filename).replace(os.sep, "/")
+        self._owner = norm.endswith(_LIFECYCLE_OWNER_SUFFIXES)
+        self._spawn_ctors = set()   # local names bound to SlotProcess
+        self._spawn_mods = set()    # aliases of horovod_tpu.runner.spawn
+        self._hvd_module = False    # file imports horovod at all
+        self._proc_names = set()    # locals holding worker process handles
+
+    # -- import bookkeeping ------------------------------------------------
+    def _note_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    if root in ("horovod_tpu", "horovod"):
+                        self._hvd_module = True
+                    if a.name.endswith(".spawn") \
+                            and root in ("horovod_tpu", "horovod"):
+                        self._spawn_mods.add(
+                            a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.split(".")[0] in ("horovod_tpu", "horovod") \
+                        or node.level:
+                    self._hvd_module = True
+                for a in node.names:
+                    name = a.asname or a.name
+                    if a.name == "SlotProcess":
+                        self._spawn_ctors.add(name)
+                    elif a.name == "spawn":
+                        self._spawn_mods.add(name)
+
+    def _is_spawn_ctor(self, call):
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return fn.id in self._spawn_ctors
+        if isinstance(fn, ast.Attribute) and fn.attr == "SlotProcess":
+            root = _root_name(fn)
+            return root in self._spawn_mods or self._hvd_module
+        return False
+
+    @staticmethod
+    def _worker_receiver(node):
+        """True when the call receiver reads like a worker process
+        handle: any ``.proc`` hop or ``workers``/``.workers[...]``
+        container access in the chain."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("proc", "workers"):
+                return True
+            if isinstance(sub, ast.Name) and sub.id == "workers":
+                return True
+        return False
+
+    def _report(self, node, what):
+        self.diags.append(Diagnostic.make(
+            "HVD212",
+            f"{what} outside the driver/actuator modules: the cohort "
+            "mutates with no journal entry, no fleet lease, and no "
+            "blacklist accounting, and the next discovery reconcile "
+            "fights it",
+            file=self.filename, line=node.lineno,
+            hint="mutate cohorts through desired state the drivers "
+                 "reconcile — autoscale.write_target for membership, "
+                 "fleet/actuators.py drain flags for serving, the "
+                 "arbiter's lease ledger for chip transfers — see "
+                 "docs/fault_tolerance.md \"Fleet arbitration\"; "
+                 "suppress with `# hvd-lint: disable=HVD212` only in "
+                 "launcher shims that own the process table; "
+                 + _DOC_HINT))
+
+    def run(self, tree):
+        if self._owner:
+            return []
+        self._note_imports(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and self._is_spawn_ctor(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self._proc_names.add(target.id)
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_spawn_ctor(node):
+                self._report(node, "direct `SlotProcess(...)` spawn")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _KILL_METHODS:
+                recv = node.func.value
+                if isinstance(recv, ast.Name) \
+                        and recv.id in self._proc_names:
+                    self._report(
+                        node, f"`{recv.id}.{node.func.attr}()` on a "
+                              "hand-spawned worker process")
+                elif self._hvd_module and self._worker_receiver(recv):
+                    self._report(
+                        node,
+                        f"`{_unparse(node.func)}()` on a worker "
+                        "process handle")
+        return self.diags
+
+
 class _HandRollResharding:
     """HVD211 over one module: a ``device_get(...)`` result that flows
     — through any chain of reshape / ravel / asarray / concatenate /
@@ -1714,6 +1856,7 @@ def _lint_tree(src, tree, filename):
     diags = analyzer.finish()
     diags.extend(_RawTimingAnalyzer(filename).run(tree))
     diags.extend(_RequestBufferAnalyzer(filename).run(tree))
+    diags.extend(_WorkerLifecycleAnalyzer(filename).run(tree))
     diags.extend(_HandRollResharding(filename).run(tree))
     diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)

@@ -63,6 +63,12 @@ RULES = {
                         "full replica on host and skips the planner's "
                         "memory bound, digest verification, and "
                         "hvd-sim proofs"),
+    "HVD212": (WARNING, "direct worker spawn/terminate "
+                        "(SlotProcess(...) / terminate/kill on a "
+                        "worker process handle) outside the driver/"
+                        "actuator modules — hand-rolled cohort "
+                        "mutation bypasses the journal, the fleet "
+                        "lease ledger, and blacklist accounting"),
     # -- interprocedural schedule verifier (hvd-lint verify) ---------------
     "HVD401": (ERROR, "collective reachable under rank-tainted control "
                       "flow through any call depth (the whole-program "

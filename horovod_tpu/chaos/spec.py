@@ -49,6 +49,14 @@ POINTS = {
               "journal routes for ms=N; matcher wid=primary|standby "
               "selects the role; docs/fault_tolerance.md Control-plane "
               "HA)",
+    "transfer": "fleet/arbiter.py — each lease-transfer state "
+                "transition, fired after the ledger write and before "
+                "the actuation it authorises (matchers: name = target "
+                "state, kind = direction train_to_serve|"
+                "serve_to_train; docs/fault_tolerance.md Fleet "
+                "arbitration)",
+    "drain": "fleet/actuators.py — raising the serving drain flag "
+             "during a serve->train ebb (matcher: name = cohort)",
 }
 
 # action -> what firing does.

@@ -1,0 +1,29 @@
+"""horovod_tpu.fleet: one chip budget, two planes.
+
+The chip-budget arbiter owns a fixed slot budget split between the
+training cohort and the serving cohort and rebalances it from live
+pressure signals: serving queue depth + p99 SLO breaches pull chips
+*out of* training (graceful preemption at the next commit boundary,
+planner-driven reshard, zero lost steps), and a calm serving plane
+ebbs leased chips back (drain-first, zero dropped accepted requests).
+
+Every rebalance is a journaled **lease transfer**: the lease record
+lands in the driver journal's durable ``fleet`` KV scope *before* any
+actuation it authorises, term-fenced like every other control-plane
+mutation, so a standby promotion mid-transfer resumes or rolls the
+transfer back deterministically (docs/fault_tolerance.md "Fleet
+arbitration").
+
+Modules:
+
+- ``ledger``    — the lease ledger: records, state machine, backends
+- ``policy``    — the pure decision core (pressure in, Decision out)
+- ``actuators`` — the only module outside the drivers allowed to
+  mutate cohorts (HVD212 enforces this)
+- ``arbiter``   — the control loop composing the three
+- ``metrics``   — telemetry families (``hvd_fleet_*``)
+- ``cli``       — the ``hvd-fleet`` operator tool
+"""
+
+__all__ = ["actuators", "arbiter", "cli", "ledger", "metrics",
+           "policy"]

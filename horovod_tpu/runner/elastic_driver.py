@@ -27,6 +27,7 @@ from .http_server import RendezvousServer, new_job_token
 from .job import _rendezvous_ip
 from ..chaos import ChaosSignal, inject as _chaos_inject
 from ..exceptions import PREEMPT_EXIT_CODE, RESTART_EXIT_CODE
+from ..fleet import ledger as fleet_ledger
 from .rendezvous import (ASSIGN_SCOPE, ELASTIC_SCOPE, EXIT_SCOPE,
                          PEER_SCOPE, VERSION_KEY)
 from ..telemetry import core as telemetry
@@ -285,6 +286,11 @@ class ElasticDriver:
         self._m_heartbeat_failures = telemetry.counter(
             "hvd_elastic_driver_heartbeat_failures_total",
             "Workers failed for missing their heartbeat lease")
+        # Graceful-preemption cause ledger: cloud notice vs fleet
+        # arbiter lease transfer (the fleet/ chip arbiter marks its
+        # victims in the durable "fleet" KV scope before shrinking the
+        # target; docs/fault_tolerance.md "Fleet arbitration").
+        self.preempt_causes = {"preempt": 0, "arbiter_transfer": 0}
         self._liveness = heartbeat_mod.LivenessTracker(
             self.elastic.heartbeat_timeout)
         if resume_state is not None:
@@ -531,8 +537,15 @@ class ElasticDriver:
         still = []
         now = time.monotonic()
         for w, kill_at in self.stopping:
-            if w.proc.poll() is not None:
+            rc = w.proc.poll()
+            if rc is not None:
                 w.proc.wait()
+                if rc == PREEMPT_EXIT_CODE:
+                    # A stop-requested worker that hands off at its
+                    # commit boundary is the arbiter-shrink path (the
+                    # target file shrank under a lease): same cause
+                    # accounting as a self-initiated exit 83.
+                    self._count_preempt_exit(w.worker_id)
                 # The lease may have been re-published between the stop
                 # request and the actual exit (a SIGTERM-trapping worker
                 # keeps beating until its commit-boundary hand-off);
@@ -642,6 +655,29 @@ class ElasticDriver:
             if want <= self.version:
                 self.server.delete(ELASTIC_SCOPE, key)
 
+    def _count_preempt_exit(self, wid):
+        """Account one graceful exit-83 hand-off to its cause. The
+        fleet arbiter marks its lease victims in the durable "fleet"
+        scope BEFORE the target shrinks (ledger-before-actuation), so
+        a marker present at exit time means this hand-off belongs to a
+        journaled transfer; the marker is retired durably (journaled
+        delete) so a promoted standby does not re-count it and a later
+        respawn of the slot is judged on its own."""
+        cause = "preempt"
+        marker = self.server.get(fleet_ledger.SCOPE,
+                                 fleet_ledger.TRANSFER_PREFIX + wid)
+        if marker:
+            cause = "arbiter_transfer"
+            self._jrec("kv_delete", scope=fleet_ledger.SCOPE,
+                       key=fleet_ledger.TRANSFER_PREFIX + wid)
+            self.server.delete(fleet_ledger.SCOPE,
+                               fleet_ledger.TRANSFER_PREFIX + wid,
+                               term=self._wt())
+        self.preempt_causes[cause] += 1
+        self.log.info(
+            "elastic driver: worker %s left after a graceful "
+            "preemption hand-off (cause=%s)", wid, cause)
+
     def _sweep_exits(self):
         """Returns True when a failure changed membership."""
         changed = False
@@ -672,9 +708,7 @@ class ElasticDriver:
                 # everything right on its way out. Unconditional on
                 # ``completing`` (the re-publish below is gated anyway):
                 # a preemption during wind-down must not read as a crash.
-                self.log.info(
-                    "elastic driver: worker %s left after a graceful "
-                    "preemption hand-off", wid)
+                self._count_preempt_exit(wid)
                 changed = True
             elif rc == RESTART_EXIT_CODE and not self.completing:
                 # Compiled-plane reset (elastic.py exit-restart): the

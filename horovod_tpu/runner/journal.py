@@ -45,8 +45,13 @@ JOURNAL_FILE = "journal.jsonl"
 SNAPSHOT_FILE = "snapshot.json"
 
 #: KV scopes replicated through the journal (everything else is
-#: ephemeral and re-published by workers after a failover).
-DURABLE_SCOPES = ("elastic.state", "elastic.exit")
+#: ephemeral and re-published by workers after a failover). The
+#: ``fleet`` scope holds the chip-budget arbiter's lease ledger
+#: (fleet/ledger.py): a lease must be durable *before* any actuation
+#: it authorises, so a standby promotion mid-transfer can resume or
+#: roll it back deterministically (docs/fault_tolerance.md "Fleet
+#: arbitration").
+DURABLE_SCOPES = ("elastic.state", "elastic.exit", "fleet")
 
 DEFAULT_SNAPSHOT_EVERY = 256
 

@@ -37,6 +37,7 @@ def scale_knobs():
             envparse.SERVING_SCALE_UP_DEPTH, 32),
         "drain_timeout": envparse.get_float(
             envparse.SERVING_DRAIN_TIMEOUT, 30.0),
+        "slo_p99": envparse.get_float(envparse.SERVING_SLO_P99, 0.0),
     }
 
 
@@ -44,8 +45,8 @@ class Autoscaler:
     """Policy core: observe cohort stats, fire scale hooks."""
 
     def __init__(self, scale_up, scale_down=None, drain=None, *,
-                 scale_up_depth=None, drain_timeout=None, window=3,
-                 cooldown_s=10.0, idle_s=30.0):
+                 scale_up_depth=None, drain_timeout=None, slo_p99=None,
+                 window=3, cooldown_s=10.0, idle_s=30.0):
         knobs = scale_knobs()
         self.scale_up = scale_up
         self.scale_down = scale_down
@@ -56,6 +57,8 @@ class Autoscaler:
         self.drain_timeout = (drain_timeout
                               if drain_timeout is not None
                               else knobs["drain_timeout"])
+        self.slo_p99 = (slo_p99 if slo_p99 is not None
+                        else knobs["slo_p99"])
         self.window = int(window)
         self.cooldown_s = float(cooldown_s)
         self.idle_s = float(idle_s)
@@ -77,8 +80,16 @@ class Autoscaler:
         now = time.monotonic() if now is None else now
         fired = []
         total = sum(self._pressure(s) for s in cohorts.values())
+        worst_p99 = max(
+            (float(s.get("p99_latency") or 0.0)
+             for s in cohorts.values()), default=0.0)
         # -- scale-up ------------------------------------------------------
-        if total >= self.scale_up_depth:
+        # Two breach conditions feed one window-smoothed counter: queue
+        # pressure (the fast signal) and a p99 SLO violation (the
+        # slow-but-not-queued overload the depth trigger misses — every
+        # request admitted, each one crawling).
+        slo_breach = self.slo_p99 > 0 and worst_p99 >= self.slo_p99
+        if total >= self.scale_up_depth or slo_breach:
             self._breaches += 1
         else:
             self._breaches = 0
@@ -86,9 +97,16 @@ class Autoscaler:
                 and now - self._last_scale_up >= self.cooldown_s):
             self._breaches = 0
             self._last_scale_up = now
-            self._log.warning(
-                "serving autoscale: pressure %d >= %d for %d ticks; "
-                "scaling up", total, self.scale_up_depth, self.window)
+            if slo_breach and total < self.scale_up_depth:
+                self._log.warning(
+                    "serving autoscale: p99 %.3fs >= SLO %.3fs for %d "
+                    "ticks (queue shallow at %d); scaling up",
+                    worst_p99, self.slo_p99, self.window, total)
+            else:
+                self._log.warning(
+                    "serving autoscale: pressure %d >= %d for %d "
+                    "ticks; scaling up", total, self.scale_up_depth,
+                    self.window)
             self.scale_up()
             fired.append(("scale_up", total))
         # -- scale-down (drain first) --------------------------------------
@@ -135,12 +153,25 @@ class Autoscaler:
 # --------------------------------------------------------------------------
 
 def write_target(path, hosts_per_line):
-    """Atomically write the desired host list (one ``host:slots`` per
-    line) the discovery script serves to the elastic driver."""
+    """Atomically + durably write the desired host list (one
+    ``host:slots`` per line) the discovery script serves to the
+    elastic driver. fsync before the rename: a rename alone is atomic
+    against concurrent readers but not against power loss — a crash
+    could surface an *empty* target file, which the discovery script
+    would faithfully report as "cohort of zero" and the driver would
+    obediently tear everything down."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write("\n".join(hosts_per_line) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
 
 def discovery_script_lines(target_file):

@@ -134,6 +134,10 @@ class Scheduler:
         self._preempted = collections.OrderedDict()  # id -> _Seq, LRU
         self._stamp = itertools.count(1)
         self.step_log = collections.deque(maxlen=STEP_LOG)
+        # End-to-end (submit -> done) latencies of recent completions,
+        # bounded so stats() can report a rolling p99 without the
+        # window itself becoming an unbounded buffer (HVD210).
+        self._latency_window = collections.deque(maxlen=256)
         self.draining = False
         self.steps = 0
         self.completed = 0
@@ -293,6 +297,7 @@ class Scheduler:
         seq.t_done = time.monotonic()
         if state == DONE:
             self.completed += 1
+            self._latency_window.append(seq.t_done - seq.t_submit)
             if seq.t_prefill_done is not None:
                 _m.latency("decode").observe(
                     seq.t_done - seq.t_prefill_done)
@@ -388,6 +393,16 @@ class Scheduler:
                 "pages_free": self.pool.free_pages,
                 "pages_total": self.pool.num_pages,
                 "draining": self.draining,
+                "p99_latency": self._p99_locked(),
                 "recent_steps": [list(c) for c in
                                  list(self.step_log)[-32:]],
             }
+
+    def _p99_locked(self):
+        """p99 of the recent end-to-end latency window (0.0 until the
+        first completion). Holds self._lock via stats()."""
+        if not self._latency_window:
+            return 0.0
+        ordered = sorted(self._latency_window)
+        return ordered[min(len(ordered) - 1,
+                           int(0.99 * len(ordered)))]

@@ -244,6 +244,14 @@ class ServingWorker:
             flag = http_client.get_kv(
                 addr, port, SERVING_SCOPE, f"drain.{self.cohort}",
                 token=token, retries=0, deadline=2.0)
+            if not (flag and flag.strip() == b"1"):
+                # Per-worker drain: the fleet arbiter ebbs chips back
+                # to training one worker at a time, which must not
+                # drain the survivors of the same cohort.
+                flag = http_client.get_kv(
+                    addr, port, SERVING_SCOPE,
+                    f"drain.{self.cohort}.{self.wid}",
+                    token=token, retries=0, deadline=2.0)
             if flag and flag.strip() == b"1" \
                     and not self.scheduler.draining:
                 self._log.warning(

@@ -416,6 +416,41 @@ SERVING_DRAIN_TIMEOUT = register(
     "SERVING_DRAIN_TIMEOUT", "30",
     "Seconds a draining cohort may take to finish in-flight "
     "sequences before scale-down proceeds anyway")
+SERVING_SLO_P99 = register(
+    "SERVING_SLO_P99", "0",
+    "Serving p99 end-to-end latency SLO in seconds; a window-smoothed "
+    "breach counts as scale-up pressure even with a shallow queue "
+    "(0 = latency trigger off, depth-only autoscaling)")
+
+# -- fleet arbitration (docs/fault_tolerance.md "Fleet arbitration") -------
+FLEET = register(
+    "FLEET", "0",
+    "Enable the chip-budget arbiter: one fixed slot budget split "
+    "between the training and serving cohorts, rebalanced by "
+    "journaled lease transfers (horovod_tpu/fleet/)")
+FLEET_MIN_TRAIN_SLOTS = register(
+    "FLEET_MIN_TRAIN_SLOTS", "1",
+    "Floor the arbiter never shrinks the training cohort below")
+FLEET_MIN_SERVE_SLOTS = register(
+    "FLEET_MIN_SERVE_SLOTS", "1",
+    "Floor the arbiter never shrinks the serving cohort below")
+FLEET_WINDOW = register(
+    "FLEET_WINDOW", "3",
+    "Consecutive pressured observations before the arbiter proposes "
+    "a train->serve lease transfer (smoothing against blips)")
+FLEET_COOLDOWN = register(
+    "FLEET_COOLDOWN", "30",
+    "Seconds between arbiter transfers in either direction; bounds "
+    "reshard churn from an oscillating load")
+FLEET_EBB_IDLE_S = register(
+    "FLEET_EBB_IDLE_S", "60",
+    "Seconds the serving plane must stay unpressured before leased "
+    "slots ebb back to training (drain-first, never dropping an "
+    "accepted request)")
+FLEET_TICK_S = register(
+    "FLEET_TICK_S", "1",
+    "Arbiter control-loop period when running threaded (FleetArbiter"
+    ".start); each tick reads stats, steps leases, actuates")
 
 # -- kernels ----------------------------------------------------------------
 BRIDGE_FLASH = register(

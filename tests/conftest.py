@@ -32,7 +32,7 @@ import pytest  # noqa: E402
 _SLOW_MODULES = {
     "test_spmd", "test_examples", "test_cluster", "test_frameworks",
     "test_elastic", "test_xla_global", "test_weak_scaling",
-    "test_chaos_matrix",
+    "test_chaos_matrix", "test_fleet_matrix",
 }
 # Individual subprocess-spawning tests inside otherwise-fast modules
 # (spawned workers may contend for the real chip; the fast lane stays

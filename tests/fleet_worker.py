@@ -1,0 +1,80 @@
+"""Fleet-matrix training worker (launched by test_fleet_matrix.py).
+
+Runs a fixed number of optimizer steps whose loss trajectory is
+**cohort-size invariant by construction**: every rank computes the
+same deterministic pseudo-gradient from ``(step, params)``, the
+cohort averages it (``hvd.allreduce`` with ``op=Average`` — identity
+on identical inputs at any world size), and the update is applied to
+committed elastic state. Any lost step, replayed-from-stale-state
+step, or corrupted reshard therefore shows up as a per-step loss
+divergence against an uninterrupted reference run at equal step
+counts — which is exactly the headline assertion of the fleet chaos
+row (docs/fault_tolerance.md "Fleet arbitration").
+
+Log lines: ``<wid> step=<n> rank=<r> size=<s> loss=<float>`` per
+step, ``<wid> DONE steps=<n> ...`` at the end.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic  # noqa: E402
+
+LOG = os.environ["ELASTIC_TEST_LOG"]
+STEPS = int(os.environ.get("FLEET_TEST_STEPS", "12"))
+STEP_SLEEP = float(os.environ.get("FLEET_TEST_STEP_SLEEP", "0.3"))
+DIM = 8
+LR = 0.1
+
+WID = os.environ.get("HVDTPU_WORKER_ID", "static:?")
+
+
+def log_line(msg):
+    with open(LOG, "a") as f:
+        f.write(f"{WID} {msg}\n")
+
+
+def pseudo_grad(step, params):
+    """Deterministic, rank-independent gradient: the trajectory is a
+    pure function of the step sequence, never of cohort size."""
+    phase = np.sin(0.5 * step + np.arange(DIM)).astype(np.float32)
+    return params.astype(np.float32) * 0.3 + phase
+
+
+@elastic.run
+def train(state):
+    while state.step < STEPS:
+        g = pseudo_grad(state.step, state.params)
+        g = np.asarray(hvd.allreduce(jnp.asarray(g), op=hvd.Average,
+                                     name=f"step{state.step}"))
+        state.params = state.params - LR * g
+        loss = float(np.sum(state.params ** 2))
+        log_line(f"step={state.step} rank={hvd.rank()} "
+                 f"size={hvd.size()} loss={loss:.10f}")
+        state.step += 1
+        state.commit()
+        time.sleep(STEP_SLEEP)
+    return state.step
+
+
+def main():
+    hvd.init()
+    state = elastic.ObjectState(
+        step=0, params=np.zeros(DIM, np.float32))
+    final_step = train(state)
+    log_line(f"DONE steps={final_step} rank={hvd.rank()} "
+             f"size={hvd.size()} "
+             f"loss={float(np.sum(state.params ** 2)):.10f}")
+
+
+if __name__ == "__main__":
+    main()

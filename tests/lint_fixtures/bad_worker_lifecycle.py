@@ -1,0 +1,35 @@
+"""HVD212 fixture: hand-rolled cohort mutation.
+
+Three positives (direct SlotProcess spawn, terminate on the spawned
+handle, kill through a driver's workers table), one negative (a plain
+subprocess the rule must leave alone), one suppression.
+"""
+
+import subprocess
+
+from horovod_tpu.runner.spawn import SlotProcess
+
+
+def hand_spawn(driver, env):
+    proc = SlotProcess(["python", "worker.py"], env=env)  # HVD212
+    return proc
+
+
+def hand_stop(proc):
+    proc.terminate()  # HVD212 — proc was hand-spawned above
+
+
+def reach_into_driver(driver, wid):
+    driver.workers[wid].proc.kill()  # HVD212
+
+
+def fine_subprocess(cmd):
+    # Negative: an ordinary subprocess that is not a cohort worker.
+    helper = subprocess.Popen(cmd)
+    helper.terminate()
+    return helper
+
+
+def launcher_shim(driver, wid):
+    # Suppressed: a shim that legitimately owns the process table.
+    driver.workers[wid].proc.terminate()  # hvd-lint: disable=HVD212

@@ -1,0 +1,524 @@
+"""Fleet arbiter: ledger, policy, arbiter loop, durable recovery.
+
+The fast (in-process) half of the fleet acceptance story
+(docs/fault_tolerance.md "Fleet arbitration"): the lease state
+machine and its resume/rollback rules, the pressure policy, the
+arbiter's surge/ebb control loop over fake actuators, and — the part
+that earns the "journaled" in journaled lease transfer — a real
+DriverJournal round-trip proving a promotion mid-transfer recovers
+the lease and rolls it forward (or back) deterministically. The
+multi-process rows (real preemption, reshard, serving traffic) live
+in test_fleet_matrix.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu import chaos
+from horovod_tpu.chaos import spec as chaos_spec
+from horovod_tpu.fleet import ledger as ledger_mod
+from horovod_tpu.fleet.arbiter import FleetArbiter
+from horovod_tpu.fleet.ledger import (LeaseLedger, LeaseStateError,
+                                      MemoryBackend)
+from horovod_tpu.fleet.policy import Decision, FleetPolicy
+from horovod_tpu.runner import journal as journal_mod
+from horovod_tpu.runner.http_server import KVStoreServer
+
+
+# --------------------------------------------------------------------------
+# fakes
+# --------------------------------------------------------------------------
+
+class FakeActuators:
+    """Records desired-state writes; slot counts double as probes."""
+
+    def __init__(self, train=4, serve=1):
+        self.train, self.serve = train, serve
+        self.calls = []
+
+    def pick_train_victims(self, old, new):
+        return [f"h:{i}" for i in range(new, old)]
+
+    def pick_serve_victims(self, old, new):
+        return [f"h:{i}" for i in range(new, old)]
+
+    def set_train_slots(self, n):
+        self.train = n
+        self.calls.append(("train", n))
+
+    def set_serve_slots(self, n):
+        self.serve = n
+        self.calls.append(("serve", n))
+
+    def drain(self, wid):
+        self.calls.append(("drain", wid))
+
+
+class FakeProbes:
+    def __init__(self, act):
+        self.act = act
+
+    def train_size(self):
+        return self.act.train
+
+    def train_victims_gone(self, victims):
+        return True
+
+    def serve_size(self):
+        return self.act.serve
+
+    def serve_drained(self, victims):
+        return True
+
+    def cohort_stats(self):
+        return {}
+
+
+def make_policy(**over):
+    kw = dict(min_train_slots=1, min_serve_slots=1, window=2,
+              cooldown_s=0.0, ebb_idle_s=5.0, scale_up_depth=8,
+              slo_p99=0.5)
+    kw.update(over)
+    return FleetPolicy(**kw)
+
+
+def make_arbiter(train=4, serve=1, backend=None, **pol):
+    # One transfer per scenario: a long cooldown keeps the HOT stats
+    # from triggering a second surge while we assert on the first.
+    pol.setdefault("cooldown_s", 50.0)
+    ledger = LeaseLedger(backend if backend is not None
+                         else MemoryBackend())
+    act = FakeActuators(train, serve)
+    arb = FleetArbiter(ledger, act, FakeProbes(act),
+                       policy=make_policy(**pol), train_slots=train,
+                       serve_slots=serve, drain_timeout=30.0)
+    return arb, act, ledger
+
+
+HOT = {"serve.0": {"queue_depth": 10, "running": 2,
+                   "p99_latency": 0.1}}
+COLD = {"serve.0": {"queue_depth": 0, "running": 0,
+                    "p99_latency": 0.0}}
+SLOW_CALM_QUEUE = {"serve.0": {"queue_depth": 1, "running": 1,
+                               "p99_latency": 2.0}}
+
+
+# --------------------------------------------------------------------------
+# ledger state machine
+# --------------------------------------------------------------------------
+
+class TestLedgerStateMachine:
+    def test_chains_advance_in_order(self):
+        led = LeaseLedger(MemoryBackend())
+        lease = led.open("train_to_serve", 1, now=10.0)
+        for state in ("preempting", "resharding", "activating",
+                      "complete"):
+            lease = led.advance(lease, state, now=11.0)
+        assert lease["state"] == "complete"
+        assert led.active() is None  # terminal clears the active key
+
+    def test_skipping_a_state_is_illegal(self):
+        led = LeaseLedger(MemoryBackend())
+        lease = led.open("train_to_serve", 1)
+        with pytest.raises(LeaseStateError):
+            led.advance(lease, "resharding")
+
+    def test_rollback_only_from_proposed(self):
+        led = LeaseLedger(MemoryBackend())
+        lease = led.open("serve_to_train", 1)
+        led.advance(lease, "rolled_back")  # fine from proposed
+        led2 = LeaseLedger(MemoryBackend())
+        lease2 = led2.open("serve_to_train", 1)
+        lease2 = led2.advance(lease2, "draining")
+        with pytest.raises(LeaseStateError):
+            led2.advance(lease2, "rolled_back")
+
+    def test_resume_action_rules(self):
+        assert ledger_mod.resume_action({"state": "proposed"}) \
+            == "rollback"
+        for state in ("preempting", "resharding", "activating",
+                      "draining", "returning"):
+            assert ledger_mod.resume_action({"state": state}) \
+                == "roll_forward"
+        assert ledger_mod.resume_action({"state": "complete"}) is None
+        assert ledger_mod.resume_action({"state": "rolled_back"}) \
+            is None
+
+    def test_single_lease_in_flight(self):
+        led = LeaseLedger(MemoryBackend())
+        led.open("train_to_serve", 1)
+        with pytest.raises(LeaseStateError):
+            led.open("serve_to_train", 1)
+
+    def test_transfer_markers_roundtrip(self):
+        led = LeaseLedger(MemoryBackend())
+        led.mark_transfer("localhost:2", "lease-1")
+        assert led.transfer_of("localhost:2") == "lease-1"
+        led.clear_transfer("localhost:2")
+        assert led.transfer_of("localhost:2") is None
+
+    def test_split_roundtrip_with_leased_count(self):
+        led = LeaseLedger(MemoryBackend())
+        assert led.split() is None
+        led.set_split(3, 2, leased=1)
+        assert led.split() == {"train": 3, "serve": 2, "leased": 1}
+
+
+# --------------------------------------------------------------------------
+# policy
+# --------------------------------------------------------------------------
+
+class TestFleetPolicy:
+    def test_depth_pressure_fires_after_window(self):
+        pol = make_policy()
+        split = {"train": 4, "serve": 1}
+        assert pol.decide(split, HOT, 0, now=1.0) is None
+        d = pol.decide(split, HOT, 0, now=2.0)
+        assert d == Decision("train_to_serve", 1, d.reason)
+        assert "pressure" in d.reason
+
+    def test_p99_breach_fires_with_shallow_queue(self):
+        pol = make_policy()
+        split = {"train": 4, "serve": 1}
+        pol.decide(split, SLOW_CALM_QUEUE, 0, now=1.0)
+        d = pol.decide(split, SLOW_CALM_QUEUE, 0, now=2.0)
+        assert d is not None and d.direction == "train_to_serve"
+        assert "SLO" in d.reason
+
+    def test_slo_off_means_depth_only(self):
+        pol = make_policy(slo_p99=0)
+        split = {"train": 4, "serve": 1}
+        for t in range(5):
+            assert pol.decide(split, SLOW_CALM_QUEUE, 0,
+                              now=float(t)) is None
+
+    def test_training_idle_skips_the_window(self):
+        pol = make_policy(window=3)
+        split = {"train": 4, "serve": 1}
+        d = pol.decide(split, HOT, 0, now=1.0, train_idle=True)
+        assert d is not None and "idle" in d.reason
+
+    def test_min_train_floor_blocks_surge(self):
+        pol = make_policy(min_train_slots=4)
+        split = {"train": 4, "serve": 1}
+        for t in range(5):
+            assert pol.decide(split, HOT, 0, now=float(t)) is None
+
+    def test_cooldown_spaces_transfers(self):
+        pol = make_policy(cooldown_s=100.0)
+        split = {"train": 4, "serve": 1}
+        pol.decide(split, HOT, 0, now=1.0)
+        assert pol.decide(split, HOT, 0, now=2.0) is not None
+        pol.note_transfer(2.0)
+        for t in range(3, 8):
+            assert pol.decide(split, HOT, 0, now=float(t)) is None
+
+    def test_ebb_needs_calm_and_leased_slots(self):
+        pol = make_policy(ebb_idle_s=5.0)
+        split = {"train": 3, "serve": 2}
+        # no leased slots out -> never ebb
+        for t in range(10):
+            assert pol.decide(split, COLD, 0, now=float(t)) is None
+        pol2 = make_policy(ebb_idle_s=5.0)
+        decisions = [pol2.decide(split, COLD, 1, now=float(t))
+                     for t in range(10)]
+        fired = [d for d in decisions if d is not None]
+        assert fired and fired[0].direction == "serve_to_train"
+
+    def test_ebb_respects_serve_floor(self):
+        pol = make_policy(ebb_idle_s=1.0, min_serve_slots=2)
+        split = {"train": 3, "serve": 2}
+        for t in range(10):
+            assert pol.decide(split, COLD, 1, now=float(t)) is None
+
+
+# --------------------------------------------------------------------------
+# arbiter control loop (fake actuators)
+# --------------------------------------------------------------------------
+
+class TestArbiterLoop:
+    def _drive(self, arb, stats, ticks, t0=1000.0):
+        arb.stats_fn = lambda: stats
+        leases = []
+        now = t0
+        for _ in range(ticks):
+            lease = arb.tick(now)
+            if lease is not None:
+                leases.append(lease)
+            now += 1.0
+        return leases, now
+
+    def test_surge_takes_one_slot_from_training(self):
+        arb, act, led = make_arbiter()
+        leases, _ = self._drive(arb, HOT, 8)
+        assert any(l["state"] == "complete" for l in leases)
+        assert arb.split == {"train": 3, "serve": 2, "leased": 1}
+        assert ("train", 3) in act.calls and ("serve", 2) in act.calls
+        # actuation order: training shrink strictly before serving grow
+        assert act.calls.index(("train", 3)) \
+            < act.calls.index(("serve", 2))
+        # durable: the split survives in the backend
+        assert led.split() == arb.split
+
+    def test_ebb_returns_the_leased_slot_drain_first(self):
+        arb, act, led = make_arbiter()
+        self._drive(arb, HOT, 8)
+        assert arb.split["leased"] == 1
+        leases, _ = self._drive(arb, COLD, 12, t0=2000.0)
+        assert any(l["state"] == "complete"
+                   and l["direction"] == "serve_to_train"
+                   for l in leases)
+        assert arb.split == {"train": 4, "serve": 1, "leased": 0}
+        drains = [c for c in act.calls if c[0] == "drain"]
+        assert drains, act.calls
+        # drain precedes the serving shrink
+        assert act.calls.index(drains[0]) \
+            < act.calls.index(("serve", 1))
+
+    def test_transfer_markers_written_before_shrink(self):
+        marks = []
+        arb, act, led = make_arbiter()
+        orig_mark, orig_set = led.mark_transfer, act.set_train_slots
+        led.mark_transfer = lambda w, i: (marks.append(("mark", w)),
+                                          orig_mark(w, i))
+        act.set_train_slots = lambda n: (marks.append(("shrink", n)),
+                                         orig_set(n))
+        self._drive(arb, HOT, 3)
+        kinds = [k for k, _ in marks]
+        assert kinds.index("mark") < kinds.index("shrink")
+
+    def test_completed_lease_clears_markers_and_active(self):
+        arb, act, led = make_arbiter()
+        self._drive(arb, HOT, 8)
+        assert led.active() is None
+        assert led.transfer_of("h:3") is None
+
+
+# --------------------------------------------------------------------------
+# chaos: the new injection points parse and fire
+# --------------------------------------------------------------------------
+
+class TestFleetChaosPoints:
+    def test_transfer_and_drain_points_parse(self):
+        rules = chaos_spec.parse_spec(
+            "transfer:fail:name=preempting:kind=train_to_serve:once;"
+            "drain:delay:ms=10")
+        assert [r.point for r in rules] == ["transfer", "drain"]
+
+    def test_unknown_point_still_rejected(self):
+        with pytest.raises(chaos_spec.ChaosSpecError):
+            chaos_spec.parse_spec("fleet:fail")
+
+    def test_transfer_fail_interrupts_after_ledger_write(self,
+                                                         monkeypatch):
+        """A chaos fault at the transfer point fires AFTER the ledger
+        write — the crash window the resume rules exist for: the
+        ledger says 'preempting', no actuation ran."""
+        monkeypatch.setenv("HVDTPU_CHAOS",
+                           "transfer:fail:name=preempting:once")
+        chaos.reset()
+        try:
+            arb, act, led = make_arbiter()
+            arb.stats_fn = lambda: HOT
+            arb.tick(1000.0)
+            with pytest.raises(Exception):
+                arb.tick(1001.0)
+            lease = led.active()
+            assert lease["state"] == "preempting"
+            assert ("train", 3) not in act.calls  # actuation never ran
+        finally:
+            monkeypatch.delenv("HVDTPU_CHAOS")
+            chaos.reset()
+
+
+# --------------------------------------------------------------------------
+# durable recovery: the journal round-trip (promotion mid-transfer)
+# --------------------------------------------------------------------------
+
+def _kv_server(term):
+    server = KVStoreServer(job_token="t", addr="localhost")
+    server.set_term(term)
+    server.start()
+    return server
+
+
+def _journaled_arbiter(tmp_path, term=1):
+    server = _kv_server(term)
+    journal = journal_mod.DriverJournal(str(tmp_path / "journal"),
+                                        term=term)
+    backend = ledger_mod.DriverBackend(server, journal=journal,
+                                       term_fn=lambda: term)
+    ledger = LeaseLedger(backend)
+    act = FakeActuators()
+    arb = FleetArbiter(ledger, act, FakeProbes(act),
+                       policy=make_policy(), train_slots=4,
+                       serve_slots=1, drain_timeout=30.0)
+    return arb, act, journal, server
+
+
+def _promote(tmp_path, term=2):
+    """Replay the dead primary's journal into a fresh server — the
+    StandbyController promotion data path (fleet scope is durable, so
+    the lease ledger arrives with it)."""
+    state, seq, _snap = journal_mod.read_dir(str(tmp_path / "journal"))
+    server = _kv_server(term)
+    server.load_state(state["kv"])
+    backend = ledger_mod.DriverBackend(server, journal=None,
+                                       term_fn=lambda: term)
+    return LeaseLedger(backend), state, server
+
+
+class TestJournaledRecovery:
+    def test_fleet_scope_is_durable(self):
+        assert journal_mod.durable_key("fleet", "lease.x")
+        assert journal_mod.durable_key("fleet", "split")
+
+    def test_promotion_mid_transfer_rolls_forward(self, tmp_path):
+        arb, act, journal, server = _journaled_arbiter(tmp_path)
+        try:
+            arb.stats_fn = lambda: HOT
+            arb.tick(1000.0)
+            arb.tick(1001.0)  # proposed -> preempting (+ actuation)
+        finally:
+            journal.close()
+            server.stop()
+        # -- promotion: replay journal, rebuild arbiter -------------------
+        ledger2, state, server2 = _promote(tmp_path)
+        try:
+            lease = ledger2.active()
+            assert lease is not None
+            assert lease["state"] == "preempting"
+            act2 = FakeActuators(train=4, serve=1)
+            arb2 = FleetArbiter(ledger2, act2, FakeProbes(act2),
+                                policy=make_policy(window=100),
+                                drain_timeout=30.0)
+            assert arb2.resume() == "roll_forward"
+            # the re-issued actuation is the same desired-state write
+            assert ("train", 3) in act2.calls
+            now = 2000.0
+            arb2.stats_fn = lambda: HOT
+            for _ in range(6):
+                arb2.tick(now)
+                now += 1.0
+            assert ledger2.active() is None
+            final = ledger2.get(lease["id"])
+            assert final["state"] == "complete"
+            assert arb2.split == {"train": 3, "serve": 2, "leased": 1}
+        finally:
+            server2.stop()
+
+    def test_lease_left_at_proposed_rolls_back(self, tmp_path):
+        server = _kv_server(term=1)
+        journal = journal_mod.DriverJournal(str(tmp_path / "journal"),
+                                            term=1)
+        try:
+            backend = ledger_mod.DriverBackend(server, journal=journal,
+                                               term_fn=lambda: 1)
+            ledger = LeaseLedger(backend)
+            ledger.set_split(4, 1, leased=0)
+            ledger.open("train_to_serve", 1, now=1000.0)  # crash here
+        finally:
+            journal.close()
+            server.stop()
+        ledger2, _state, server2 = _promote(tmp_path)
+        try:
+            lease = ledger2.active()
+            assert lease["state"] == "proposed"
+            act2 = FakeActuators()
+            arb2 = FleetArbiter(ledger2, act2, FakeProbes(act2),
+                                policy=make_policy(),
+                                drain_timeout=30.0)
+            assert arb2.resume() == "rollback"
+            assert ledger2.active() is None
+            rolled = ledger2.get(lease["id"])
+            assert rolled["state"] == "rolled_back"
+            assert act2.calls == []  # rollback actuates nothing
+            assert arb2.split == {"train": 4, "serve": 1, "leased": 0}
+        finally:
+            server2.stop()
+
+    def test_stale_term_is_fenced(self):
+        """A resurrected pre-promotion arbiter (old term) must not be
+        able to mutate the ledger once a newer primary has taken
+        over."""
+        from horovod_tpu.runner.journal import StaleTermError
+        server = _kv_server(term=1)
+        try:
+            backend = ledger_mod.DriverBackend(server, journal=None,
+                                               term_fn=lambda: 1)
+            ledger = LeaseLedger(backend)
+            ledger.set_split(4, 1)
+            server.set_term(2)  # a newer primary took over
+            with pytest.raises(StaleTermError):
+                ledger.open("train_to_serve", 1)
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------------------------------
+# ledger JSON shape (the documented format)
+# --------------------------------------------------------------------------
+
+def test_lease_record_format_matches_docs(tmp_path):
+    led = LeaseLedger(MemoryBackend())
+    lease = led.open("train_to_serve", 1, now=42.0)
+    raw = led.backend.get(ledger_mod.LEASE_PREFIX + lease["id"])
+    record = json.loads(raw)
+    assert set(record) == {"id", "direction", "slots", "state",
+                           "wids", "created", "updated"}
+    assert record["state"] == "proposed"
+    assert record["direction"] == "train_to_serve"
+
+
+def test_cli_knobs_and_status_render(capsys):
+    from horovod_tpu.fleet import cli
+    assert cli.main(["knobs"]) == 0
+    out = capsys.readouterr().out
+    assert "window" in out and "cooldown_s" in out
+
+
+# --------------------------------------------------------------------------
+# driver cause accounting: arbiter preemption is never a failure
+# --------------------------------------------------------------------------
+
+def test_arbiter_preemption_counted_as_transfer_not_failure(monkeypatch):
+    from horovod_tpu.exceptions import PREEMPT_EXIT_CODE
+    from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                                   ElasticSettings)
+    from horovod_tpu.runner.job import Settings
+    from test_elastic import _fake_spawn
+
+    es = ElasticSettings(Settings(num_proc=2), min_np=1)
+    driver = ElasticDriver(es, ["true"])
+    try:
+        monkeypatch.setattr(driver, "_spawn", _fake_spawn(driver))
+        driver._reconcile(driver._discover_targets())
+        # The arbiter marks its victim in the durable fleet scope
+        # BEFORE shrinking the target (ledger-before-actuation), so
+        # when the exit-83 sweep runs the marker is already there.
+        driver.server.put(ledger_mod.SCOPE,
+                          ledger_mod.TRANSFER_PREFIX + "localhost:1",
+                          "lease-test")
+        driver.workers["localhost:1"].proc.poll = \
+            lambda: PREEMPT_EXIT_CODE
+        assert driver._sweep_exits()  # a membership change...
+        assert driver.preempt_causes["arbiter_transfer"] == 1
+        assert driver.preempt_causes["preempt"] == 0
+        assert driver.fail_counts == {}  # ...never a failure
+        assert driver.blacklist == set()
+        # The marker is consumed so a LATER unrelated preemption of a
+        # respawn in the same slot is not misattributed.
+        assert driver.server.get(
+            ledger_mod.SCOPE,
+            ledger_mod.TRANSFER_PREFIX + "localhost:1") is None
+        # A plain cloud preemption (no marker) keeps its own cause.
+        driver.workers["localhost:0"].proc.poll = \
+            lambda: PREEMPT_EXIT_CODE
+        assert driver._sweep_exits()
+        assert driver.preempt_causes == {"preempt": 1,
+                                         "arbiter_transfer": 1}
+        assert driver.fail_counts == {}
+    finally:
+        driver.server.stop()

@@ -1,0 +1,490 @@
+"""Fleet matrix: real two-plane jobs under traffic-driven arbitration.
+
+The acceptance rows for the chip-budget arbiter
+(docs/fault_tolerance.md "Fleet arbitration"), each one a genuine
+multi-process elastic training job (driver + spawned fleet_worker.py
+processes, discovery read from the arbiter's target file) sharing one
+control plane with an in-process serving cohort:
+
+- (A) the headline spike row: a traffic spike mid-training breaches
+  the serving SLO, the arbiter leases one training slot to serving
+  (graceful exit-83 preemption at a commit boundary, reshard, serving
+  scale-out), and BOTH planes come out whole — the training per-step
+  loss trajectory is bit-exact against an uninterrupted reference run
+  at equal step counts (zero lost steps), and every accepted serving
+  request completes (zero accepted-request loss, p99 recovers);
+- (B) arbiter-initiated preemption is accounted as a membership
+  change (cause=arbiter_transfer), never a failure/blacklist entry,
+  on a real SIGTERM mid-training — the process-level half of the
+  exit-code regression in test_fleet.py;
+- (C) a worker SIGKILLed while the surge lease is mid-flight recovers
+  through the NORMAL elastic path (failure count, respawn) with the
+  lease intact — the transfer still completes and training still
+  finishes every step.
+
+Cohort sizes here are powers of two (2 -> 1) on purpose: averaging
+identical per-rank gradients is bit-exact at those sizes, so the
+trajectory comparison needs no tolerance — any lost or replayed-from-
+stale-state step is a hard inequality.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.fleet import ledger as ledger_mod
+from horovod_tpu.fleet.actuators import DriverProbes, TargetFileActuators
+from horovod_tpu.fleet.arbiter import FleetArbiter
+from horovod_tpu.fleet.ledger import LeaseLedger
+from horovod_tpu.fleet.policy import FleetPolicy
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                               ElasticSettings)
+from horovod_tpu.runner.job import Settings
+from horovod_tpu.serving import autoscale as sautoscale
+from horovod_tpu.serving.model import ToyLM
+from horovod_tpu.serving.router import InProcClient, Router
+from horovod_tpu.serving.worker import ServingWorker
+from test_elastic import _worker_env
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FLEET_WORKER = os.path.join(HERE, "fleet_worker.py")
+
+#: padded decode step (CPU stand-in for a real model's step time).
+DECODE_DELAY_S = 0.02
+
+
+class PacedToyLM(ToyLM):
+    def decode(self, contexts):
+        time.sleep(DECODE_DELAY_S)
+        return super().decode(contexts)
+
+
+def _parse_steps(log_path):
+    """[(wid, step, rank, size, loss_str)] — losses kept as strings so
+    equality is bitwise, not tolerance-based."""
+    entries = []
+    if not os.path.exists(log_path):
+        return entries
+    for line in open(log_path):
+        m = re.match(r"(\S+) step=(\d+) rank=(\d+) size=(\d+) "
+                     r"loss=(\S+)", line)
+        if m:
+            entries.append((m.group(1), int(m.group(2)),
+                            int(m.group(3)), int(m.group(4)),
+                            m.group(5)))
+    return entries
+
+
+def _trajectory(entries):
+    """step -> set of distinct loss strings logged for that step."""
+    traj = {}
+    for _wid, step, _rank, _size, loss in entries:
+        traj.setdefault(step, set()).add(loss)
+    return traj
+
+
+def _reference_trajectory(tmp_path, steps):
+    """Uninterrupted single-worker run of the same worker program —
+    the oracle the interrupted run must match step for step."""
+    target = tmp_path / "ref_targets"
+    sautoscale.write_target(str(target), ["localhost:1"])
+    script = tmp_path / "ref_discover.sh"
+    script.write_text(
+        "\n".join(sautoscale.discovery_script_lines(str(target)))
+        + "\n")
+    script.chmod(0o755)
+    log_path = tmp_path / "ref_log"
+    es = ElasticSettings(
+        Settings(num_proc=1, start_timeout=60,
+                 env=_worker_env(log_path, FLEET_TEST_STEPS=steps,
+                                 FLEET_TEST_STEP_SLEEP=0.01)),
+        discovery_script=str(script), min_np=1, max_np=8,
+        discovery_interval=0.2)
+    driver = ElasticDriver(es, [sys.executable, FLEET_WORKER])
+    rc = driver.run()
+    assert rc == 0, open(log_path).read() if log_path.exists() \
+        else "no ref log"
+    traj = _trajectory(_parse_steps(log_path))
+    assert sorted(traj) == list(range(steps))
+    return {step: losses.pop() for step, losses in traj.items()}
+
+
+class _ServePlane:
+    """The serving half of the fleet: in-process workers registered in
+    the TRAINING driver's KV store (one control plane for both
+    cohorts), a router over them, and the slot actuation the arbiter
+    drives — starting a worker on scale-out, stopping drained victims
+    on scale-in."""
+
+    def __init__(self, driver, cohort="serve"):
+        self.driver = driver
+        self.cohort = cohort
+        self.kv = ("127.0.0.1", driver.port, driver.token)
+        self.workers = {}
+        self.router = Router(members={cohort: []})
+        self.lock = threading.Lock()
+
+    def set_slots(self, n):
+        with self.lock:
+            for wid in range(n):
+                if wid not in self.workers:
+                    w = ServingWorker(PacedToyLM(), cohort=self.cohort,
+                                      wid=wid, num_pages=24,
+                                      page_size=2, queue_limit=32,
+                                      max_batch_tokens=64).start()
+                    w.register(*self.kv,
+                               advertise=f"inproc-{self.cohort}.{wid}")
+                    self.workers[wid] = w
+            for wid in [w for w in self.workers if w >= n]:
+                w = self.workers.pop(wid)
+                w.stop()
+                self.driver.server.delete(
+                    "serving", f"member.{self.cohort}.{wid}")
+                self.driver.server.delete(
+                    "serving", f"stats.{self.cohort}.{wid}")
+            self.router.members[self.cohort] = [
+                InProcClient(w) for w in self.workers.values()]
+
+    def stop(self):
+        with self.lock:
+            for w in self.workers.values():
+                w.stop()
+            self.workers.clear()
+
+
+class _Actuators(TargetFileActuators):
+    """Stock target-file actuation for the training plane; in-process
+    worker lifecycle for the serving plane (the test IS the serving
+    launcher here)."""
+
+    def __init__(self, train_target, plane, **kw):
+        super().__init__(train_target, train_target + ".serve",
+                         serve_cohort=plane.cohort, **kw)
+        self.plane = plane
+
+    def set_serve_slots(self, slots):
+        super().set_serve_slots(slots)  # keep the desired-state file
+        self.plane.set_slots(slots)
+
+
+def _spike(router, record, n=24, max_new=8):
+    """A burst of concurrent requests; every outcome is recorded so
+    accepted-request loss is countable afterwards."""
+    oracle = ToyLM()
+    threads = []
+
+    def one(i):
+        prompt = [2, 3 + i % 5]
+        status, body = router.generate(
+            {"prompt": prompt, "max_new_tokens": max_new})
+        if status == 200:
+            ok = body["tokens"] == oracle.reference_completion(
+                prompt, max_new)
+            record.append(("ok" if ok else "corrupt",
+                           body.get("latency", 0.0)))
+        elif status in (429, 503):
+            record.append(("rejected", 0.0))
+        else:
+            record.append(("error", 0.0))
+
+    for i in range(n):
+        th = threading.Thread(target=one, args=(i,))
+        th.start()
+        threads.append(th)
+        time.sleep(0.01)
+    return threads
+
+
+def _fleet_job(tmp_path, steps=16, step_sleep=0.4, slo_p99=0.3,
+               window=2):
+    """Build the whole two-plane rig: training driver (2 slots, target
+    -file discovery), serving plane (1 worker), arbiter colocated with
+    the driver (DriverBackend against the driver's own KV store).
+    Returns (driver, plane, arbiter, log_path, train_target)."""
+    train_target = str(tmp_path / "train_targets")
+    sautoscale.write_target(train_target, ["localhost:2"])
+    script = tmp_path / "discover.sh"
+    script.write_text("\n".join(
+        sautoscale.discovery_script_lines(train_target)) + "\n")
+    script.chmod(0o755)
+    log_path = tmp_path / "log"
+    es = ElasticSettings(
+        Settings(num_proc=2, start_timeout=60,
+                 env=_worker_env(log_path, FLEET_TEST_STEPS=steps,
+                                 FLEET_TEST_STEP_SLEEP=step_sleep)),
+        discovery_script=str(script), min_np=1, max_np=8,
+        discovery_interval=0.2)
+    driver = ElasticDriver(es, [sys.executable, FLEET_WORKER])
+    plane = _ServePlane(driver)
+    plane.set_slots(1)
+    backend = ledger_mod.DriverBackend(driver.server,
+                                       term_fn=driver._wt)
+    act = _Actuators(train_target, plane,
+                     kv_put=lambda s, k, v: driver.server.put(
+                         s, k, v, term=driver._wt()))
+    arbiter = FleetArbiter(
+        LeaseLedger(backend), act, DriverProbes(driver),
+        policy=FleetPolicy(min_train_slots=1, min_serve_slots=1,
+                           window=window, cooldown_s=600.0,
+                           ebb_idle_s=600.0, scale_up_depth=6,
+                           slo_p99=slo_p99),
+        train_slots=2, serve_slots=1, drain_timeout=10.0)
+    return driver, plane, arbiter, log_path, train_target
+
+
+def _run_driver(driver):
+    box = {}
+
+    def run():
+        box["rc"] = driver.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _tick_until(arbiter, pred, deadline_s, tick_s=0.25):
+    deadline = time.monotonic() + deadline_s
+    lease = None
+    while time.monotonic() < deadline:
+        lease = arbiter.tick(time.time())
+        if pred(lease):
+            return lease
+        time.sleep(tick_s)
+    return lease
+
+
+def _assert_zero_request_loss(record):
+    outcomes = [kind for kind, _ in record]
+    assert "error" not in outcomes, outcomes
+    assert "corrupt" not in outcomes, outcomes
+    assert outcomes.count("ok") > 0, outcomes
+
+
+def test_traffic_spike_leases_training_slot_with_zero_lost_steps(
+        tmp_path):
+    """(A) The headline row. A traffic spike breaches the serving SLO
+    mid-training; the arbiter completes a train_to_serve lease; the
+    shrunk training cohort finishes every step with a loss trajectory
+    bit-exact to the uninterrupted reference; no accepted request is
+    lost; the preemption is accounted as an arbiter transfer, never a
+    failure."""
+    STEPS = 16
+    reference = _reference_trajectory(tmp_path, STEPS)
+    driver, plane, arbiter, log_path, _tt = _fleet_job(
+        tmp_path, steps=STEPS)
+    record = []
+    try:
+        thread, box = _run_driver(driver)
+        # Let training reach steady state, then spike the serving
+        # plane and run the arbiter until the lease completes.
+        time.sleep(1.5)
+        req_threads = _spike(plane.router, record)
+        t_spike = time.monotonic()
+        lease = _tick_until(
+            arbiter,
+            lambda l: l is not None and l["state"] == "complete",
+            deadline_s=45.0)
+        recovery_s = time.monotonic() - t_spike
+        assert lease is not None and lease["state"] == "complete", \
+            lease
+        assert lease["direction"] == "train_to_serve"
+        assert arbiter.split == {"train": 1, "serve": 2, "leased": 1}
+        # Serving really scaled out through the lease.
+        assert len(plane.workers) == 2
+        for th in req_threads:
+            th.join(timeout=60)
+        thread.join(timeout=120)
+        assert not thread.is_alive(), "training driver hung"
+        assert box["rc"] == 0, (open(log_path).read()
+                                if os.path.exists(log_path)
+                                else "no log")
+        # -- training plane: zero lost steps, bit-exact trajectory ----
+        entries = _parse_steps(log_path)
+        traj = _trajectory(entries)
+        assert sorted(traj) == list(range(STEPS)), sorted(traj)
+        for step in range(STEPS):
+            assert len(traj[step]) == 1, (
+                f"step {step} diverged across the reshard: "
+                f"{traj[step]}")
+            assert traj[step] == {reference[step]}, (
+                f"step {step}: {traj[step]} != ref "
+                f"{{{reference[step]}}}")
+        # The cohort really shrank mid-run (preemption + reshard).
+        sizes = {e[3] for e in entries}
+        assert sizes == {1, 2}, sizes
+        # -- accounting: a transfer, never a failure ------------------
+        assert driver.preempt_causes["arbiter_transfer"] >= 1, \
+            driver.preempt_causes
+        assert driver.fail_counts == {}, driver.fail_counts
+        assert driver.blacklist == set()
+        # -- serving plane: zero accepted-request loss ----------------
+        _assert_zero_request_loss(record)
+        assert recovery_s < 45.0
+    finally:
+        plane.stop()
+        driver.server.stop()
+
+
+def test_spike_p99_recovers_after_scale_out(tmp_path):
+    """(A') The latency half of the spike row: p99 of a wave sent
+    AFTER the lease completes is below the p99 of the spike wave that
+    triggered it — the freed chip restored serving headroom."""
+    STEPS = 16
+    driver, plane, arbiter, log_path, _tt = _fleet_job(
+        tmp_path, steps=STEPS)
+    spike_record, after_record = [], []
+    oracle = ToyLM()
+
+    def timed_wave(record, n):
+        def one(i):
+            t0 = time.monotonic()
+            status, body = plane.router.generate(
+                {"prompt": [2, 3 + i % 5], "max_new_tokens": 8})
+            if status == 200:
+                ok = body["tokens"] == oracle.reference_completion(
+                    [2, 3 + i % 5], 8)
+                record.append(("ok" if ok else "corrupt",
+                               time.monotonic() - t0))
+            else:
+                record.append(("rejected" if status in (429, 503)
+                               else "error", time.monotonic() - t0))
+        threads = []
+        for i in range(n):
+            th = threading.Thread(target=one, args=(i,))
+            th.start()
+            threads.append(th)
+            time.sleep(0.01)
+        for th in threads:
+            th.join(timeout=60)
+
+    try:
+        thread, box = _run_driver(driver)
+        time.sleep(1.5)
+        wave = threading.Thread(target=timed_wave,
+                                args=(spike_record, 24))
+        wave.start()
+        lease = _tick_until(
+            arbiter,
+            lambda l: l is not None and l["state"] == "complete",
+            deadline_s=45.0)
+        wave.join(timeout=90)
+        assert lease is not None and lease["state"] == "complete"
+        timed_wave(after_record, 12)
+        thread.join(timeout=120)
+        assert box["rc"] == 0
+
+        def p99(record):
+            lat = sorted(t for kind, t in record if kind == "ok")
+            assert lat, record
+            return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+        assert p99(after_record) < p99(spike_record), (
+            p99(after_record), p99(spike_record))
+        _assert_zero_request_loss(spike_record)
+        _assert_zero_request_loss(after_record)
+    finally:
+        plane.stop()
+        driver.server.stop()
+
+
+def test_marker_preemption_real_sigterm_counts_as_transfer(tmp_path):
+    """(B) Process-level exit-code regression: with the lease victim
+    marked in the durable fleet scope, shrinking the target makes the
+    driver SIGTERM a real worker mid-training (the signal can land
+    mid-commit — the handler defers to the commit boundary either
+    way); the exit-83 sweep must account it as cause=arbiter_transfer
+    and never as a failure."""
+    STEPS = 12
+    driver, plane, arbiter, log_path, train_target = _fleet_job(
+        tmp_path, steps=STEPS, step_sleep=0.3)
+    try:
+        thread, box = _run_driver(driver)
+        time.sleep(1.5)
+        # Ledger-before-actuation by hand: marker first...
+        driver.server.put(ledger_mod.SCOPE,
+                          ledger_mod.TRANSFER_PREFIX + "localhost:1",
+                          "lease-row-b", term=driver._wt())
+        # ...then the desired-state shrink the driver reconciles.
+        sautoscale.write_target(train_target, ["localhost:1"])
+        thread.join(timeout=120)
+        assert not thread.is_alive(), "training driver hung"
+        assert box["rc"] == 0, (open(log_path).read()
+                                if os.path.exists(log_path)
+                                else "no log")
+        assert driver.preempt_causes["arbiter_transfer"] == 1, \
+            driver.preempt_causes
+        assert driver.fail_counts == {}, driver.fail_counts
+        assert driver.blacklist == set()
+        traj = _trajectory(_parse_steps(log_path))
+        assert sorted(traj) == list(range(STEPS))
+        assert all(len(v) == 1 for v in traj.values()), traj
+    finally:
+        plane.stop()
+        driver.server.stop()
+
+
+def test_sigkill_mid_transfer_recovers_with_lease_intact(tmp_path):
+    """(C) HA row: the surviving training worker is SIGKILLed while
+    the surge lease is mid-flight. The kill takes the NORMAL elastic
+    failure path (fail count, respawn from the target file) and the
+    lease is untouched by it — the transfer completes and training
+    still finishes every step exactly once."""
+    STEPS = 18
+    driver, plane, arbiter, log_path, _tt = _fleet_job(
+        tmp_path, steps=STEPS)
+    record = []
+    try:
+        thread, box = _run_driver(driver)
+        time.sleep(1.5)
+        _spike(plane.router, record)
+        # Drive the lease into flight (past proposed), then kill the
+        # survivor — the slot the lease did NOT take.
+        lease = _tick_until(
+            arbiter,
+            lambda l: l is not None and l["state"] in (
+                "preempting", "resharding", "activating"),
+            deadline_s=30.0)
+        assert lease is not None, "lease never opened"
+        assert "localhost:1" in lease["wids"]  # victim = highest slot
+        survivor = driver.workers.get("localhost:0")
+        assert survivor is not None
+        survivor.proc.kill()
+        lease = _tick_until(
+            arbiter,
+            lambda l: l is not None and l["state"] == "complete",
+            deadline_s=60.0)
+        assert lease is not None and lease["state"] == "complete", \
+            lease
+        # The ledger finished the lease with the split settled (read
+        # BEFORE the driver exits and takes its KV store down).
+        assert arbiter.ledger.active() is None
+        assert arbiter.split == {"train": 1, "serve": 2, "leased": 1}
+        thread.join(timeout=120)
+        assert not thread.is_alive(), "training driver hung"
+        assert box["rc"] == 0, (open(log_path).read()
+                                if os.path.exists(log_path)
+                                else "no log")
+        # The kill was a genuine failure (normal elastic accounting)
+        # ...
+        assert driver.fail_counts.get("localhost") == 1, \
+            driver.fail_counts
+        assert driver.blacklist == set()
+        # ...the preemption stayed a transfer...
+        assert driver.preempt_causes["arbiter_transfer"] >= 1, \
+            driver.preempt_causes
+        # Training lost nothing: every step present, single loss each
+        # (the respawned worker restored the last commit, it did not
+        # rewind committed steps).
+        traj = _trajectory(_parse_steps(log_path))
+        assert sorted(traj) == list(range(STEPS)), sorted(traj)
+        assert all(len(v) == 1 for v in traj.values()), traj
+        _assert_zero_request_loss(record)
+    finally:
+        plane.stop()
+        driver.server.stop()

@@ -358,6 +358,53 @@ class TestAstRules:
         assert severity == WARNING
         assert "resharding" in title
 
+    # -- HVD212: hand-rolled worker lifecycle ------------------------------
+    def test_worker_lifecycle_fixture(self):
+        diags = self.lint("bad_worker_lifecycle.py")
+        assert rules_of(diags) == ["HVD212", "HVD212", "HVD212"]
+        assert [d.line for d in diags] == [14, 19, 23]
+
+    def test_direct_slotprocess_spawn_flagged(self):
+        src = ("from horovod_tpu.runner.spawn import SlotProcess\n"
+               "def launch(env):\n"
+               "    return SlotProcess(['python', 'w.py'], env=env)\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD212"]
+
+    def test_terminate_on_driver_workers_flagged(self):
+        src = ("import horovod_tpu\n"
+               "def stop(driver, wid):\n"
+               "    driver.workers[wid].proc.terminate()\n")
+        assert rules_of(ast_lint.lint_source(src)) == ["HVD212"]
+
+    def test_plain_subprocess_is_clean(self):
+        src = ("import subprocess\n"
+               "def run(cmd):\n"
+               "    p = subprocess.Popen(cmd)\n"
+               "    p.terminate()\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_lifecycle_owners_are_exempt(self):
+        # The driver and the fleet actuator ARE the legal mutation
+        # surface — the rule must stay silent inside them.
+        src = ("from horovod_tpu.runner.spawn import SlotProcess\n"
+               "def respawn(env):\n"
+               "    return SlotProcess(['python', 'w.py'], env=env)\n")
+        for owner in ("horovod_tpu/runner/elastic_driver.py",
+                      "horovod_tpu/fleet/actuators.py"):
+            assert ast_lint.lint_source(src, filename=owner) == []
+
+    def test_worker_lifecycle_suppressible(self):
+        src = ("from horovod_tpu.runner.spawn import SlotProcess\n"
+               "p = SlotProcess(['python', 'w.py'], env={})"
+               "  # hvd-lint: disable=HVD212\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_hvd212_in_catalog(self):
+        from horovod_tpu.analysis.diagnostics import RULES, WARNING
+        severity, title = RULES["HVD212"]
+        assert severity == WARNING
+        assert "spawn/terminate" in title
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"

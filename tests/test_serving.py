@@ -572,6 +572,60 @@ def test_autoscaler_elastic_target_file(tmp_path):
     assert out.stdout.splitlines() == ["localhost:2", "otherhost:2"]
 
 
+def test_autoscaler_p99_slo_breach_scales_up():
+    # Latency trigger: queues stay shallow (each request admitted as
+    # soon as it arrives) but every one takes longer than the SLO —
+    # the depth trigger never fires, the p99 trigger must.
+    ups = []
+    a = sautoscale.Autoscaler(lambda: ups.append(1), scale_up_depth=100,
+                              window=2, cooldown_s=100.0, slo_p99=0.5)
+    slow = {"c0": {"queue_depth": 1, "running": 1,
+                   "p99_latency": 1.8}}
+    a.observe(slow, now=0.0)
+    assert ups == []
+    a.observe(slow, now=1.0)
+    assert ups == [1]
+
+
+def test_autoscaler_slo_off_by_default():
+    ups = []
+    a = sautoscale.Autoscaler(lambda: ups.append(1), scale_up_depth=100,
+                              window=1, cooldown_s=0.0)
+    slow = {"c0": {"queue_depth": 0, "running": 0,
+                   "p99_latency": 99.0}}
+    for t in range(4):
+        a.observe(slow, now=float(t))
+    assert ups == []
+
+
+def test_scheduler_stats_report_p99_latency():
+    w = _worker().start()
+    try:
+        for _ in range(3):
+            status, _body = w.handle_generate(
+                {"prompt": [2, 7], "max_new_tokens": 3})
+            assert status == 200
+        stats = w.scheduler.stats()
+        assert stats["p99_latency"] > 0.0
+        # p99 over few samples is the max observed end-to-end latency
+        assert stats["p99_latency"] < 60.0
+    finally:
+        w.stop()
+
+
+def test_write_target_is_atomic(tmp_path):
+    # A reader must never observe a torn/empty file: the tmp file is
+    # fsynced then renamed over the target, so the only observable
+    # states are old-content and new-content.
+    target = tmp_path / "targets"
+    sautoscale.write_target(str(target), ["localhost:4"])
+    sautoscale.write_target(str(target), ["localhost:2"])
+    assert target.read_text() == "localhost:2\n"
+    leftovers = [p for p in os.listdir(tmp_path)
+                 if p != "targets"]
+    assert leftovers == []  # no tmp files left behind
+
+
 # ==========================================================================
 # Knobs + metrics contract
 # ==========================================================================
@@ -580,7 +634,7 @@ def test_serving_knobs_registered():
     for name in ("SERVING", "SERVING_MAX_BATCH_TOKENS",
                  "SERVING_KV_PAGE_SIZE", "SERVING_KV_PAGES",
                  "SERVING_QUEUE_LIMIT", "SERVING_SCALE_UP_DEPTH",
-                 "SERVING_DRAIN_TIMEOUT"):
+                 "SERVING_DRAIN_TIMEOUT", "SERVING_SLO_P99"):
         assert name in envparse.KNOBS, name
         assert getattr(envparse, name) == name
 
