@@ -844,6 +844,129 @@ def _bench_serving(hvd, on_tpu):
     return rows, summary
 
 
+def _bench_migration(hvd, on_tpu):
+    """`--serving` companion lane (ISSUE 19; docs/serving.md "Live
+    migration"): migrate-vs-recompute A/B at long contexts. Two
+    identical 2-worker rigs; 8 long streams (32-token prompt, 48 new
+    tokens) are posted straight at worker 0, interrupted mid-decode by
+    a drain. The MIGRATE arm hands its live KV pages to the peer
+    (verified page transfer, zero re-prefill); the RECOMPUTE arm
+    (``migrate=False``) must finish every stream locally before the
+    chip comes free. Measured per arm: chip-release latency (drain ->
+    worker-0 idle — the number fleet arbitration waits on) and
+    drain-completion time (drain -> every client has its tokens),
+    plus the re-prefill count, which the migrate arm must hold at 0.
+    Archived to BENCH_r15.json."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    from horovod_tpu.runner.http_server import (AUTH_HEADER,
+                                                KVStoreServer,
+                                                new_job_token)
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.worker import ServingWorker
+
+    DECODE_DELAY_S = 0.01
+    STREAMS = 8
+    PROMPT_TOKENS = 32
+    NEW_TOKENS = 48
+    INTERRUPT_S = 0.2
+
+    class PacedToyLM(ToyLM):
+        def decode(self, contexts):
+            time.sleep(DECODE_DELAY_S)
+            return super().decode(contexts)
+
+    oracle = ToyLM()
+
+    def one_arm(migrate):
+        token = new_job_token()
+        kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+        kv_port = kv.start()
+        workers, ports = [], []
+        try:
+            for wid in range(2):
+                w = ServingWorker(PacedToyLM(), cohort="c0", wid=wid,
+                                  migrate=migrate).start()
+                port = w.serve_http(addr="127.0.0.1", token=token)
+                w.register("127.0.0.1", kv_port, token,
+                           advertise=f"127.0.0.1:{port}")
+                workers.append(w)
+                ports.append(port)
+
+            def one_request(i, record):
+                prompt = [(i % 7) + 1] * PROMPT_TOKENS
+                body = _json.dumps(
+                    {"prompt": prompt,
+                     "max_new_tokens": NEW_TOKENS}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{ports[0]}/v1/generate",
+                    data=body, method="POST")
+                req.add_header(AUTH_HEADER, token)
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    out = _json.loads(resp.read())
+                record[i] = (out["tokens"] ==
+                             oracle.reference_completion(
+                                 prompt, NEW_TOKENS))
+
+            record = [None] * STREAMS
+            threads = []
+            for i in range(STREAMS):
+                th = threading.Thread(target=one_request,
+                                      args=(i, record))
+                th.start()
+                threads.append(th)
+            time.sleep(INTERRUPT_S)  # streams provably mid-decode
+            t0 = time.monotonic()
+            drain = urllib.request.Request(
+                f"http://127.0.0.1:{ports[0]}/v1/serving/drain",
+                data=b"{}", method="POST")
+            drain.add_header(AUTH_HEADER, token)
+            urllib.request.urlopen(drain, timeout=10).read()
+            while not workers[0].scheduler.idle():
+                time.sleep(0.002)
+            chip_release_s = time.monotonic() - t0
+            for th in threads:
+                th.join(timeout=120)
+            completion_s = time.monotonic() - t0
+            s0 = workers[0].scheduler.stats()
+            s1 = workers[1].scheduler.stats()
+            return {
+                "benchmark": "serving_migration_ab",
+                "arm": "migrate" if migrate else "recompute",
+                "streams": STREAMS,
+                "prompt_tokens": PROMPT_TOKENS,
+                "new_tokens": NEW_TOKENS,
+                "decode_step_delay_s": DECODE_DELAY_S,
+                "chip_release_s": round(chip_release_s, 4),
+                "drain_completion_s": round(completion_s, 4),
+                "migrated_out": s0["migrated_out"],
+                "migrate_failed": s0["migrate_failed"],
+                "migrated_in_peer": s1["migrated_in"],
+                "re_prefills": s0["preemptions"] + s1["preemptions"],
+                "token_exact": all(record),
+            }
+        finally:
+            for w in workers:
+                w.stop()
+            kv.stop()
+
+    rows = [one_arm(migrate=True), one_arm(migrate=False)]
+    mig, rec = rows
+    summary = {
+        "chip_release_speedup": round(
+            rec["chip_release_s"] / max(mig["chip_release_s"], 1e-9),
+            2),
+        "zero_re_prefill_on_migrate": (mig["migrated_out"] >= 1
+                                       and mig["re_prefills"] == 0),
+        "token_exact_both_arms": (mig["token_exact"]
+                                  and rec["token_exact"]),
+    }
+    return rows, summary
+
+
 def _bench_fleet(hvd, on_tpu):
     """`--fleet` lane (docs/fault_tolerance.md "Fleet arbitration"):
     replay a scripted traffic-spike profile against the two-plane rig
@@ -1842,6 +1965,31 @@ def main():
             raise
         except Exception as e:  # noqa: BLE001 — best-effort lane
             print(f"# bench: serving lane failed: {e!r}",
+                  file=sys.stderr, flush=True)
+        # Companion A/B: live migration vs recompute at long contexts
+        # (ISSUE 19, docs/serving.md "Live migration"). Chip-release
+        # and drain-completion latency per arm, archived separately so
+        # BENCH_r11.json keeps its stable load-sweep schema.
+        try:
+            rows, summary = _bench_migration(hvd, on_tpu)
+            for row in rows:
+                print(json.dumps(row), flush=True)
+            with open("BENCH_r15.json", "w") as f:
+                json.dump({"cmd": "python bench.py --serving",
+                           "rows": rows, "summary": summary}, f,
+                          indent=1)
+            print("# bench: migrate-vs-recompute A/B archived to "
+                  "BENCH_r15.json", file=sys.stderr, flush=True)
+            assert summary["token_exact_both_arms"], (
+                "migration A/B diverged from the oracle tokens "
+                "(BENCH_r15.json has both arms)")
+            assert summary["zero_re_prefill_on_migrate"], (
+                "migrate arm re-prefilled or never migrated — drain "
+                "fell back to recompute (BENCH_r15.json)")
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — best-effort lane
+            print(f"# bench: migration A/B failed: {e!r}",
                   file=sys.stderr, flush=True)
     # --fleet: scripted traffic-spike replay through the chip-budget
     # arbiter (training sim + real serving stack under one slot

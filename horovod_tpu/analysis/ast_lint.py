@@ -62,6 +62,16 @@ a static finding. Three rules:
   elastic drivers reconcile (target files, drain flags, the fleet
   lease ledger); a bypass mutates membership with no journal entry,
   no lease, and no blacklist accounting.
+- **HVD213** (warning) — silent degradation in serving/fleet context
+  (a file under ``serving/`` or ``fleet/``, a class named
+  router/scheduler/worker/arbiter/migration, or a ``handle_*``
+  handler): an ``except`` clause catching a transport error
+  (``OSError`` and kin, ``URLError``, ``HTTPException``,
+  ``TimeoutError``, a ``*TRANSPORT*`` tuple) whose body neither
+  re-raises nor records it (no ``raise``, no log call, no metric
+  ``inc``/``observe``). The degradation contract is *loud* fallback
+  (docs/serving.md); a swallowed transport fault becomes unexplained
+  tail latency or quietly lost capacity.
 
 The HVD3xx block is the static half of ``hvd-sanitize`` (runtime half:
 analysis/sanitizer.py) — thread-safety and liveness hazards in the kind
@@ -1299,6 +1309,151 @@ class _WorkerLifecycleAnalyzer:
         return self.diags
 
 
+# ==========================================================================
+# HVD213: silently swallowed transport errors in serving/fleet code
+# ==========================================================================
+
+#: Exception names that read as transport/IO failures. Matched on the
+#: bare name or the last attribute hop (``urllib.error.URLError``,
+#: ``http.client.HTTPException``, ``socket.timeout``).
+_TRANSPORT_EXC_NAMES = frozenset({
+    "OSError", "IOError", "EnvironmentError", "ConnectionError",
+    "ConnectionResetError", "ConnectionRefusedError",
+    "ConnectionAbortedError", "BrokenPipeError", "TimeoutError",
+    "InterruptedError", "URLError", "HTTPException",
+    "timeout", "gaierror", "herror",
+})
+# HTTPError is deliberately absent: it means the peer ANSWERED (with
+# an error status) — a protocol outcome the handler usually translates
+# into a status-code return, not a vanished transport failure.
+
+#: Name patterns like ``_TRANSPORT_ERRORS`` — a tuple constant of
+#: transport exception types bound to a module-level name.
+_TRANSPORT_NAME_RE = re.compile(r"transport|network", re.IGNORECASE)
+
+#: Attribute calls inside a handler that count as "the failure was
+#: observed": a log record or a metric update.
+_OBSERVE_ATTRS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log", "inc", "observe", "set",
+})
+
+
+class _SilentDegradationAnalyzer:
+    """HVD213 over one module: in serving/fleet context — a file under
+    ``serving/`` or ``fleet/``, a class whose name says
+    router/scheduler/worker/arbiter/migration, or a ``handle_*``
+    request handler — flag an ``except`` clause that catches a
+    transport error (``OSError`` and kin, ``URLError``,
+    ``HTTPException``, ``TimeoutError``, a ``*TRANSPORT*`` tuple) and
+    neither re-raises nor records it (no ``raise``, no log call, no
+    metric ``inc``/``observe``). The serving plane's degradation
+    contract (docs/serving.md "Live migration") is *loud* fallback:
+    every skipped peer, failed migration, or dead-marked worker leaves
+    a log line or a counter bump; a silent swallow turns a transport
+    fault into unexplained tail latency or quietly lost capacity."""
+
+    _CTX_CLASS_RE = re.compile(
+        r"serving|router|scheduler|arbiter|fleet|worker|migrat",
+        re.IGNORECASE)
+    _CTX_FUNC_RE = re.compile(r"^handle_", re.IGNORECASE)
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        parts = os.path.normpath(filename).split(os.sep)
+        self._ctx_file = "serving" in parts or "fleet" in parts
+
+    @classmethod
+    def _transport_type(cls, node):
+        """The transport-ish spelling in an except type expr, or None.
+
+        Handles bare names, dotted names (last hop decides), and
+        tuples (any transport element taints the whole clause — the
+        handler body is shared)."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Tuple):
+            for elt in node.elts:
+                hit = cls._transport_type(elt)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in _TRANSPORT_EXC_NAMES \
+                    or _TRANSPORT_NAME_RE.search(node.id):
+                return node.id
+            return None
+        if isinstance(node, ast.Attribute):
+            if node.attr in _TRANSPORT_EXC_NAMES:
+                return _unparse(node)
+        return None
+
+    @staticmethod
+    def _handler_observes(handler):
+        """True when the handler body re-raises or records the error:
+        any ``raise``, or any call whose attribute name is a log/metric
+        verb (``log.warning``, ``counter.inc``, ...)."""
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Raise):
+                return True
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _OBSERVE_ATTRS:
+                return True
+            # A CLI front-end printing the failure (stderr) is loud.
+            if isinstance(sub.func, ast.Name) and sub.func.id == "print":
+                return True
+        return False
+
+    def _report(self, handler, spelled):
+        self.diags.append(Diagnostic.make(
+            "HVD213",
+            f"`except {spelled}` in serving/fleet code swallows a "
+            "transport error without a log, metric, or re-raise: the "
+            "failure disappears — degraded capacity and skipped peers "
+            "become unexplained tail latency with no audit trail",
+            file=self.filename, line=handler.lineno,
+            hint="record the fallback before taking it — a "
+                 "`log.warning(...)` naming what failed and what "
+                 "happens instead, or a counter bump "
+                 "(hvd_serving_migrations_total{outcome}), or re-raise "
+                 "— see docs/serving.md \"Live migration\" fallback "
+                 "ladder; suppress with `# hvd-lint: disable=HVD213` "
+                 "only where the caller records the degradation; "
+                 + _DOC_HINT))
+
+    def run(self, tree):
+        self._walk(tree.body, self._ctx_file)
+        return self.diags
+
+    def _walk(self, stmts, ctx):
+        for node in stmts:
+            node_ctx = ctx
+            if isinstance(node, ast.ClassDef):
+                node_ctx = ctx or bool(
+                    self._CTX_CLASS_RE.search(node.name))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                node_ctx = ctx or bool(
+                    self._CTX_FUNC_RE.search(node.name))
+            if node_ctx and isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    spelled = self._transport_type(handler.type)
+                    if spelled and not self._handler_observes(handler):
+                        self._report(handler, spelled)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                children = getattr(node, field, None)
+                if not children:
+                    continue
+                if field == "handlers":
+                    for h in children:
+                        self._walk(h.body, node_ctx)
+                else:
+                    self._walk(children, node_ctx)
+
+
 class _HandRollResharding:
     """HVD211 over one module: a ``device_get(...)`` result that flows
     — through any chain of reshape / ravel / asarray / concatenate /
@@ -1857,6 +2012,7 @@ def _lint_tree(src, tree, filename):
     diags.extend(_RawTimingAnalyzer(filename).run(tree))
     diags.extend(_RequestBufferAnalyzer(filename).run(tree))
     diags.extend(_WorkerLifecycleAnalyzer(filename).run(tree))
+    diags.extend(_SilentDegradationAnalyzer(filename).run(tree))
     diags.extend(_HandRollResharding(filename).run(tree))
     diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)

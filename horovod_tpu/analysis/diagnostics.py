@@ -69,6 +69,13 @@ RULES = {
                         "actuator modules — hand-rolled cohort "
                         "mutation bypasses the journal, the fleet "
                         "lease ledger, and blacklist accounting"),
+    "HVD213": (WARNING, "silent degradation: an except clause in "
+                        "serving/fleet code swallows a transport error "
+                        "(OSError, ConnectionError, URLError, "
+                        "HTTPException, TimeoutError, ...) without a "
+                        "log, metric, or re-raise — the failure "
+                        "disappears and the fallback ladder "
+                        "(docs/serving.md) loses its audit trail"),
     # -- interprocedural schedule verifier (hvd-lint verify) ---------------
     "HVD401": (ERROR, "collective reachable under rank-tainted control "
                       "flow through any call depth (the whole-program "

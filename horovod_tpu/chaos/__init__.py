@@ -183,7 +183,9 @@ class Plan:
 
 
 def _failure_for(rule, point):
-    if point.startswith("kv_") or point == "heartbeat":
+    # migrate_out failures are transport-shaped so the migration
+    # client's chunk-retry machinery (not a crash) absorbs them.
+    if point.startswith("kv_") or point in ("heartbeat", "migrate_out"):
         err = rule.err or "reset"
         if err == "refused":
             return urllib.error.URLError(ConnectionRefusedError(

@@ -57,6 +57,17 @@ POINTS = {
                 "arbitration)",
     "drain": "fleet/actuators.py — raising the serving drain flag "
              "during a serve->train ebb (matcher: name = cohort)",
+    "migrate_out": "serving/migration.py migrate_out() — each chunk "
+                   "POST attempt of a KV-cache live migration, per "
+                   "retry (matchers: key = request id, name = "
+                   "migration id; fail raises a retryable transport "
+                   "error, corrupt flips payload bytes AFTER the "
+                   "digest was computed so the target must refuse)",
+    "migrate_in": "serving/worker.py handle_migrate_in() — each "
+                  "received migrate chunk (matchers: key = migration "
+                  "id, name = cohort.wid; fail answers a retryable "
+                  "503, corrupt flips received payload bytes before "
+                  "digest verification)",
 }
 
 # action -> what firing does.
@@ -77,7 +88,9 @@ ACTIONS = {
              "the op, peers stall on it (stuck-collective watchdog "
              "territory)",
     "corrupt": "flip bytes inside the just-written checkpoint payload "
-               "so its checksum fails on restore",
+               "so its checksum fails on restore; at the migrate "
+               "points, flip KV page payload bytes so the sha256 "
+               "digest check refuses the transfer",
     "kill": "SIGKILL the whole process — an abrupt driver-host death "
             "(no cleanup, no journal flush beyond what already "
             "fsync'd; the warm-standby takeover scenario)",
@@ -93,7 +106,7 @@ ACTIONS = {
 SIGNAL_ACTION_POINTS = {
     "mismatch": ("collective",),
     "stall": ("collective", "backend_submit"),
-    "corrupt": ("checkpoint",),
+    "corrupt": ("checkpoint", "migrate_out", "migrate_in"),
     "partition": ("driver",),
 }
 

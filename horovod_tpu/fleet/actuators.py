@@ -23,6 +23,15 @@ graceful SIGTERM preemption at the next commit boundary (exit 83 →
 membership change, reshard, zero lost steps), growing the serving
 target spawns serving workers that join through the normal
 router/rendezvous paths.
+
+When the serving plane has live migration wired (serving/migration.py,
+docs/serving.md "Live migration"), the drain flags raised here are
+migration-backed: the drained worker hands its in-flight KV pages to a
+surviving peer instead of decoding them to completion, so the serve →
+train chip ebb returns slots in O(transfer) rather than O(longest
+stream), with zero re-prefills. Without a peer or on a refused
+transfer the drain degrades — loudly — to the original finish-locally
+path; either way no accepted request is lost.
 """
 
 from ..chaos import inject as _chaos_inject

@@ -17,7 +17,9 @@ The serving plane rides the same server (docs/serving.md): attaching a
 ``serving_router`` or ``serving_worker`` (``attach_serving``) enables
 the token-gated ``POST /v1/generate``, ``GET /v1/serving/stats`` and
 ``POST /v1/serving/drain`` routes — the router and every serving
-worker host their HTTP surface through this one handler.
+worker host their HTTP surface through this one handler. Workers
+additionally answer ``POST /v1/serving/migrate_in`` (KV-cache live
+migration, docs/serving.md "Live migration").
 """
 
 import secrets
@@ -123,12 +125,15 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self):  # noqa: N802
-        """Serving-plane routes: /v1/generate, /v1/serving/drain."""
+        """Serving-plane routes: /v1/generate, /v1/serving/drain,
+        /v1/serving/migrate_in (worker targets only — migration is
+        host-to-host, the router never holds KV pages)."""
         if not self._authorized():
             return
         import json as _json
         target = self._serving_target()
-        if self.path not in ("/v1/generate", "/v1/serving/drain") \
+        if self.path not in ("/v1/generate", "/v1/serving/drain",
+                             "/v1/serving/migrate_in") \
                 or target is None:
             return self._reply(404, b"")
         length = int(self.headers.get("Content-Length", 0))
@@ -142,6 +147,11 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
                 400, {"error": "bad JSON body: must be an object"})
         if self.path == "/v1/generate":
             code, body = target.handle_generate(payload)
+        elif self.path == "/v1/serving/migrate_in":
+            worker = getattr(self.server, "serving_worker", None)
+            if worker is None:
+                return self._reply(404, b"")
+            code, body = worker.handle_migrate_in(payload)
         else:
             code, body = target.handle_drain(payload)
         self._reply_json(code, body)

@@ -23,8 +23,16 @@ The pool optionally carries real per-token payload (``kv_dim`` > 0):
 :meth:`PageTable.gather` reads the sequence's context back in token
 order. Tests and the ToyLM decode through this path, so paging is data
 movement, not just bookkeeping.
+
+Live migration (docs/serving.md "Live migration") exports a sequence's
+pages in table order — each page carrying a sha256 digest of its used
+slots — and imports them on another host all-or-nothing against that
+host's watermark: every digest is verified *before* a single page is
+allocated, so a refused import leaves the target pool untouched.
 """
 
+import base64
+import hashlib
 import threading
 
 import numpy as np
@@ -38,6 +46,28 @@ WATERMARK_FRACTION = 16
 class PoolExhausted(RuntimeError):
     """Raised by :meth:`PagePool.alloc` when the pool cannot satisfy an
     allocation; the scheduler catches it and preempts."""
+
+
+class MigrationError(RuntimeError):
+    """Base of every export/import refusal. Every subtype is raised
+    *before* the target pool is mutated (all-or-nothing), so a failed
+    migration leaves the importer exactly as it was and the caller
+    falls back to recompute (the graceful-degradation contract)."""
+
+
+class DigestMismatch(MigrationError):
+    """A page payload does not match its sha256 digest — corruption in
+    transit. Import refuses the whole record."""
+
+
+class GeometryMismatch(MigrationError):
+    """The record's page_size/kv_dim/page-count does not fit this
+    pool — migrating between incompatible serving configurations."""
+
+
+class NoHeadroom(MigrationError):
+    """Placing the record would dip below this pool's admission
+    watermark; the target has no room to host a *growing* sequence."""
 
 
 class PagePool:
@@ -99,11 +129,98 @@ class PagePool:
         _m.kv_pages_free().set(free_now)
         return pages
 
+    def alloc_admit(self, n):
+        """``n`` page ids, refused with :class:`NoHeadroom` when the
+        grab would dip below the admission watermark. The check and the
+        allocation are one critical section — an import can never race
+        another allocator into the reserve."""
+        n = int(n)
+        with self._lock:
+            if len(self._free) - n < self.watermark:
+                raise NoHeadroom(
+                    f"import needs {n} pages but only "
+                    f"{len(self._free)} free over a watermark of "
+                    f"{self.watermark} (pool {self.num_pages})")
+            pages = [self._free.pop() for _ in range(n)]
+            free_now = len(self._free)
+        _m.kv_pages_free().set(free_now)
+        return pages
+
     def free(self, pages):
         with self._lock:
             self._free.extend(pages)
             free_now = len(self._free)
         _m.kv_pages_free().set(free_now)
+
+    # -- live migration ----------------------------------------------------
+    def _page_bytes(self, page, used):
+        """Raw payload of one page's first ``used`` slots (b"" for a
+        bookkeeping-only pool)."""
+        if self.data is None:
+            return b""
+        return np.ascontiguousarray(
+            self.data[page, :used], np.float32).tobytes()
+
+    def export_sequence(self, table):
+        """One sequence's KV state as a wire record: page payloads in
+        table order, each with a sha256 digest, plus the pool geometry
+        the importer must match. Sequence metadata (prompt, generated
+        tokens, next position) is layered on by the scheduler."""
+        n_tokens = table.num_tokens
+        ps = self.page_size
+        pages = []
+        for idx, page in enumerate(table.pages):
+            used = min(ps, n_tokens - idx * ps)
+            raw = self._page_bytes(page, used)
+            pages.append({
+                "payload": base64.b64encode(raw).decode("ascii"),
+                "digest": hashlib.sha256(raw).hexdigest(),
+            })
+        return {"num_tokens": n_tokens, "page_size": ps,
+                "kv_dim": self.kv_dim, "pages": pages}
+
+    def import_sequence(self, record):
+        """Place an exported record into this pool; returns the new
+        :class:`PageTable`. All-or-nothing: geometry and every page
+        digest are verified *before* any page is allocated, and the
+        allocation itself is watermark-fenced (:meth:`alloc_admit`) —
+        any raise leaves the pool's free count exactly as it was."""
+        if (int(record["page_size"]) != self.page_size
+                or int(record["kv_dim"]) != self.kv_dim):
+            raise GeometryMismatch(
+                f"record pages are {record['page_size']} slots x "
+                f"kv_dim {record['kv_dim']}; this pool is "
+                f"{self.page_size} x {self.kv_dim}")
+        n_tokens = int(record["num_tokens"])
+        pages_meta = record["pages"]
+        if self.pages_needed(n_tokens) != len(pages_meta):
+            raise GeometryMismatch(
+                f"{n_tokens} tokens need "
+                f"{self.pages_needed(n_tokens)} pages, record carries "
+                f"{len(pages_meta)}")
+        ps = self.page_size
+        payloads = []
+        for idx, pg in enumerate(pages_meta):
+            raw = base64.b64decode(pg["payload"])
+            if hashlib.sha256(raw).hexdigest() != pg["digest"]:
+                raise DigestMismatch(
+                    f"page {idx}/{len(pages_meta)} payload does not "
+                    f"match its sha256 digest")
+            used = min(ps, n_tokens - idx * ps)
+            if self.kv_dim and len(raw) != used * self.kv_dim * 4:
+                raise GeometryMismatch(
+                    f"page {idx} carries {len(raw)} bytes, expected "
+                    f"{used * self.kv_dim * 4}")
+            payloads.append((raw, used))
+        pages = self.alloc_admit(len(pages_meta))   # NoHeadroom
+        table = PageTable(self)
+        table.pages = pages
+        table.num_tokens = n_tokens
+        if self.data is not None:
+            for page, (raw, used) in zip(pages, payloads):
+                self.data[page, :used] = np.frombuffer(
+                    raw, np.float32).reshape(used, self.kv_dim)
+        return table
 
 
 class PageTable:

@@ -31,6 +31,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 
 from ..utils.logging_util import get_logger
 from . import metrics as _m
@@ -39,8 +40,20 @@ from . import metrics as _m
 DEAD_GRACE_S = 5.0
 #: member slots probed per cohort during KV discovery.
 MAX_MEMBERS = 32
-#: Retry-After seconds returned with router 429s.
+#: Retry-After base seconds for router 429s (jittered per request).
 RETRY_AFTER_S = 1.0
+#: handoff hops the router follows for one migrated stream.
+HANDOFF_HOPS = 4
+
+
+def retry_after_jitter(request_id, base=RETRY_AFTER_S):
+    """Deterministic per-request ``Retry-After``: ``base`` scaled into
+    [0.5, 1.5) by a hash of the request id. Synchronized clients that
+    all hit a full queue de-herd — each backs off a *different* but
+    *reproducible* amount (same id, same value), so chaos/backpressure
+    tests stay deterministic while the thundering herd disperses."""
+    h = zlib.crc32(str(request_id).encode())
+    return round(float(base) * (0.5 + (h % 4096) / 4096.0), 3)
 
 # RemoteDisconnected is a ConnectionResetError, but BadStatusLine (a
 # half-written response from a dying worker) is only an HTTPException.
@@ -126,6 +139,7 @@ class Router:
         self.completed = 0
         self.rerouted = 0
         self.rejected = 0
+        self.handoffs = 0        # migrated streams followed to a peer
         self._log = get_logger()
 
     # -- membership --------------------------------------------------------
@@ -212,13 +226,25 @@ class Router:
 
     def generate(self, payload):
         """Forward one request; ``(status, body)``. Transport failures
-        re-route; uniform backpressure returns 429 + Retry-After."""
+        re-route; a ``migrated`` response is followed to the new host
+        (the stream continues there with zero re-prefill); uniform
+        backpressure returns 429 + a per-request-jittered
+        Retry-After."""
+        request_id = None
+        if isinstance(payload, dict):
+            request_id = payload.get("id")
+            # Ask workers for the raw handoff record instead of having
+            # them proxy a migrated stream — the router follows it and
+            # keeps the fallback ladder (replay on the next candidate)
+            # in one place.
+            payload["handoff"] = "return"
         candidates = self._candidates(payload.pop("cohort", None)
                                       if isinstance(payload, dict)
                                       else None)
         if not candidates:
             return 503, {"error": "no serving workers registered"}
         backpressured = failed = draining = False
+        retry_hint = 0.0
         for client in candidates:
             try:
                 status, body = client.generate(payload)
@@ -232,6 +258,15 @@ class Router:
                 self._mark_dead(client)
                 failed = True
                 continue
+            if status == 200 and body.get("state") == "migrated":
+                status, body = self._follow_handoff(body)
+                if status != 200:
+                    # Handoff lost (the peer died before the stream
+                    # was claimed): fall back to replaying the request
+                    # on the next candidate — recompute, the status
+                    # quo.
+                    failed = True
+                    continue
             if status == 200:
                 with self._lock:
                     self.accepted += 1
@@ -246,6 +281,14 @@ class Router:
                     draining = True
                 else:
                     backpressured = True
+                    # Honor the most conservative worker-supplied
+                    # (already jittered) Retry-After hint.
+                    try:
+                        retry_hint = max(
+                            retry_hint,
+                            float(body.get("retry_after") or 0.0))
+                    except (TypeError, ValueError):
+                        pass
                 continue
             if 400 <= status < 500:
                 # Deterministic client errors (400 malformed, 413 too
@@ -259,13 +302,58 @@ class Router:
                 self.rejected += 1
             _m.rejected_total("overload").inc()
             return 429, {"error": "all serving cohorts at queue limit",
-                         "retry_after": RETRY_AFTER_S}
+                         "retry_after": retry_hint
+                         or retry_after_jitter(request_id)}
         if draining:
             with self._lock:
                 self.rejected += 1
             _m.rejected_total("draining").inc()
             return 503, {"error": "all serving cohorts draining"}
         return 503, {"error": "no serving worker reachable"}
+
+    # -- migration handoff -------------------------------------------------
+    def _client_for(self, url):
+        """A client for a handoff target: the known member with that
+        base URL when we have one (keeps its dead-marking state), else
+        a fresh WorkerClient on the KV token."""
+        base = url.rstrip("/")
+        with self._lock:
+            for clients in self.members.values():
+                for client in clients:
+                    if client.base_url == base:
+                        return client
+        token = self.kv[2] if self.kv is not None else ""
+        return WorkerClient(base, token=token)
+
+    def _follow_handoff(self, body, hops=HANDOFF_HOPS):
+        """Chase a migrated stream to the host now decoding it; the
+        final ``(status, body)``. The continuation is the *same*
+        sequence — imported KV pages, zero re-prefill — so the client
+        stream completes token-exact without replaying the prompt.
+        Any failure returns non-200 and the caller falls back to the
+        replay (recompute) ladder."""
+        for _ in range(hops):
+            handoff = body.get("handoff") or {}
+            url, rid = handoff.get("url"), handoff.get("id")
+            if not url or not rid:
+                return 502, {"error": "malformed handoff record"}
+            client = self._client_for(url)
+            try:
+                status, body = client.generate(
+                    {"attach": rid, "handoff": "return"})
+            except _TRANSPORT_ERRORS as e:
+                self._log.warning(
+                    "serving router: handoff target %s unreachable "
+                    "(%s); falling back to re-route", url, e)
+                self._mark_dead(client)
+                return 502, {"error": "handoff target unreachable"}
+            if status == 200 and body.get("state") == "migrated":
+                continue             # moved again: follow the chain
+            if status == 200:
+                with self._lock:
+                    self.handoffs += 1
+            return status, body
+        return 502, {"error": "handoff chain unresolved"}
 
     # HTTP-surface aliases (the runner server dispatches on these).
     def handle_generate(self, payload):
@@ -317,7 +405,14 @@ class Router:
                 try:
                     fresh[(cohort, self._wid_of(client, i))] = \
                         client.stats()
-                except _TRANSPORT_ERRORS:
+                except _TRANSPORT_ERRORS as e:
+                    # Stale beats absent, but never silently (HVD213):
+                    # an operator watching the log can tell a scrape
+                    # gap from a healthy idle worker.
+                    self._log.debug(
+                        "serving router: stats scrape of %s failed "
+                        "(%s); serving last-known view",
+                        client.base_url, e)
                     continue
         with self._lock:
             self._stats_cache.update(fresh)
@@ -343,6 +438,7 @@ class Router:
                 "cohorts": cohorts,
                 "accepted": self.accepted, "completed": self.completed,
                 "rerouted": self.rerouted, "rejected": self.rejected,
+                "handoffs": self.handoffs,
             }
 
     # -- drain -------------------------------------------------------------
@@ -364,7 +460,11 @@ class Router:
             try:
                 status, _ = client.drain()
                 acks[str(i)] = status == 200
-            except _TRANSPORT_ERRORS:
+            except _TRANSPORT_ERRORS as e:
+                self._log.warning(
+                    "serving router: direct drain of %s failed (%s); "
+                    "the KV drain flag still reaches it",
+                    client.base_url, e)
                 acks[str(i)] = False
         return {"cohort": cohort, "acks": acks}
 

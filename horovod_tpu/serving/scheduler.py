@@ -19,7 +19,10 @@ is alive *right now*. The scheduler owns that loop:
    watermark engaged);
 3. **decode** one token for every running sequence through its page
    table; KV growth that exhausts the pool preempts the
-   least-recently-(re)admitted sequence and retries;
+   least-recently-(re)admitted sequence and retries — with a Migrator
+   wired (serving/migration.py) the victim is first offered to a peer
+   as a verified page transfer, and only a refused/failed transfer
+   falls back to the recompute preemption above;
 4. **retire** finished sequences (max tokens or EOS), freeing pages and
    completing their streams.
 
@@ -46,8 +49,9 @@ from .kv_cache import PagePool, PageTable, PoolExhausted
 #: recent step compositions kept for stats/debug (bounded).
 STEP_LOG = 256
 
-QUEUED, PREFILL, RUNNING, PREEMPTED, DONE, FAILED = (
-    "queued", "prefill", "running", "preempted", "done", "failed")
+QUEUED, PREFILL, RUNNING, PREEMPTED, DONE, FAILED, MIGRATED = (
+    "queued", "prefill", "running", "preempted", "done", "failed",
+    "migrated")
 
 
 class Request:
@@ -94,7 +98,7 @@ class SequenceResult:
 class _Seq:
     __slots__ = ("req", "result", "table", "generated", "state",
                  "t_submit", "t_admit", "t_prefill_done", "t_done",
-                 "admit_stamp", "preempts")
+                 "admit_stamp", "preempts", "migrations")
 
     def __init__(self, req, result):
         self.req = req
@@ -108,6 +112,7 @@ class _Seq:
         self.t_done = None
         self.admit_stamp = 0     # LRU key: last (re)admission order
         self.preempts = 0
+        self.migrations = 0      # hops this sequence arrived through
 
     def tokens_alive(self):
         return self.req.prompt + self.generated
@@ -145,6 +150,15 @@ class Scheduler:
         self.admission_blocked = 0
         self.tokens_out = 0
         self.preemptions = 0
+        # -- live migration (docs/serving.md) --------------------------
+        # A Migrator (serving/migration.py) set by the worker once it
+        # knows the KV member plane; None = pure recompute, the
+        # pre-migration behavior.
+        self.migrator = None
+        self.elastic_version = "0"   # stamped into exported records
+        self.migrated_out = 0
+        self.migrated_in = 0
+        self.migrate_failed = 0
 
     # -- intake (HTTP handler threads) -------------------------------------
     def submit(self, req):
@@ -271,13 +285,18 @@ class Scheduler:
     # -- preemption --------------------------------------------------------
     def _preempt_lru(self, exclude_id):
         """Free the least-recently-(re)admitted running sequence's
-        pages; it re-enters via recompute-on-resume. Returns True when
-        a victim was found."""
+        pages. Migration first when a Migrator is wired: the victim's
+        verified KV pages move to a peer with headroom and its stream
+        completes there with **zero recompute**; any migration failure
+        falls back to the status-quo recompute-on-resume path. Returns
+        True when a victim was found (pages freed either way)."""
         victims = [s for s in self._running.values()
                    if s.req.id != exclude_id]
         if not victims:
             return False
         victim = min(victims, key=lambda s: s.admit_stamp)
+        if self._try_migrate_out(victim):
+            return True
         victim.table.release()
         victim.table = None
         victim.state = PREEMPTED
@@ -287,6 +306,107 @@ class Scheduler:
         self.preemptions += 1
         _m.preempted_total().inc()
         return True
+
+    # -- live migration ----------------------------------------------------
+    def _export_record(self, seq):
+        """``seq`` as a migration wire record: KV pages in table order
+        (hot) or none at all (a preempted sequence migrates cold and
+        resumes by recompute on the target), plus the sequence
+        metadata — prompt, generated tokens, next position
+        (num_tokens) — and the elastic-version fence."""
+        rec = {"v": 1, "id": seq.req.id,
+               "prompt": list(seq.req.prompt),
+               "generated": list(seq.generated),
+               "max_new_tokens": seq.req.max_new_tokens,
+               "preempts": seq.preempts,
+               "migrations": seq.migrations + 1,
+               "elastic_version": str(self.elastic_version)}
+        if seq.table is not None:
+            rec.update(self.pool.export_sequence(seq.table))
+        else:
+            rec.update({"num_tokens": 0,
+                        "page_size": self.pool.page_size,
+                        "kv_dim": self.pool.kv_dim, "pages": []})
+        return rec
+
+    def _try_migrate_out(self, seq):
+        """Export + hand ``seq`` to a peer through the migrator. True
+        when the sequence now lives elsewhere: pages freed, stream
+        finished locally with state ``migrated`` and the handoff record
+        the router (or the worker itself) follows. False = caller
+        falls back to recompute; the migrator has already counted and
+        logged why (graceful degradation, never silent)."""
+        if self.migrator is None:
+            return False
+        handoff = self.migrator.migrate_seq(self._export_record(seq))
+        if handoff is None:
+            self.migrate_failed += 1
+            return False
+        if seq.table is not None:
+            seq.table.release()
+            seq.table = None
+        self._running.pop(seq.req.id, None)
+        self._preempted.pop(seq.req.id, None)
+        seq.state = MIGRATED
+        seq.t_done = time.monotonic()
+        self.migrated_out += 1
+        seq.result.finish({
+            "id": seq.req.id, "tokens": list(seq.generated),
+            "state": MIGRATED, "handoff": handoff,
+            "preempts": seq.preempts,
+            "migrations": seq.migrations + 1,
+        })
+        return True
+
+    def migrate_all_out(self):
+        """Drain the accelerator by moving every live sequence to a
+        peer (worker drain / SIGTERM hand-off) — chip-return latency
+        decouples from stream length. Sequences whose migration falls
+        back stay local and finish through the normal decode/recompute
+        path. Returns the number migrated."""
+        if self.migrator is None:
+            return 0
+        moved = 0
+        with self._lock:
+            live = (list(self._running.values())
+                    + list(self._preempted.values()))
+            for seq in live:
+                if self._try_migrate_out(seq):
+                    moved += 1
+        return moved
+
+    def import_remote(self, record):
+        """Place a migrated-in sequence; ``(local_id, SequenceResult)``.
+        Hot records (pages present) resume decoding from the imported
+        KV with no prefill; cold records re-enter through the normal
+        recompute admission. Raises kv_cache.MigrationError subtypes —
+        always before anything is placed, so a refusal leaves this
+        scheduler untouched (all-or-nothing)."""
+        req = Request(f"{record['id']}~m{next(self._stamp)}",
+                      record["prompt"], record["max_new_tokens"])
+        generated = [int(t) for t in record.get("generated", ())]
+        with self._lock:
+            table = None
+            if int(record.get("num_tokens", 0)):
+                table = self.pool.import_sequence(record)
+            seq = _Seq(req, SequenceResult(req.max_new_tokens))
+            seq.generated = generated
+            seq.preempts = int(record.get("preempts", 0))
+            seq.migrations = int(record.get("migrations", 1))
+            now = time.monotonic()
+            seq.t_admit = now
+            if table is not None:
+                seq.table = table
+                seq.state = RUNNING
+                seq.t_prefill_done = now
+                seq.admit_stamp = next(self._stamp)
+                self._running[req.id] = seq
+            else:
+                seq.state = PREEMPTED
+                self._preempted[req.id] = seq
+            self.migrated_in += 1
+        _m.migrations_total("imported").inc()
+        return req.id, seq.result
 
     # -- completion --------------------------------------------------------
     def _finish(self, seq, state=DONE, error=None):
@@ -306,6 +426,7 @@ class Scheduler:
         summary = {
             "id": seq.req.id, "tokens": list(seq.generated),
             "state": state, "preempts": seq.preempts,
+            "migrations": seq.migrations,
             "latency": {
                 "queue": (seq.t_admit or seq.t_done) - seq.t_submit,
                 "prefill": ((seq.t_prefill_done - seq.t_admit)
@@ -332,6 +453,14 @@ class Scheduler:
             contexts = [s.table.gather() for s in batch]
             next_tokens, next_kv = self.model.decode(contexts)
             for seq, tok, kv in zip(batch, next_tokens, next_kv):
+                if seq.state == MIGRATED:
+                    # An earlier sequence's exhaustion migrated this
+                    # one away mid-step. Its exported KV (and token
+                    # list) predate THIS step's token, so the target
+                    # regenerates it deterministically as its first
+                    # continuation step — recording it here too would
+                    # double it.
+                    continue
                 seq.generated.append(int(tok))
                 self.tokens_out += 1
                 _m.tokens_total().inc()
@@ -390,6 +519,9 @@ class Scheduler:
                 "tokens_out": self.tokens_out,
                 "preemptions": self.preemptions,
                 "admission_blocked": self.admission_blocked,
+                "migrated_out": self.migrated_out,
+                "migrated_in": self.migrated_in,
+                "migrate_failed": self.migrate_failed,
                 "pages_free": self.pool.free_pages,
                 "pages_total": self.pool.num_pages,
                 "draining": self.draining,

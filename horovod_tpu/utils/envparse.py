@@ -421,6 +421,18 @@ SERVING_SLO_P99 = register(
     "Serving p99 end-to-end latency SLO in seconds; a window-smoothed "
     "breach counts as scale-up pressure even with a shallow queue "
     "(0 = latency trigger off, depth-only autoscaling)")
+SERVING_MIGRATE_RETRIES = register(
+    "SERVING_MIGRATE_RETRIES", "3",
+    "Retry attempts per KV-cache migration chunk POST before the "
+    "transfer falls back to recompute")
+SERVING_MIGRATE_DEADLINE = register(
+    "SERVING_MIGRATE_DEADLINE", "5",
+    "Seconds each migration chunk may spend retrying before the "
+    "transfer falls back to recompute")
+SERVING_MIGRATE_MAX_BYTES = register(
+    "SERVING_MIGRATE_MAX_BYTES", "4194304",
+    "Upper bound on one migrate_in POST body; a sequence's pages are "
+    "chunked to stay under it (bounds target staging memory too)")
 
 # -- fleet arbitration (docs/fault_tolerance.md "Fleet arbitration") -------
 FLEET = register(

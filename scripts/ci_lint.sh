@@ -71,7 +71,8 @@ rules = {r["ruleId"] for r in results}
 families = {rule[:4] for rule in rules if rule.startswith("HVD")}
 missing = {"HVD2", "HVD3", "HVD4", "HVD5"} - families
 assert not missing, f"fixture corpus no longer trips {sorted(missing)}xx"
-for tag in ("HVD210", "HVD211", "HVD212", "HVD401", "HVD402", "HVD403",
+for tag in ("HVD210", "HVD211", "HVD212", "HVD213", "HVD401", "HVD402",
+            "HVD403",
             "HVD404",
             "HVD405", "HVD501", "HVD502", "HVD503"):
     assert tag in rules, f"fixture corpus no longer trips {tag}"

@@ -1,0 +1,70 @@
+"""HVD213 fixture: silently swallowed transport errors.
+
+Three positives (a bare OSError pass in a router class, a URLError
+swallow in a handle_* function, a *TRANSPORT* tuple swallowed with
+only a fallback assignment), three negatives (a logged handler, a
+re-raise, a non-transport exception type), one suppression.
+"""
+
+import urllib.error
+
+_TRANSPORT_ERRORS = (ConnectionError, OSError)
+
+
+class RequestRouter:
+    def __init__(self, log, clients):
+        self._log = log
+        self._clients = clients
+
+    def scrape(self, client):
+        try:
+            return client.stats()
+        except OSError:  # HVD213
+            pass
+
+    def scrape_logged(self, client):
+        # Negative: the fallback is recorded before it is taken.
+        try:
+            return client.stats()
+        except OSError as e:
+            self._log.warning("stats scrape failed (%s)", e)
+            return None
+
+    def scrape_reraise(self, client):
+        # Negative: the error escapes to a caller that records it.
+        try:
+            return client.stats()
+        except ConnectionError:
+            raise
+
+
+def handle_generate(client, payload):
+    try:
+        return client.generate(payload)
+    except urllib.error.URLError:  # HVD213
+        return {"status": 502}
+
+
+def handle_probe(client):
+    try:
+        return client.ping()
+    except _TRANSPORT_ERRORS:  # HVD213 — tuple named *TRANSPORT*
+        result = None
+    return result
+
+
+def handle_parse(raw):
+    # Negative: ValueError is not a transport error.
+    try:
+        return int(raw)
+    except ValueError:
+        return 0
+
+
+class FleetProbe:
+    def check(self, sock):
+        # Suppressed: the caller counts probe failures.
+        try:
+            return sock.recv(1)
+        except BrokenPipeError:  # hvd-lint: disable=HVD213
+            return b""

@@ -13,10 +13,17 @@ Env (all optional):
   SERVING_HOST_DELAY                        seconds per decode step
                                             (slows generation so kills
                                             land mid-decode)
+  SERVING_HOST_HANDOFF                      1 = SIGTERM triggers
+                                            worker.handoff() (drain +
+                                            migrate live sequences to
+                                            peers) then a clean exit,
+                                            instead of the default
+                                            fatal path
   HVDTPU_SERVING_*                          the registered knobs
 """
 
 import os
+import signal
 import sys
 import time
 
@@ -51,6 +58,15 @@ def main():
         host, _, kv_port = kv.rpartition(":")
         worker.register(host, int(kv_port), token,
                         advertise=f"127.0.0.1:{port}")
+    if os.environ.get("SERVING_HOST_HANDOFF") == "1":
+        def _handoff(signum, frame):
+            moved = worker.handoff()
+            print(f"HANDOFF {moved}", flush=True)
+            # Linger briefly so in-flight attach/handoff-follow
+            # requests against this host can still complete.
+            time.sleep(1.0)
+            os._exit(0)
+        signal.signal(signal.SIGTERM, _handoff)
     print(f"SERVING {port}", flush=True)
     while True:  # until SIGTERM/SIGKILL from the test
         time.sleep(0.2)

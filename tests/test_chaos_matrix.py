@@ -1053,3 +1053,174 @@ def test_serving_kv_blackout_degrades_to_local_and_resyncs(monkeypatch):
         for proc, _ in procs:
             proc.wait(timeout=10)
         kv.stop()
+
+
+# ==========================================================================
+# Live-migration rows (ISSUE 19, docs/serving.md "Live migration")
+# ==========================================================================
+
+def test_serving_sigterm_handoff_migrates_zero_recompute():
+    """Migration row (a): SIGTERM a hand-off-enabled worker while
+    streams are mid-decode. The dying worker drains by MIGRATING its
+    live sequences to the surviving peer — verified page transfer, not
+    replay — so every stream completes token-exact with ZERO
+    re-prefills on the migrated sequences (``preempts == 0`` on their
+    summaries, ``preemptions == 0`` on the target) and the router
+    follows hand-off records instead of re-routing (``rerouted == 0``,
+    zero accepted-request loss)."""
+    import signal
+    import threading
+
+    from horovod_tpu.runner.http_server import KVStoreServer, \
+        new_job_token
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.router import Router
+    from test_serving import _http_json, _spawn_host
+
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    procs = []
+    try:
+        for wid in range(2):
+            procs.append(_spawn_host(
+                "c0", wid, kv_port, token,
+                env_extra={"SERVING_HOST_DELAY": "0.04",
+                           "SERVING_HOST_HANDOFF": "1"}))
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        assert router.refresh_from_kv(["c0"]) == {"c0": 2}
+        m = ToyLM()
+        specs = [([(i % 5) + 1, 3], 24) for i in range(16)]
+        out = [None] * 16
+
+        def gen(i, p, n):
+            out[i] = router.generate(
+                {"prompt": p, "max_new_tokens": n})
+
+        threads = [threading.Thread(target=gen, args=(i, p, n))
+                   for i, (p, n) in enumerate(specs)]
+        for t in threads:
+            t.start()
+        # 24 tokens x 40ms/step >= ~1s of decode: the SIGTERM lands
+        # with streams admitted and provably mid-decode on both hosts.
+        time.sleep(0.5)
+        procs[0][0].send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(timeout=180)
+        # The hand-off banner is the dying host's own account of what
+        # it moved; with a live peer and no chaos it must move > 0.
+        line = procs[0][0].stdout.readline().strip()
+        assert line.startswith("HANDOFF "), f"no hand-off banner: {line!r}"
+        moved = int(line.split()[1])
+        assert moved >= 1, "SIGTERM landed with nothing live to migrate"
+
+        for i, (p, n) in enumerate(specs):
+            status, body = out[i]
+            assert status == 200, (i, out[i])
+            assert body["tokens"] == m.reference_completion(p, n), i
+        assert router.completed == 16, "zero accepted-request loss"
+        # Clean hand-off, not replay: the router FOLLOWED migration
+        # records; the dead-host re-route/re-prefill path never fired.
+        assert router.handoffs >= 1, \
+            "SIGTERM landed after completion; hand-off never exercised"
+        assert router.rerouted == 0, "a stream was replayed, not migrated"
+        migrated = [b for _, b in out if b.get("migrations", 0) >= 1]
+        assert len(migrated) >= moved
+        for body in migrated:
+            assert body["preempts"] == 0, \
+                "migrated stream re-prefilled (recompute leak)"
+        # Target-side ledger: the imports landed, and nothing on the
+        # survivor was preempted to make room (watermark admission).
+        status, _, stats = _http_json(procs[1][1], "/v1/serving/stats",
+                                      token=token)
+        assert status == 200
+        assert stats["migrated_in"] == moved
+        assert stats["preemptions"] == 0
+    finally:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        kv.stop()
+
+
+def test_serving_migrate_corrupt_digest_rejected_recompute_fallback():
+    """Migration row (b): a corrupting transport under hand-off. Every
+    exported page is corrupted in flight (``migrate_out:corrupt``), so
+    the target's commit-time digest verification must REJECT every
+    transfer (nothing placed, all-or-nothing) and the source's
+    hand-off banner reports 0 moved. The fallback ladder then finishes
+    the job loudly: the dying host exits, the router replays the
+    affected streams on the survivor via recompute, and every stream
+    still completes with the exact oracle tokens."""
+    import signal
+    import threading
+
+    from horovod_tpu.runner.http_server import KVStoreServer, \
+        new_job_token
+    from horovod_tpu.serving.model import ToyLM
+    from horovod_tpu.serving.router import Router
+    from test_serving import _http_json, _spawn_host
+
+    token = new_job_token()
+    kv = KVStoreServer(job_token=token, addr="127.0.0.1")
+    kv_port = kv.start()
+    procs = []
+    try:
+        procs.append(_spawn_host(
+            "c0", 0, kv_port, token,
+            env_extra={"SERVING_HOST_DELAY": "0.08",
+                       "SERVING_HOST_HANDOFF": "1",
+                       "HVDTPU_CHAOS": "migrate_out:corrupt"}))
+        procs.append(_spawn_host(
+            "c0", 1, kv_port, token,
+            env_extra={"SERVING_HOST_DELAY": "0.005"}))
+        router = Router(kv=("127.0.0.1", kv_port, token))
+        assert router.refresh_from_kv(["c0"]) == {"c0": 2}
+        m = ToyLM()
+        specs = [([(i % 5) + 1, 4], 24) for i in range(8)]
+        out = [None] * 8
+
+        def gen(i, p, n):
+            out[i] = router.generate(
+                {"prompt": p, "max_new_tokens": n})
+
+        threads = [threading.Thread(target=gen, args=(i, p, n))
+                   for i, (p, n) in enumerate(specs)]
+        for t in threads:
+            t.start()
+        # 24 tokens x 80ms/step on host 0: the SIGTERM lands with its
+        # streams far from done, and the 1s post-hand-off linger is not
+        # enough to finish them locally — the replay path MUST fire.
+        time.sleep(0.4)
+        procs[0][0].send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(timeout=180)
+        line = procs[0][0].stdout.readline().strip()
+        assert line.startswith("HANDOFF "), f"no hand-off banner: {line!r}"
+        assert int(line.split()[1]) == 0, \
+            "a corrupted page transfer was accepted"
+
+        for i, (p, n) in enumerate(specs):
+            status, body = out[i]
+            assert status == 200, (i, out[i])
+            assert body["tokens"] == m.reference_completion(p, n), i
+        assert router.completed == 8, "zero accepted-request loss"
+        # The fallback was recompute (replay on the survivor), never a
+        # followed migration record.
+        assert router.rerouted >= 1, \
+            "host 0 finished locally; the corrupt fallback never fired"
+        assert router.handoffs == 0
+        # Nothing corrupted was ever placed on the survivor.
+        status, _, stats = _http_json(procs[1][1], "/v1/serving/stats",
+                                      token=token)
+        assert status == 200
+        assert stats["migrated_in"] == 0
+    finally:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        kv.stop()

@@ -405,6 +405,100 @@ class TestAstRules:
         assert severity == WARNING
         assert "spawn/terminate" in title
 
+    # -- HVD213: silently swallowed transport errors -----------------------
+    def test_silent_degradation_fixture(self):
+        diags = self.lint("bad_silent_degradation.py")
+        assert rules_of(diags) == ["HVD213", "HVD213", "HVD213"]
+        assert [d.line for d in diags] == [22, 44, 51]
+
+    def test_swallow_in_serving_file_flagged(self):
+        src = ("def fetch(client):\n"
+               "    try:\n"
+               "        return client.stats()\n"
+               "    except OSError:\n"
+               "        return None\n")
+        diags = ast_lint.lint_source(
+            src, filename="horovod_tpu/serving/router.py")
+        assert rules_of(diags) == ["HVD213"]
+
+    def test_logged_handler_is_clean(self):
+        src = ("class StreamRouter:\n"
+               "    def fetch(self, client):\n"
+               "        try:\n"
+               "            return client.stats()\n"
+               "        except OSError as e:\n"
+               "            self._log.warning('scrape failed: %s', e)\n"
+               "            return None\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_metric_bump_is_clean(self):
+        src = ("class FleetArbiter:\n"
+               "    def probe(self, peer):\n"
+               "        try:\n"
+               "            return peer.ping()\n"
+               "        except ConnectionError:\n"
+               "            self._m_failed.inc()\n"
+               "            return None\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_non_transport_exception_is_clean(self):
+        src = ("def handle_parse(raw):\n"
+               "    try:\n"
+               "        return int(raw)\n"
+               "    except ValueError:\n"
+               "        return 0\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_http_error_translation_is_clean(self):
+        # HTTPError means the peer ANSWERED — translating its status
+        # into a return value is protocol handling, not a swallow.
+        src = ("import urllib.error\n"
+               "class WorkerRouter:\n"
+               "    def req(self, client):\n"
+               "        try:\n"
+               "            return client.call()\n"
+               "        except urllib.error.HTTPError as e:\n"
+               "            return e.code, {}\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_outside_serving_context_is_clean(self):
+        # Same swallow, but no serving/fleet context anywhere: not a
+        # finding (the rule scopes to the degradation contract).
+        src = ("def read_config(path):\n"
+               "    try:\n"
+               "        return open(path).read()\n"
+               "    except OSError:\n"
+               "        return ''\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_silent_degradation_suppressible(self):
+        src = ("class PeerScheduler:\n"
+               "    def probe(self, peer):\n"
+               "        try:\n"
+               "            return peer.ping()\n"
+               "        except OSError:"
+               "  # hvd-lint: disable=HVD213\n"
+               "            return None\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_serving_and_fleet_sweep_is_hvd213_clean(self):
+        # The shipped serving/fleet planes hold themselves to the
+        # loud-fallback contract the rule enforces.
+        import glob
+        hits = []
+        for pkg in ("serving", "fleet"):
+            pat = os.path.join(REPO, "horovod_tpu", pkg, "*.py")
+            for path in sorted(glob.glob(pat)):
+                hits += [d for d in ast_lint.lint_file(path)
+                         if d.rule == "HVD213"]
+        assert hits == [], [(d.file, d.line) for d in hits]
+
+    def test_hvd213_in_catalog(self):
+        from horovod_tpu.analysis.diagnostics import RULES, WARNING
+        severity, title = RULES["HVD213"]
+        assert severity == WARNING
+        assert "transport" in title
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"
