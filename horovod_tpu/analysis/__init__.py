@@ -30,34 +30,69 @@ Three layers, one finding type (:class:`Diagnostic`):
    ``HVDTPU_SANITIZE=1`` lock-order/liveness instrumentation behind the
    HVD3xx thread-safety rules (``hvd-lint --self`` runs the static
    side over this package itself).
+6. **protocol model checker** (``protocol``/``hvd-model``) — the
+   control-plane spec modules (analysis/protocol/) the HA journal,
+   fleet ledger, and KV-migration runtimes execute, plus the
+   explicit-state explorer that proves their invariants up to a
+   bounded depth (HVD7xx; docs/modelcheck.md).
+
+Public names resolve lazily (PEP 562): the control-plane runtime
+imports the ``analysis.protocol`` spec modules on its hot import path,
+so touching this package must not drag in jax, the parser stack, or
+the simulator until a caller actually asks for them.
 
 Rule catalog and suppression syntax: docs/lint.md.
 """
 
-from .diagnostics import (  # noqa: F401
+import importlib
+
+from .diagnostics import (  # noqa: F401  (eager: stdlib-only)
     Diagnostic, RULES, ERROR, WARNING, dedupe, worst_severity,
 )
-from .jaxpr_lint import check_fn, check_jaxpr  # noqa: F401
-from .ast_lint import (  # noqa: F401
-    AliasResolver, lint_source, lint_file, lint_paths,
-    iter_python_files,
-)
-from .schedule import (  # noqa: F401
-    extract_schedule, verify_paths, verify_source,
-)
-from .simulate import (  # noqa: F401
-    render_trace, simulate_paths, simulate_source,
-    verify_and_simulate_paths, verify_and_simulate_source,
-)
-from .explain import (  # noqa: F401
-    ExplainError, explain_bundle, render_report,
-)
-from .sarif import to_sarif  # noqa: F401
-from .baseline import (  # noqa: F401
-    filter_new, load_baseline, write_baseline,
-)
-from .order_guard import SubmissionOrderGuard  # noqa: F401
-from . import sanitizer  # noqa: F401
+
+#: public name -> the submodule that defines it (resolved on first use).
+_LAZY_NAMES = {
+    "check_fn": "jaxpr_lint", "check_jaxpr": "jaxpr_lint",
+    "AliasResolver": "ast_lint", "lint_source": "ast_lint",
+    "lint_file": "ast_lint", "lint_paths": "ast_lint",
+    "iter_python_files": "ast_lint",
+    "extract_schedule": "schedule", "verify_paths": "schedule",
+    "verify_source": "schedule",
+    "render_trace": "simulate", "simulate_paths": "simulate",
+    "simulate_source": "simulate",
+    "verify_and_simulate_paths": "simulate",
+    "verify_and_simulate_source": "simulate",
+    "ExplainError": "explain", "explain_bundle": "explain",
+    "render_report": "explain",
+    "to_sarif": "sarif", "write_sarif": "sarif",
+    "filter_new": "baseline", "load_baseline": "baseline",
+    "write_baseline": "baseline",
+    "SubmissionOrderGuard": "order_guard",
+}
+
+_LAZY_MODULES = frozenset({
+    "ast_lint", "baseline", "cli", "costmodel", "explain",
+    "jaxpr_lint", "order_guard", "protocol", "sanitizer", "sarif",
+    "schedule", "simulate",
+})
+
+
+def __getattr__(name):
+    if name in _LAZY_NAMES:
+        mod = importlib.import_module("." + _LAZY_NAMES[name], __name__)
+        value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_NAMES) | _LAZY_MODULES)
 
 
 def runtime_axis_sizes():
@@ -97,6 +132,7 @@ def verify_traceable(fn, args, kwargs=None, axis_sizes=None, mode=True,
     """Trace ``fn`` and enforce the findings — the hook the compile
     bridges call behind ``verify=``. ``axis_sizes`` defaults to the
     runtime mesh's axes."""
+    from .jaxpr_lint import check_fn
     if axis_sizes is None:
         axis_sizes = runtime_axis_sizes()
     diags = check_fn(fn, *args, axis_sizes=axis_sizes, **(kwargs or {}))

@@ -1407,6 +1407,46 @@ class _SilentDegradationAnalyzer:
                 return True
         return False
 
+    @staticmethod
+    def _deferred_reraise(handler, func):
+        """True for the retry-ladder idiom: the handler stashes the
+        bound exception (``except OSError as e: last = e``) and the
+        enclosing function raises it — or raises *through* it (``raise
+        X(...) from last``) — after the loop. The error is not
+        swallowed, just deferred past the last attempt."""
+        if func is None or not handler.name:
+            return False
+        aliases = {handler.name}
+        # Two passes so a chain (a = e; b = a) inside the handler
+        # still resolves.
+        for _ in range(2):
+            for sub in ast.walk(handler):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = sub.value
+                if not (isinstance(value, ast.Name)
+                        and value.id in aliases):
+                    continue
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+        in_handler = set()
+        for sub in ast.walk(handler):
+            in_handler.add(id(sub))
+        for sub in ast.walk(func):
+            if not isinstance(sub, ast.Raise) or id(sub) in in_handler:
+                continue
+            for expr in (sub.exc, sub.cause):
+                if expr is None:
+                    continue
+                for name in ast.walk(expr):
+                    if isinstance(name, ast.Name) \
+                            and name.id in aliases:
+                        return True
+        return False
+
     def _report(self, handler, spelled):
         self.diags.append(Diagnostic.make(
             "HVD213",
@@ -1425,6 +1465,169 @@ class _SilentDegradationAnalyzer:
                  + _DOC_HINT))
 
     def run(self, tree):
+        self._walk(tree.body, self._ctx_file, None)
+        return self.diags
+
+    def _walk(self, stmts, ctx, func):
+        for node in stmts:
+            node_ctx = ctx
+            node_func = func
+            if isinstance(node, ast.ClassDef):
+                node_ctx = ctx or bool(
+                    self._CTX_CLASS_RE.search(node.name))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                node_ctx = ctx or bool(
+                    self._CTX_FUNC_RE.search(node.name))
+                node_func = node
+            if node_ctx and isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    spelled = self._transport_type(handler.type)
+                    if spelled \
+                            and not self._handler_observes(handler) \
+                            and not self._deferred_reraise(handler,
+                                                           func):
+                        self._report(handler, spelled)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                children = getattr(node, field, None)
+                if not children:
+                    continue
+                if field == "handlers":
+                    for h in children:
+                        self._walk(h.body, node_ctx, node_func)
+                else:
+                    self._walk(children, node_ctx, node_func)
+
+
+class _ProtocolOrderAnalyzer:
+    """HVD704/HVD705 over one module: AST-level companions to the
+    hvd-model protocol checker (docs/modelcheck.md) — they catch the
+    two bug shapes the models prove fatal *before* anything runs.
+
+    Context: a file under ``fleet/`` or ``runner/``, or a class whose
+    name says arbiter/ledger/journal/lease — the modules that execute
+    the control-plane protocols.
+
+    HVD704: within one function, an actuation call (``set_train_slots``
+    / ``set_serve_slots`` / ``drain`` / ``write_target``) appears
+    *before* the first durable ledger/journal write (a call like
+    ``ledger.advance(...)`` / ``self._jrec(...)``). The arbiter's
+    contract is ledger-before-actuation (fleet/ledger.py): a crash
+    between an early actuation and its late write strands an effect the
+    recovery protocol cannot see — exactly the ``actuate_before_ledger``
+    counterexample hvd-model minimizes.
+
+    HVD705: a ``<...>server.put(...)`` KV write carrying positional
+    scope/key/value but no ``term=`` keyword. An unfenced write slips
+    the split-brain fence (journal_spec.term_fences) — the
+    ``skip_fence`` counterexample.
+    """
+
+    _CTX_CLASS_RE = re.compile(r"arbiter|ledger|journal|lease",
+                               re.IGNORECASE)
+    _DURABLE_RECV_RE = re.compile(r"ledger|journal", re.IGNORECASE)
+    _DURABLE_ATTRS = frozenset({
+        "record", "advance", "open", "mark_transfer", "set_split",
+        "put", "write"})
+    _ACTUATION_ATTRS = frozenset({
+        "set_train_slots", "set_serve_slots", "drain", "write_target"})
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        parts = os.path.normpath(filename).split(os.sep)
+        self._ctx_file = "fleet" in parts or "runner" in parts
+
+    @staticmethod
+    def _dotted(node):
+        """Best-effort dotted receiver text ('self.ledger' etc.)."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    def _is_durable_write(self, call):
+        func = call.func
+        if isinstance(func, ast.Name) and func.id == "_jrec":
+            return True
+        if not isinstance(func, ast.Attribute):
+            return False
+        if func.attr == "_jrec":
+            return True
+        if func.attr not in self._DURABLE_ATTRS:
+            return False
+        return bool(self._DURABLE_RECV_RE.search(
+            self._dotted(func.value)))
+
+    def _is_actuation(self, call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id in self._ACTUATION_ATTRS
+        return (isinstance(func, ast.Attribute)
+                and func.attr in self._ACTUATION_ATTRS)
+
+    def _check_function(self, func_node):
+        durable_line = None
+        actuation = None
+        for sub in ast.walk(func_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if self._is_durable_write(sub):
+                if durable_line is None or sub.lineno < durable_line:
+                    durable_line = sub.lineno
+            elif self._is_actuation(sub):
+                if actuation is None or sub.lineno < actuation[1]:
+                    name = (sub.func.attr
+                            if isinstance(sub.func, ast.Attribute)
+                            else sub.func.id)
+                    actuation = (name, sub.lineno)
+        if (durable_line is not None and actuation is not None
+                and actuation[1] < durable_line):
+            name, lineno = actuation
+            self.diags.append(Diagnostic.make(
+                "HVD704",
+                f"actuation `{name}(...)` at line {lineno} precedes "
+                f"the first durable ledger/journal write (line "
+                f"{durable_line}) in `{func_node.name}` — a crash in "
+                "the window strands an effect the recovery protocol "
+                "cannot see (ledger-before-actuation, "
+                "fleet/ledger.py)",
+                file=self.filename, line=lineno,
+                hint="write the lease/journal state first, actuate "
+                     "second — recovery replays resume_action() from "
+                     "the ledger; hvd-model minimizes the crash "
+                     "interleaving (docs/modelcheck.md); suppress "
+                     "with `# hvd-lint: disable=HVD704` where the "
+                     "early call is not an actuation; " + _DOC_HINT))
+
+    def _check_unfenced_put(self, call):
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "put"):
+            return
+        recv = self._dotted(func.value)
+        if not recv or not recv.split(".")[-1].endswith("server"):
+            return
+        if len(call.args) < 3:
+            return      # backend .put(key, value) shims, not KV writes
+        if any(kw.arg == "term" for kw in call.keywords):
+            return
+        self.diags.append(Diagnostic.make(
+            "HVD705",
+            f"`{recv}.put(...)` writes KV state without a `term=` "
+            "fence in a protocol module — a resurrected stale primary "
+            "could mutate cohort state after a newer term took over "
+            "(split-brain; journal_spec.term_fences)",
+            file=self.filename, line=call.lineno,
+            hint="pass term= (runner/http_server.py rejects stale "
+                 "writers with 409); hvd-model's `skip_fence` seeded "
+                 "bug shows the interleaving (docs/modelcheck.md); "
+                 "suppress with `# hvd-lint: disable=HVD705` for "
+                 "stores that are never HA-replicated; " + _DOC_HINT))
+
+    def run(self, tree):
         self._walk(tree.body, self._ctx_file)
         return self.diags
 
@@ -1434,24 +1637,16 @@ class _SilentDegradationAnalyzer:
             if isinstance(node, ast.ClassDef):
                 node_ctx = ctx or bool(
                     self._CTX_CLASS_RE.search(node.name))
-            elif isinstance(node, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                node_ctx = ctx or bool(
-                    self._CTX_FUNC_RE.search(node.name))
-            if node_ctx and isinstance(node, ast.Try):
-                for handler in node.handlers:
-                    spelled = self._transport_type(handler.type)
-                    if spelled and not self._handler_observes(handler):
-                        self._report(handler, spelled)
-            for field in ("body", "orelse", "finalbody", "handlers"):
-                children = getattr(node, field, None)
-                if not children:
-                    continue
-                if field == "handlers":
-                    for h in children:
-                        self._walk(h.body, node_ctx)
-                else:
-                    self._walk(children, node_ctx)
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and node_ctx:
+                self._check_function(node)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        self._check_unfenced_put(sub)
+                continue
+            body = getattr(node, "body", None)
+            if isinstance(body, list):
+                self._walk(body, node_ctx)
 
 
 class _HandRollResharding:
@@ -1945,6 +2140,106 @@ def check_knob_docs(doc_path):
     return diags
 
 
+_DOC_METRIC_RE = re.compile(r"^\|\s*`(hvd_[a-z0-9_]+)`\s*\|\s*"
+                            r"([a-z]+)\s*\|")
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+#: The metric families the serving/fleet registries own — the drift
+#: check is scoped to them so rows registered elsewhere (coordinator,
+#: elastic, ...) stay out of scope.
+_METRIC_PREFIXES = ("hvd_serving_", "hvd_fleet_")
+
+
+def _registered_metrics(source_paths):
+    """``name -> (kind, file, line)`` scraped from
+    ``telemetry.counter/gauge/histogram("name", ...)`` calls in the
+    metric factory modules."""
+    out = {}
+    for path in source_paths:
+        try:
+            _, tree = parse_cached(path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in _METRIC_FACTORIES):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                out.setdefault(first.value,
+                               (func.attr, path, node.lineno))
+    return out
+
+
+def check_metric_docs(doc_path, source_paths=None):
+    """Cross-check the serving/fleet metric registries
+    (``serving/metrics.py``, ``fleet/metrics.py``) against the table
+    rows of ``docs/metrics.md``: every registered metric needs a
+    documented row, every documented ``hvd_serving_*``/``hvd_fleet_*``
+    row needs a registration, and the documented type column must match
+    the registered factory (rule HVD307 — the registry is the docs'
+    source of truth). Returns a list of :class:`Diagnostic`."""
+    if source_paths is None:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        source_paths = [os.path.join(pkg, "serving", "metrics.py"),
+                        os.path.join(pkg, "fleet", "metrics.py")]
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError as exc:
+        return [Diagnostic.make(
+            "HVD307", f"cannot read metric docs: {exc}",
+            file=doc_path)]
+    documented = {}
+    for lineno, line in enumerate(lines, start=1):
+        m = _DOC_METRIC_RE.match(line.strip())
+        if m and m.group(1).startswith(_METRIC_PREFIXES):
+            documented.setdefault(m.group(1), (lineno, m.group(2)))
+    registered = {
+        name: rec
+        for name, rec in _registered_metrics(source_paths).items()
+        if name.startswith(_METRIC_PREFIXES)}
+    diags = []
+    for name in sorted(set(documented) & set(registered)):
+        doc_line, doc_kind = documented[name]
+        reg_kind, _, _ = registered[name]
+        if doc_kind != reg_kind:
+            diags.append(Diagnostic.make(
+                "HVD307",
+                f"metric {name}: documented type {doc_kind!r} "
+                f"disagrees with the registered factory "
+                f"{reg_kind!r}",
+                file=doc_path, line=doc_line,
+                hint="align the docs row and the telemetry factory "
+                     "call; " + _DOC_HINT))
+    for name in sorted(set(registered) - set(documented)):
+        _, src_file, src_line = registered[name]
+        diags.append(Diagnostic.make(
+            "HVD307",
+            f"metric {name} is registered in "
+            f"{os.path.basename(src_file)} but has no table row in "
+            f"{os.path.basename(doc_path)}",
+            file=src_file, line=src_line,
+            hint=f"add a `{name}` row to docs/metrics.md (or drop "
+                 "the factory); " + _DOC_HINT))
+    for name in sorted(set(documented) - set(registered)):
+        diags.append(Diagnostic.make(
+            "HVD307",
+            f"metric {name} is documented but not registered in the "
+            "serving/fleet metric modules — nothing emits it, so the "
+            "row is stale",
+            file=doc_path, line=documented[name][0],
+            hint="register it through telemetry.counter/gauge/"
+                 "histogram (or drop the row); " + _DOC_HINT))
+    return diags
+
+
 def _apply_suppressions(diags, src):
     lines = src.splitlines()
     file_off = set()
@@ -2013,6 +2308,7 @@ def _lint_tree(src, tree, filename):
     diags.extend(_RequestBufferAnalyzer(filename).run(tree))
     diags.extend(_WorkerLifecycleAnalyzer(filename).run(tree))
     diags.extend(_SilentDegradationAnalyzer(filename).run(tree))
+    diags.extend(_ProtocolOrderAnalyzer(filename).run(tree))
     diags.extend(_HandRollResharding(filename).run(tree))
     diags.extend(_ConcurrencyAnalyzer(filename).run(tree))
     diags = _apply_suppressions(diags, src)

@@ -90,6 +90,13 @@ def _default_knob_docs():
     return path if os.path.isfile(path) else None
 
 
+def _default_metric_docs():
+    """docs/metrics.md next to the package; None when absent."""
+    path = os.path.join(os.path.dirname(_package_dir()), "docs",
+                        "metrics.md")
+    return path if os.path.isfile(path) else None
+
+
 def _build_parser():
     parser = argparse.ArgumentParser(
         prog="hvd-lint",
@@ -126,6 +133,14 @@ def _build_parser():
     parser.add_argument("--knobs-md", default="", metavar="PATH",
                         help="knob docs to cross-check against "
                              "(default: the repo's docs/knobs.md)")
+    parser.add_argument("--check-metrics", action="store_true",
+                        help="cross-check the serving/fleet metric "
+                             "registries against docs/metrics.md "
+                             "(HVD307); with no paths given, runs "
+                             "only the cross-check")
+    parser.add_argument("--metrics-md", default="", metavar="PATH",
+                        help="metric docs to cross-check against "
+                             "(default: the repo's docs/metrics.md)")
     parser.add_argument("--baseline", default="", metavar="FILE",
                         help="fail only on findings NOT recorded in "
                              "FILE (default: the HVDTPU_LINT_BASELINE "
@@ -248,6 +263,8 @@ def main(argv=None):
     # the file expects it to be read.
     check_knobs = (args.check_knobs or args.self_sweep
                    or bool(args.knobs_md))
+    check_metrics = (args.check_metrics or args.self_sweep
+                     or bool(args.metrics_md))
     paths = list(args.paths)
     if args.self_sweep:
         paths = [_package_dir()]
@@ -255,9 +272,11 @@ def main(argv=None):
         perf = True   # the perf leg rides the same corpus — HVD6xx
         if fail_on == "error":
             fail_on = "warning"
-    elif not paths and not check_knobs and not args.calibrate:
+    elif not paths and not (check_knobs or check_metrics) \
+            and not args.calibrate:
         paths = ["."]
-    # `hvd-lint --check-knobs` with no paths runs ONLY the cross-check.
+    # `hvd-lint --check-knobs`/`--check-metrics` with no paths runs
+    # ONLY the cross-check(s).
 
     table, ranks = None, None
     if perf:
@@ -323,6 +342,19 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
 
+    if check_metrics:
+        # Same tolerance contract as the knob cross-check: implicit
+        # (--self) skips silently when the docs are absent, explicit
+        # --check-metrics must not report green on nothing.
+        doc_path = args.metrics_md or _default_metric_docs()
+        if doc_path:
+            diags.extend(ast_lint.check_metric_docs(doc_path))
+        elif args.check_metrics or args.metrics_md:
+            print("hvd-lint: no metric docs found (no docs/metrics.md "
+                  "next to the package); pass --metrics-md PATH",
+                  file=sys.stderr)
+            return 2
+
     only = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
     if only:
         diags = [d for d in diags if d.rule in only]
@@ -356,8 +388,7 @@ def main(argv=None):
     if args.format == "json":
         print(json.dumps([d.to_dict() for d in diags], indent=1))
     elif args.format == "sarif":
-        print(json.dumps(sarif.to_sarif(diags, suppressed=suppressed),
-                         indent=1, sort_keys=True))
+        sarif.write_sarif(None, diags, suppressed=suppressed)
     else:
         if perf_report is not None:
             report_text = costmodel.render_report(perf_report)

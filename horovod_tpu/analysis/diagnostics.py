@@ -136,6 +136,22 @@ RULES = {
     "HVD305": (WARNING, "thread started with neither daemon=True nor "
                         "a join path"),
     "HVD306": (ERROR, "knob registry and docs/knobs.md disagree"),
+    "HVD307": (ERROR, "metric registry and docs/metrics.md disagree"),
+    # HVD7xx — protocol model checking (hvd-model, docs/modelcheck.md).
+    "HVD701": (ERROR, "protocol safety invariant violated (minimized "
+                      "counterexample attached)"),
+    "HVD702": (ERROR, "protocol liveness goal unreachable under fair "
+                      "scheduling (the protocol wedges once faults "
+                      "stop)"),
+    "HVD703": (WARNING, "model exploration exhausted its "
+                        "depth/state/wall-clock budget before "
+                        "covering the bounded space"),
+    "HVD704": (WARNING, "actuation issued before the durable "
+                        "ledger/journal write in a protocol module "
+                        "(a crash in the window strands the effect)"),
+    "HVD705": (WARNING, "KV/store write without a term= fence inside "
+                        "a protocol module (stale-primary mutations "
+                        "slip the split-brain fence)"),
 }
 
 _SEV_ORDER = {ERROR: 0, WARNING: 1}

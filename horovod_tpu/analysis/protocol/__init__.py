@@ -1,0 +1,29 @@
+"""Control-plane protocol specs + the ``hvd-model`` explicit-state
+checker (docs/modelcheck.md).
+
+The three hand-rolled distributed protocols — the term-fenced HA
+journal (runner/journal.py), the fleet lease ledger (fleet/ledger.py),
+and the KV-migration handshake (serving/migration.py) — keep their
+*pure transition logic* here, as first-class state-machine specs:
+
+- :mod:`journal_spec`   — journal entry application, state digests,
+  the durable-scope partition, and the term-fence predicate.
+- :mod:`lease_spec`     — lease state chains, transition validation,
+  and the promoted-arbiter resume rule (roll forward xor back).
+- :mod:`migration_spec` — chunk packing, inbound staging reassembly,
+  the watermark admission predicate, and refusal classification.
+
+**Spec-is-implementation**: the runtime modules import and execute
+these functions (tests/test_protocol_model.py asserts the delegation
+by identity), so the model the checker explores can never drift from
+shipped code. :mod:`model` is the explicit-state BFS explorer
+(crash/restart, message loss, duplication, reorder injected at every
+step), :mod:`machines` builds the three protocol models (plus their
+seeded-bug mutants for the mutation proof), and :mod:`cli` is the
+``hvd-model`` entry point emitting HVD7xx findings as text/JSON/SARIF.
+
+Everything in the spec modules is stdlib-pure: importing them from the
+runtime costs no jax, no parser stack, no simulator.
+"""
+
+from . import journal_spec, lease_spec, migration_spec  # noqa: F401

@@ -25,6 +25,24 @@ Alongside leases the scope carries:
 import json
 import time
 
+# The lease state machine lives in the protocol spec
+# (spec-is-implementation — analysis/protocol/lease_spec.py is the
+# module the hvd-model checker explores, and this module executes the
+# exact same chain/validation/resume functions;
+# tests/test_protocol_model.py asserts the delegation by identity).
+# This file owns everything impure: backends, journaled writes, terms.
+from ..analysis.protocol.lease_spec import (
+    CHAINS,
+    DIRECTIONS,
+    SERVE_TO_TRAIN,
+    TERMINAL_STATES,
+    TRAIN_TO_SERVE,
+    LeaseStateError,
+    check_transition,
+    next_state,
+    resume_action,
+)
+
 #: The durable KV scope (runner/journal.py DURABLE_SCOPES).
 SCOPE = "fleet"
 ACTIVE_KEY = "active"
@@ -32,67 +50,9 @@ SPLIT_KEY = "split"
 LEASE_PREFIX = "lease."
 TRANSFER_PREFIX = "transfer."
 
-TRAIN_TO_SERVE = "train_to_serve"
-SERVE_TO_TRAIN = "serve_to_train"
-DIRECTIONS = (TRAIN_TO_SERVE, SERVE_TO_TRAIN)
-
-#: Per-direction state chains. ``rolled_back`` is reachable only from
-#: ``proposed`` (nothing actuated yet); every later state rolls
-#: forward — the transfer state machine in docs/fault_tolerance.md.
-CHAINS = {
-    TRAIN_TO_SERVE: ("proposed", "preempting", "resharding",
-                     "activating", "complete"),
-    SERVE_TO_TRAIN: ("proposed", "draining", "returning", "complete"),
-}
-TERMINAL_STATES = ("complete", "rolled_back")
-
-
-class LeaseStateError(RuntimeError):
-    """An illegal lease transition was attempted; the message names
-    the lease, its state, and the requested state."""
-
-
-def next_state(direction, state):
-    """The successor of ``state`` on ``direction``'s chain (None at
-    the end)."""
-    chain = CHAINS[direction]
-    idx = chain.index(state)
-    return chain[idx + 1] if idx + 1 < len(chain) else None
-
-
-def resume_action(lease):
-    """What a freshly-promoted arbiter must do with a recovered
-    in-flight lease: ``None`` (terminal — nothing), ``"rollback"``
-    (``proposed`` — the ledger won the race, no actuation happened),
-    or ``"roll_forward"`` (re-issue the current state's idempotent
-    actuation and keep going)."""
-    state = lease["state"]
-    if state in TERMINAL_STATES:
-        return None
-    if state == "proposed":
-        return "rollback"
-    return "roll_forward"
-
-
-def _check_transition(lease, state):
-    direction = lease["direction"]
-    current = lease["state"]
-    if state == "rolled_back":
-        if current != "proposed":
-            raise LeaseStateError(
-                f"lease {lease['id']}: cannot roll back from "
-                f"{current!r} — actuation may have started; roll "
-                "forward instead")
-        return
-    chain = CHAINS[direction]
-    if state not in chain:
-        raise LeaseStateError(
-            f"lease {lease['id']}: {state!r} is not a {direction} "
-            f"state (chain: {' -> '.join(chain)})")
-    if state != next_state(direction, current):
-        raise LeaseStateError(
-            f"lease {lease['id']}: illegal transition "
-            f"{current!r} -> {state!r} (chain: {' -> '.join(chain)})")
+# Compatibility alias: the validator predates the spec split and was
+# module-private here.
+_check_transition = check_transition
 
 
 # --------------------------------------------------------------------------

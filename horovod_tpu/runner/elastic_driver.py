@@ -396,7 +396,8 @@ class ElasticDriver:
         host, _, port = chunk.rpartition(":")
         observed = http_client.probe_term(host, port, token=self.token,
                                           timeout=1)
-        if observed is not None and observed > self.term:
+        if observed is not None and journal_mod.term_fences(self.term,
+                                                            observed):
             raise journal_mod.StaleTermError(
                 f"term probe of standby {chunk}", self.term, observed)
 

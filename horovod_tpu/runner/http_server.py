@@ -86,9 +86,10 @@ class _KVStoreHandler(BaseHTTPRequestHandler):
             req_term = int(raw)
         except ValueError:
             return True
+        from .journal import term_fences
         with self.server.store_lock:
             cur = self.server.term
-            if req_term < cur:
+            if term_fences(req_term, cur):
                 stale = True
             else:
                 stale = False
@@ -368,8 +369,8 @@ class KVStoreServer:
         if writer_term is None:
             return
         cur = self._httpd.term
-        if writer_term < cur:
-            from .journal import StaleTermError
+        from .journal import StaleTermError, term_fences
+        if term_fences(writer_term, cur):
             raise StaleTermError(mutation, writer_term, cur)
         if writer_term > cur:
             self._httpd.term = writer_term

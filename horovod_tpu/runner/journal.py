@@ -33,32 +33,32 @@ resurrected stale primary whose server has observed a higher term gets
 the cohort (docs/fault_tolerance.md "Split-brain fencing").
 """
 
-import hashlib
 import json
 import os
 import threading
 
+# The journal's state machine lives in the protocol spec
+# (spec-is-implementation — analysis/protocol/journal_spec.py is the
+# module the hvd-model checker explores, and this module executes the
+# exact same functions; tests/test_protocol_model.py asserts the
+# delegation by identity). This file owns everything impure: files,
+# fsync, locks, telemetry.
+from ..analysis.protocol.journal_spec import (
+    DURABLE_SCOPES,
+    JournalError,
+    apply_entry,
+    durable_key,
+    new_state,
+    state_digest,
+    term_fences,
+)
 from ..telemetry import core as telemetry
 from ..utils.logging_util import get_logger
 
 JOURNAL_FILE = "journal.jsonl"
 SNAPSHOT_FILE = "snapshot.json"
 
-#: KV scopes replicated through the journal (everything else is
-#: ephemeral and re-published by workers after a failover). The
-#: ``fleet`` scope holds the chip-budget arbiter's lease ledger
-#: (fleet/ledger.py): a lease must be durable *before* any actuation
-#: it authorises, so a standby promotion mid-transfer can resume or
-#: roll it back deterministically (docs/fault_tolerance.md "Fleet
-#: arbitration").
-DURABLE_SCOPES = ("elastic.state", "elastic.exit", "fleet")
-
 DEFAULT_SNAPSHOT_EVERY = 256
-
-
-class JournalError(RuntimeError):
-    """A journal file could not be read or an entry could not be
-    applied; the message names the file/entry."""
 
 
 class StaleTermError(RuntimeError):
@@ -73,76 +73,6 @@ class StaleTermError(RuntimeError):
             "this driver is stale and must not mutate cohort state")
         self.writer_term = writer_term
         self.observed_term = observed_term
-
-
-def durable_key(scope, key):
-    """True when a worker-written KV key belongs to the durable
-    partition (journaled; survives failover)."""
-    del key
-    return scope in DURABLE_SCOPES
-
-
-def new_state():
-    """Empty driver state — the single replicated structure."""
-    return {
-        "term": 0,
-        "version": -1,
-        "rank_order": [],
-        "workers": {},       # wid -> {"host": h, "slot": i}
-        "blacklist": [],     # sorted host list
-        "fail_counts": {},
-        "resets": 0,
-        "kv": {},            # durable scopes only: scope -> {key: str}
-    }
-
-
-def apply_entry(state, entry):
-    """Apply one journal entry to a state dict (pure state transition —
-    shared by the primary's bookkeeping, crash recovery, and the
-    standby replica, so the three can never drift)."""
-    op = entry.get("op")
-    if op == "membership":
-        state["version"] = entry["version"]
-        state["rank_order"] = list(entry["rank_order"])
-        state["workers"] = {w: dict(rec)
-                            for w, rec in entry["workers"].items()}
-        state["resets"] = entry.get("resets", state["resets"])
-        # The assignment table IS durable KV state: a promoted standby
-        # re-serves the same version so the running cohort never
-        # re-rendezvouses for a takeover alone.
-        kv = state["kv"]
-        for scope in [s for s in kv if s.startswith("assign.")]:
-            del kv[scope]
-        kv[f"assign.{entry['version']}"] = dict(entry["assign"])
-        kv.setdefault("elastic", {})["version"] = str(entry["version"])
-    elif op == "fail_count":
-        state["fail_counts"][entry["host"]] = entry["count"]
-        if entry.get("blacklisted"):
-            bl = set(state["blacklist"])
-            bl.add(entry["host"])
-            state["blacklist"] = sorted(bl)
-    elif op == "kv_put":
-        state["kv"].setdefault(entry["scope"], {})[entry["key"]] = \
-            entry["value"]
-    elif op == "kv_delete":
-        state["kv"].get(entry["scope"], {}).pop(entry["key"], None)
-    elif op == "kv_clear":
-        state["kv"].pop(entry["scope"], None)
-    elif op == "term":
-        state["term"] = entry["term"]
-    else:
-        raise JournalError(f"journal entry seq={entry.get('seq')} has "
-                           f"unknown op {op!r}")
-    if entry.get("term", 0) > state["term"]:
-        state["term"] = entry["term"]
-    return state
-
-
-def state_digest(state):
-    """Canonical SHA-256 over the state — the acceptance check that a
-    journal-replayed standby equals the pre-failover primary."""
-    blob = json.dumps(state, sort_keys=True, separators=(",", ":"))
-    return hashlib.sha256(blob.encode()).hexdigest()
 
 
 def _m_bytes():
@@ -416,5 +346,5 @@ class JournalReplica:
 
 __all__ = ["DriverJournal", "JournalReplica", "JournalError",
            "StaleTermError", "DURABLE_SCOPES", "durable_key",
-           "new_state", "apply_entry", "state_digest", "replay",
-           "read_dir"]
+           "term_fences", "new_state", "apply_entry", "state_digest",
+           "replay", "read_dir"]

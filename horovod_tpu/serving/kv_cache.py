@@ -37,6 +37,11 @@ import threading
 
 import numpy as np
 
+# The watermark admission predicate is the protocol spec's (one
+# function for prefill admission, import placement, and the hvd-model
+# checker's invariant; tests/test_protocol_model.py asserts the
+# delegation).
+from ..analysis.protocol.migration_spec import admits
 from . import metrics as _m
 
 #: Default reserve fraction: admission keeps 1/16 of the pool free.
@@ -111,8 +116,8 @@ class PagePool:
         """Watermark admission check: would allocating ``tokens`` worth
         of pages keep the reserve intact?"""
         with self._lock:
-            return (len(self._free) - self.pages_needed(tokens)
-                    >= self.watermark)
+            return admits(len(self._free), self.pages_needed(tokens),
+                          self.watermark)
 
     # -- alloc/free --------------------------------------------------------
     def alloc(self, n):
@@ -136,7 +141,7 @@ class PagePool:
         another allocator into the reserve."""
         n = int(n)
         with self._lock:
-            if len(self._free) - n < self.watermark:
+            if not admits(len(self._free), n, self.watermark):
                 raise NoHeadroom(
                     f"import needs {n} pages but only "
                     f"{len(self._free)} free over a watermark of "

@@ -42,6 +42,13 @@ import time
 import urllib.error
 
 from .. import chaos
+# The migration handshake's state machine lives in the protocol spec
+# (spec-is-implementation — analysis/protocol/migration_spec.py is the
+# module the hvd-model checker explores, and this module executes the
+# exact same chunking/staging/refusal functions;
+# tests/test_protocol_model.py asserts the delegation). This file owns
+# everything impure: sockets, retries, locks, the real clock, metrics.
+from ..analysis.protocol import migration_spec
 from ..exceptions import ChaosInjectedError
 from ..utils import envparse
 from ..utils.logging_util import get_logger
@@ -101,23 +108,9 @@ def _parse_url(url):
     return host, int(port or 80)
 
 
-def chunk_pages(pages, max_bytes):
-    """Greedily pack page entries into chunks whose encoded payload
-    stays under ``max_bytes`` (at least one page per chunk — a single
-    page past the bound still ships and the target's 413 makes the
-    overflow loud). Always returns >= 1 chunk so a pageless (cold)
-    record still carries its commit metadata."""
-    max_bytes = int(max_bytes)
-    chunks, cur, size = [], [], 0
-    for pg in pages:
-        sz = len(pg.get("payload", "")) + 128   # +json framing slack
-        if cur and size + sz > max_bytes:
-            chunks.append(cur)
-            cur, size = [], 0
-        cur.append(pg)
-        size += sz
-    chunks.append(cur)
-    return chunks
+#: Greedy page packing — the spec function, re-exported for the wire
+#: layer and tests.
+chunk_pages = migration_spec.chunk_pages
 
 
 def _corrupt_payload(pages):
@@ -222,38 +215,17 @@ class InboundStaging:
     def offer(self, payload):
         """Stage one chunk; the assembled record when the migration is
         complete, else None. Raises KeyError/ValueError on a malformed
-        chunk and :class:`StagingFull` at the bound."""
-        mid = str(payload["mid"])
-        chunk = int(payload["chunk"])
-        total = int(payload["total"])
-        if total < 1 or not 0 <= chunk < total:
-            raise ValueError(f"chunk {chunk} outside total {total}")
-        now = time.monotonic()
+        chunk and :class:`StagingFull` at the bound. The transition
+        itself is migration_spec.stage_chunk — this wrapper adds the
+        lock and the real clock."""
         with self._lock:
-            for stale in [m for m, e in self._entries.items()
-                          if now - e["t"] > self.ttl_s]:
-                del self._entries[stale]
-            entry = self._entries.get(mid)
-            if entry is None:
-                if len(self._entries) >= self.max_staged:
-                    raise StagingFull(
-                        f"{len(self._entries)} inbound migrations "
-                        f"already staged")
-                entry = {"chunks": {}, "total": total, "meta": None,
-                         "t": now}
-                self._entries[mid] = entry
-            entry["t"] = now
-            entry["chunks"][chunk] = list(payload.get("pages", []))
-            if payload.get("meta") is not None:
-                entry["meta"] = dict(payload["meta"])
-            if (entry["meta"] is None
-                    or len(entry["chunks"]) < entry["total"]):
-                return None
-            del self._entries[mid]
-        record = dict(entry["meta"])
-        record["pages"] = [pg for i in sorted(entry["chunks"])
-                           for pg in entry["chunks"][i]]
-        return record
+            try:
+                return migration_spec.stage_chunk(
+                    self._entries, payload,
+                    max_staged=self.max_staged, ttl_s=self.ttl_s,
+                    now=time.monotonic())
+            except migration_spec.StagingLimit as exc:
+                raise StagingFull(str(exc)) from exc
 
     def depth(self):
         with self._lock:
@@ -330,18 +302,13 @@ class Migrator:
             try:
                 body = migrate_out(url, record, token=self.token)
             except MigrationRefused as e:
-                outcome = {"no_headroom": "no_headroom",
-                           "version_fenced": "version_fence",
-                           "digest_mismatch": "digest_mismatch",
-                           "geometry_mismatch": "digest_mismatch",
-                           "too_large": "refused",
-                           "draining": "no_headroom"}.get(
-                               e.outcome, "refused")
+                outcome, try_next = migration_spec.classify_refusal(
+                    e.outcome)
                 _m.migrations_total(outcome).inc()
                 self._log.warning(
                     "serving migrate: peer %s refused %s (%s)",
                     url, record.get("id"), e)
-                if e.outcome in ("no_headroom", "draining"):
+                if try_next:
                     continue          # structural: another peer may fit
                 return None           # payload/version: fallback now
             except TimeoutError as e:

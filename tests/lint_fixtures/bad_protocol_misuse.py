@@ -1,0 +1,37 @@
+"""HVD704/HVD705 fixture: protocol-ordering misuse.
+
+Two positives (an actuation before the durable ledger write inside an
+arbiter class; an unfenced KV ``server.put``), two negatives (the
+correct ledger-before-actuation order; a fenced put), one suppression.
+"""
+
+
+class LeaseArbiter:
+    def __init__(self, ledger, actuators, server):
+        self.ledger = ledger
+        self.actuators = actuators
+        self.server = server
+
+    def advance_badly(self, lease, nxt, slots):
+        # Positive: the actuation lands before the ledger write — a
+        # crash in between strands an effect recovery cannot see.
+        self.actuators.set_serve_slots(slots)  # HVD704
+        self.ledger.advance(lease, nxt)
+
+    def advance_correctly(self, lease, nxt, slots):
+        # Negative: durable write first, idempotent actuation second.
+        self.ledger.advance(lease, nxt)
+        self.actuators.set_serve_slots(slots)
+
+    def publish_badly(self, scope, key, value):
+        # Positive: a KV write with no term fence — a stale primary
+        # can mutate cohort state after a newer term took over.
+        self.server.put(scope, key, value)  # HVD705
+
+    def publish_correctly(self, scope, key, value, term):
+        # Negative: the write carries its writer term.
+        self.server.put(scope, key, value, term=term)
+
+    def publish_local(self, scope, key, value):
+        # Suppressed: this store is never HA-replicated.
+        self.server.put(scope, key, value)  # hvd-lint: disable=HVD705

@@ -68,3 +68,30 @@ class FleetProbe:
             return sock.recv(1)
         except BrokenPipeError:  # hvd-lint: disable=HVD213
             return b""
+
+
+def handle_with_retries(client, attempts):
+    # Negative (regression: used to false-positive): the retry-ladder
+    # idiom defers the re-raise past the last attempt — the handler
+    # stashes the bound exception and the function raises it after the
+    # loop, so nothing is swallowed.
+    last = None
+    for _ in range(attempts):
+        try:
+            return client.fetch()
+        except OSError as e:
+            last = e
+    raise last
+
+
+def handle_with_wrapped_retries(client, attempts):
+    # Negative: same ladder, re-raised through a wrapper with the
+    # stashed error as its cause.
+    last = None
+    for _ in range(attempts):
+        try:
+            return client.fetch()
+        except ConnectionError as exc:
+            failure = exc
+            last = failure
+    raise TimeoutError(f"all {attempts} attempts failed") from last

@@ -499,6 +499,55 @@ class TestAstRules:
         assert severity == WARNING
         assert "transport" in title
 
+    def test_deferred_reraise_retry_ladder_is_clean(self):
+        # Regression (false positive): a retry ladder that stores the
+        # exception and re-raises it after the loop DOES observe the
+        # error — the raise is just deferred past the last attempt.
+        src = ("class KvClient:\n"
+               "    def call(self, req):\n"
+               "        last = None\n"
+               "        for _ in range(3):\n"
+               "            try:\n"
+               "                return self._send(req)\n"
+               "            except OSError as e:\n"
+               "                last = e\n"
+               "        raise last\n")
+        assert ast_lint.lint_source(
+            src, filename="horovod_tpu/serving/client.py") == []
+
+    def test_deferred_reraise_via_alias_chain_and_cause(self):
+        # The stored name may be re-aliased, and the eventual raise may
+        # wrap it as __cause__ — still observed.
+        src = ("class KvClient:\n"
+               "    def call(self, req):\n"
+               "        last = None\n"
+               "        for _ in range(3):\n"
+               "            try:\n"
+               "                return self._send(req)\n"
+               "            except ConnectionError as exc:\n"
+               "                failure = exc\n"
+               "                last = failure\n"
+               "        raise TimeoutError('kv retries exhausted')"
+               " from last\n")
+        assert ast_lint.lint_source(
+            src, filename="horovod_tpu/serving/client.py") == []
+
+    def test_stored_but_never_reraised_is_still_flagged(self):
+        # Storing the exception without ever raising it is the silent
+        # swallow the rule exists for.
+        src = ("class KvClient:\n"
+               "    def call(self, req):\n"
+               "        last = None\n"
+               "        for _ in range(3):\n"
+               "            try:\n"
+               "                return self._send(req)\n"
+               "            except OSError as e:\n"
+               "                last = e\n"
+               "        return None\n")
+        diags = ast_lint.lint_source(
+            src, filename="horovod_tpu/serving/client.py")
+        assert rules_of(diags) == ["HVD213"]
+
     def test_loop_invariant_allreduce_is_clean(self):
         # One metric per epoch is not the per-tensor-reduction shape.
         src = ("import horovod_tpu as hvd\n"
@@ -591,6 +640,117 @@ class TestAstRules:
                "if hvd.rank() == 0:\n"
                "    hvd.barrier()\n")
         assert ast_lint.lint_source(src) == []
+
+
+# ==========================================================================
+# HVD704/705: control-plane protocol-order rules (the model checker's
+# static companions — hvd-model proves the ordering matters, these
+# catch the shape at the AST)
+# ==========================================================================
+class TestProtocolOrderRules:
+    def lint(self, name):
+        return ast_lint.lint_file(os.path.join(FIXTURES, name))
+
+    def test_fixture_positives_and_lines(self):
+        diags = self.lint("bad_protocol_misuse.py")
+        assert [(d.rule, d.line) for d in diags] == [
+            ("HVD704", 18), ("HVD705", 29)]
+
+    def test_actuation_before_ledger_message(self):
+        diags = [d for d in self.lint("bad_protocol_misuse.py")
+                 if d.rule == "HVD704"]
+        assert "set_serve_slots" in diags[0].message
+        assert "ledger" in diags[0].message.lower()
+
+    def test_correct_order_and_fenced_put_are_clean(self):
+        # The negatives in the same fixture: ledger-first ordering and
+        # the term= kwarg each silence their rule (asserted via the
+        # exact positive list above), plus the suppression comment.
+        diags = self.lint("bad_protocol_misuse.py")
+        flagged_lines = {d.line for d in diags}
+        assert 23 not in flagged_lines   # advance_correctly
+        assert 33 not in flagged_lines   # publish_correctly
+        assert 37 not in flagged_lines   # hvd-lint: disable=HVD705
+
+    def test_outside_protocol_context_is_clean(self):
+        # Same shapes in a class whose name/path has no arbiter/ledger
+        # /journal/lease context: not a finding.
+        src = ("class BatchWriter:\n"
+               "    def flush(self, rows):\n"
+               "        self.sink.put('scope', 'key', rows)\n")
+        assert ast_lint.lint_source(src) == []
+
+    def test_shipped_control_plane_is_clean(self):
+        import glob
+        hits = []
+        for pkg in ("fleet", "runner", "serving"):
+            pat = os.path.join(REPO, "horovod_tpu", pkg, "*.py")
+            for path in sorted(glob.glob(pat)):
+                hits += [d for d in ast_lint.lint_file(path)
+                         if d.rule in ("HVD704", "HVD705")]
+        assert hits == [], [(d.file, d.line) for d in hits]
+
+    def test_rules_in_catalog(self):
+        from horovod_tpu.analysis.diagnostics import RULES, WARNING
+        for rule in ("HVD704", "HVD705"):
+            severity, _ = RULES[rule]
+            assert severity == WARNING
+
+
+# ==========================================================================
+# HVD307: metric registry <-> docs/metrics.md cross-check
+# ==========================================================================
+class TestMetricDocs:
+    METRICS_MD = os.path.join(REPO, "docs", "metrics.md")
+
+    def test_shipped_docs_match_registrations(self):
+        diags = ast_lint.check_metric_docs(self.METRICS_MD)
+        assert diags == [], "\n".join(d.format() for d in diags)
+
+    def test_detects_drift_both_ways_and_kind_mismatch(self, tmp_path):
+        sources = [
+            os.path.join(REPO, "horovod_tpu", "serving", "metrics.py"),
+            os.path.join(REPO, "horovod_tpu", "fleet", "metrics.py")]
+        registered = {
+            name: rec
+            for name, rec in
+            ast_lint._registered_metrics(sources).items()
+            if name.startswith(("hvd_serving_", "hvd_fleet_"))}
+        assert registered, "metric scrape found nothing — broken"
+        doc = tmp_path / "metrics.md"
+        rows = []
+        skipped = None
+        for name in sorted(registered):
+            kind = registered[name][0]
+            if skipped is None:
+                skipped = name          # registered, undocumented
+                continue
+            if name.endswith("_total") and kind == "counter":
+                kind = "gauge"          # kind mismatch
+            rows.append(f"| `{name}` | {kind} | — | x |")
+        rows.append("| `hvd_serving_imaginary_total` | counter | — |"
+                    " x |")                # documented, unregistered
+        doc.write_text("\n".join(rows) + "\n")
+        diags = ast_lint.check_metric_docs(str(doc))
+        assert all(d.rule == "HVD307" for d in diags)
+        msgs = " ".join(d.message for d in diags)
+        assert skipped in msgs
+        assert "hvd_serving_imaginary_total" in msgs
+        assert "counter" in msgs and "gauge" in msgs
+
+    def test_registration_findings_anchor_at_source(self, tmp_path):
+        doc = tmp_path / "metrics.md"
+        doc.write_text("")          # everything is undocumented
+        diags = ast_lint.check_metric_docs(str(doc))
+        assert diags
+        anchored = [d for d in diags if d.file.endswith("metrics.py")]
+        assert anchored and all(d.line > 0 for d in anchored)
+
+    def test_hvd307_in_catalog(self):
+        from horovod_tpu.analysis.diagnostics import ERROR, RULES
+        severity, title = RULES["HVD307"]
+        assert severity == ERROR
+        assert "metric" in title
 
 
 def test_clean_sweep_examples_and_models():
@@ -919,6 +1079,66 @@ class TestSarifOutput:
         # a NEW finding carries no suppressions key at all
         doc = sarif_mod.to_sarif([d])
         assert "suppressions" not in doc["runs"][0]["results"][0]
+
+    # -- the unified writer + artifact validator ---------------------------
+    def _diag(self, rule="HVD401", file="x.py", line=3):
+        return Diagnostic.make(rule, "msg", file=file, line=line)
+
+    def test_write_sarif_tool_param_reaches_driver_name(self, capsys):
+        sarif_mod.write_sarif(None, [self._diag("HVD701")],
+                              tool="hvd-model")
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["runs"][0]["tool"]["driver"]["name"] == "hvd-model"
+        assert doc["version"] == "2.1.0"
+
+    def test_write_sarif_file_and_stdout_encode_identically(
+            self, tmp_path, capsys):
+        """One canonical encoding for every CI artifact: same bytes to
+        a file as to stdout."""
+        path = str(tmp_path / "out.sarif")
+        sarif_mod.write_sarif(path, [self._diag()])
+        sarif_mod.write_sarif("-", [self._diag()])
+        assert capsys.readouterr().out == open(path).read()
+
+    def test_validate_passes_a_sound_artifact(self):
+        doc = sarif_mod.to_sarif([self._diag("HVD401"),
+                                  self._diag("HVD402")])
+        assert sarif_mod.validate(
+            doc, require_rules=["HVD401", "HVD402"],
+            require_families=["HVD4"],
+            forbid_locations=["clean_code"]) == []
+
+    def test_validate_names_every_problem(self):
+        doc = sarif_mod.to_sarif([self._diag("HVD401",
+                                             file="bad_sim_x.py")])
+        problems = sarif_mod.validate(
+            doc, require_rules=["HVD999"], require_families=["HVD5"],
+            require_flows=[("HVD401", 2)],
+            forbid_locations=["bad_sim"])
+        text = " ".join(problems)
+        assert "HVD999" in text          # missing rule
+        assert "HVD5*" in text           # missing family
+        assert "threadFlows" in text     # flowless result
+        assert "forbidden location" in text
+
+    def test_validate_expect_none_ignores_suppressed(self):
+        doc = sarif_mod.to_sarif([], suppressed=[self._diag()])
+        assert sarif_mod.validate(doc, expect_none=True) == []
+        doc = sarif_mod.to_sarif([self._diag()])
+        problems = sarif_mod.validate(doc, expect_none=True)
+        assert problems and "expected a clean artifact" in problems[0]
+
+    def test_validator_cli_exit_codes(self, tmp_path, capsys):
+        path = str(tmp_path / "a.sarif")
+        sarif_mod.write_sarif(path, [self._diag("HVD401")])
+        assert sarif_mod.main([path, "--require-rule", "HVD401"]) == 0
+        out = capsys.readouterr().out
+        assert "ok (1 result(s), tool hvd-lint)" in out
+        assert sarif_mod.main([path, "--require-rule", "HVD999"]) == 1
+        assert "HVD999" in capsys.readouterr().err
+        assert sarif_mod.main(
+            [str(tmp_path / "missing.sarif")]) == 2
+        capsys.readouterr()
 
 
 # ==========================================================================
@@ -1622,6 +1842,79 @@ class TestOrderGuard:
         assert data["count"] == 2
         assert [e["name"] for e in data["sequence"]] == ["alpha", "beta"]
         assert data["sequence"][0]["site"] == "train.py:10 (main)"
+
+    def test_mixed_checkpoint_every_is_a_config_error(self):
+        """Differing checkpoint_every across ranks makes checkpoint
+        indices incomparable — a configuration error, not a silent None
+        and not a false divergence."""
+        g0 = SubmissionOrderGuard(rank=0, checkpoint_every=32)
+        g1 = SubmissionOrderGuard(rank=1, checkpoint_every=64)
+        for i in range(64):
+            g0.record(f"t{i}")
+            g1.record(f"t{i}")
+        with pytest.raises(ValueError) as err:
+            SubmissionOrderGuard.compare_payloads(
+                [g0.sync_payload(), g1.sync_payload()])
+        assert "checkpoint_every" in str(err.value)
+        assert "[32, 64]" in str(err.value)
+
+    def test_common_checkpoint_slid_out_of_window(self):
+        """Extreme skew: the laggard's newest checkpoint has already
+        slid out of the leader's bounded window — no comparison this
+        round (None), never a false divergence."""
+        g0 = SubmissionOrderGuard(rank=0, checkpoint_every=4, window=2)
+        g1 = SubmissionOrderGuard(rank=1, checkpoint_every=4, window=2)
+        for i in range(4):      # laggard: only checkpoint index 1
+            g0.record(f"t{i}")
+        for i in range(40):     # leader's window holds indices 9, 10
+            g1.record(f"t{i}")
+        assert SubmissionOrderGuard.compare_payloads(
+            [g0.sync_payload(), g1.sync_payload()]) is None
+
+    def test_divergence_names_rank_groups_and_window(self):
+        """The error partitions ranks by digest (so the odd rank out is
+        identifiable in a 3-rank cohort) and bounds the offending
+        submission window."""
+        g0, g1, g2 = (SubmissionOrderGuard(rank=r) for r in range(3))
+        for i in range(64):
+            g0.record(f"t{i}")
+            g2.record(f"t{i}")
+        for i in reversed(range(64)):
+            g1.record(f"t{i}")
+        with pytest.raises(SubmissionOrderError) as err:
+            SubmissionOrderGuard.compare_payloads(
+                [g.sync_payload() for g in (g0, g1, g2)])
+        msg = str(err.value)
+        assert "ranks [0, 2]" in msg and "ranks [1]" in msg
+        assert "first 64 submissions" in msg
+
+    def test_record_cap_sets_truncated(self, tmp_path):
+        """The fixture recorder is bounded: past max_record the hash
+        keeps running (comparison stays exact) but the sequence stops
+        growing and the dump says so."""
+        g = SubmissionOrderGuard(rank=0, record=True, max_record=3)
+        for i in range(5):
+            g.record(f"t{i}")
+        assert g.truncated
+        data = json.loads(open(g.dump(
+            str(tmp_path / "order.json"))).read())
+        assert data["truncated"] is True
+        assert data["count"] == 5
+        assert len(data["sequence"]) == 3
+
+    def test_digest_is_order_sensitive_and_count_tagged(self):
+        g0, g1 = SubmissionOrderGuard(rank=0), SubmissionOrderGuard(rank=1)
+        for n in ("a", "b"):
+            g0.record(n)
+        for n in ("b", "a"):
+            g1.record(n)
+        assert g0.digest() != g1.digest()   # same multiset, diff order
+        g2 = SubmissionOrderGuard(rank=2)
+        for n in ("a", "b"):
+            g2.record(n)
+        assert g0.digest() == g2.digest()
+        g2.record("c")
+        assert g0.digest() != g2.digest()   # count rides the digest
 
 
 # ==========================================================================

@@ -1,0 +1,478 @@
+"""hvd-model: the explicit-state protocol checker.
+
+Four layers, mirroring the checker's own guarantees:
+
+- **spec-is-implementation** — the runtime modules (runner/journal.py,
+  fleet/ledger.py, serving/migration.py, serving/kv_cache.py) must
+  delegate their transition logic to the pure spec modules under
+  analysis/protocol/ by IDENTITY, so exploring the models exercises
+  the exact functions production executes;
+- **explorer semantics** — BFS completeness, budget findings, fair-
+  scheduling liveness, replay/minimize, on toy models small enough to
+  reason about by hand;
+- **mutation proof** — every seeded historical bug yields a minimized
+  counterexample with the expected invariant and trace, while the
+  shipped (bug=None) models explore their full bounded space clean;
+- **rendering/CLI** — violations ride the existing hvd-lint machinery
+  (HVD701/702/703 diagnostics, text counterexamples, SARIF codeFlows)
+  and the ``hvd-model`` entry point honors its exit-code contract.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from horovod_tpu.analysis.protocol import (cli as model_cli, journal_spec,
+                                           lease_spec, machines,
+                                           migration_spec)
+from horovod_tpu.analysis.protocol.model import (Action, Step, explore,
+                                                 minimize, replay,
+                                                 result_diagnostics,
+                                                 violation_diagnostic)
+from horovod_tpu.analysis.simulate import render_trace
+
+
+def labels_of(violation):
+    return [s.label for s in violation.trace]
+
+
+# ==========================================================================
+# Spec-is-implementation: the runtime executes the spec functions
+# ==========================================================================
+class TestSpecIsImplementation:
+    def test_journal_delegates_to_spec(self):
+        from horovod_tpu.runner import journal
+        assert journal.apply_entry is journal_spec.apply_entry
+        assert journal.state_digest is journal_spec.state_digest
+        assert journal.new_state is journal_spec.new_state
+        assert journal.durable_key is journal_spec.durable_key
+        assert journal.term_fences is journal_spec.term_fences
+        assert journal.DURABLE_SCOPES is journal_spec.DURABLE_SCOPES
+        assert journal.JournalError is journal_spec.JournalError
+
+    def test_fence_sites_use_the_spec_predicate(self):
+        """The HTTP write fence and the driver's probe fence must call
+        the ONE fencing predicate the model checks — a re-derived
+        comparison at either site would drift out from under the
+        checker."""
+        import inspect
+
+        from horovod_tpu.runner import elastic_driver, http_server
+        assert "term_fences(" in inspect.getsource(
+            http_server.KVStoreServer._check_write_term)
+        assert "term_fences(" in inspect.getsource(
+            http_server._KVStoreHandler._fence_term)
+        src = inspect.getsource(elastic_driver)
+        assert "term_fences(" in src
+
+    def test_ledger_delegates_to_spec(self):
+        from horovod_tpu.fleet import ledger
+        assert ledger.next_state is lease_spec.next_state
+        assert ledger.resume_action is lease_spec.resume_action
+        assert ledger._check_transition is lease_spec.check_transition
+        assert ledger.CHAINS is lease_spec.CHAINS
+        assert ledger.TERMINAL_STATES is lease_spec.TERMINAL_STATES
+        assert ledger.LeaseStateError is lease_spec.LeaseStateError
+
+    def test_serving_migration_delegates_to_spec(self):
+        from horovod_tpu.serving import migration as serving_migration
+        assert serving_migration.chunk_pages \
+            is migration_spec.chunk_pages
+
+    def test_staging_offer_executes_spec_transition(self, monkeypatch):
+        """InboundStaging.offer is lock + clock around
+        migration_spec.stage_chunk — the spy proves the call goes
+        through the spec, parameters and return value intact."""
+        from horovod_tpu.serving import migration as serving_migration
+        calls = []
+        real = migration_spec.stage_chunk
+
+        def spy(entries, payload, **kw):
+            calls.append(dict(kw))
+            return real(entries, payload, **kw)
+
+        monkeypatch.setattr(migration_spec, "stage_chunk", spy)
+        staging = serving_migration.InboundStaging(max_staged=2,
+                                                   ttl_s=5.0)
+        record = staging.offer({
+            "mid": "m", "chunk": 0, "total": 1,
+            "pages": [{"payload": "x", "digest": "d"}],
+            "meta": {"id": "s"}, "commit": True})
+        assert calls and calls[0]["max_staged"] == 2
+        assert calls[0]["ttl_s"] == 5.0
+        assert record["id"] == "s"
+        assert [p["digest"] for p in record["pages"]] == ["d"]
+
+    def test_staging_limit_maps_to_staging_full(self):
+        from horovod_tpu.serving import migration as serving_migration
+        staging = serving_migration.InboundStaging(max_staged=1,
+                                                   ttl_s=900.0)
+        assert staging.offer({"mid": "a", "chunk": 0, "total": 2,
+                              "pages": []}) is None
+        with pytest.raises(serving_migration.StagingFull):
+            staging.offer({"mid": "b", "chunk": 0, "total": 2,
+                           "pages": []})
+
+    def test_pagepool_admission_is_the_spec_predicate(self,
+                                                      monkeypatch):
+        from horovod_tpu.serving import kv_cache
+        seen = []
+
+        def deny(free, need, watermark):
+            seen.append((free, need, watermark))
+            return False
+
+        monkeypatch.setattr(kv_cache, "admits", deny)
+        pool = kv_cache.PagePool(num_pages=8, page_size=4)
+        assert pool.can_admit(4) is False
+        with pytest.raises(kv_cache.NoHeadroom):
+            pool.alloc_admit(1)
+        assert len(seen) == 2
+        assert all(w == pool.watermark for _, _, w in seen)
+
+
+# ==========================================================================
+# Explorer semantics on hand-checkable toy models
+# ==========================================================================
+def _counter_model(limit, loop_at_end=False):
+    from horovod_tpu.analysis.protocol.model import Model
+
+    def init():
+        return {"n": 0, "x": False}
+
+    def actions(state):
+        acts = []
+        if state["n"] < limit:
+            def inc(s):
+                s["n"] += 1
+                return s
+            acts.append(Action("inc", "m", inc))
+
+        def mark(s):
+            s["x"] = True
+            return s
+        acts.append(Action("mark", "m", mark))
+        if loop_at_end and state["n"] == limit:
+            acts.append(Action("loop", "m", lambda s: s))
+        return acts
+
+    return Model("toy", init, actions)
+
+
+class TestExplorer:
+    def test_complete_exploration_counts(self):
+        # states: n in 0..3 x marked/unmarked = 8; every state has a
+        # mark edge, n<3 states have an inc edge.
+        result = explore(_counter_model(3))
+        assert result.ok
+        assert result.states == 8
+        assert result.depth == 4
+        assert result.edges == 8 + 6
+
+    def test_already_seen_successor_at_horizon_is_complete(self):
+        """The depth bound only trips on a genuinely NEW state past the
+        horizon; self-loops at the frontier must not mark the
+        exploration incomplete."""
+        result = explore(_counter_model(3, loop_at_end=True),
+                         max_depth=4)
+        assert result.complete
+
+    def test_depth_budget_is_a_finding(self):
+        result = explore(_counter_model(10), max_depth=3)
+        assert not result.complete
+        (v,) = [v for v in result.violations if v.kind == "budget"]
+        assert "depth bound 3" in v.message
+        model = _counter_model(10)
+        diag = violation_diagnostic(model, v)
+        assert diag.rule == "HVD703"
+        assert "--depth" in diag.hint
+
+    def test_state_budget_is_a_finding(self):
+        result = explore(_counter_model(100), max_states=5)
+        assert not result.complete
+        assert any("state bound 5" in v.message
+                   for v in result.violations)
+
+    def test_wall_clock_budget_is_a_finding(self):
+        result = explore(_counter_model(100), deadline_s=0.0)
+        assert not result.complete
+        assert any("wall clock" in v.message
+                   for v in result.violations)
+
+    def test_replay_follows_labels_and_rejects_disabled(self):
+        model = _counter_model(3)
+        states = replay(model, ["inc", "mark", "inc"])
+        assert states[-1] == {"n": 2, "x": True}
+        assert replay(model, ["inc", "zzz"]) is None
+        # replay never mutates earlier states in the list
+        assert states[0] == {"n": 0, "x": False}
+
+    def test_minimize_strips_irrelevant_steps(self):
+        model = _counter_model(3)
+        steps = [Step("mark", "m", False, "<f>", 0),
+                 Step("inc", "m", False, "<f>", 0),
+                 Step("mark", "m", False, "<f>", 0),
+                 Step("inc", "m", False, "<f>", 0)]
+        slim = minimize(model, steps, lambda s: s["n"] >= 2)
+        assert [s.label for s in slim] == ["inc", "inc"]
+
+    def test_safety_counterexample_is_minimized(self):
+        from horovod_tpu.analysis.protocol.model import Model
+        model = _counter_model(5)
+        model.invariants = [
+            ("n_bounded",
+             lambda s: "too big" if s["n"] >= 2 else None)]
+        result = explore(model)
+        (v,) = result.violations
+        assert v.kind == "safety" and v.name == "n_bounded"
+        assert labels_of(v) == ["inc", "inc"]   # no 'mark' noise
+        assert not result.ok
+
+    def test_liveness_judges_fair_edges_only(self):
+        """goal is reachable from the wedge VIA A FAULT, which must not
+        count: liveness asks whether the protocol gets there once the
+        faults stop."""
+        from horovod_tpu.analysis.protocol.model import Model
+
+        def init():
+            return {"at": "start"}
+
+        def actions(state):
+            acts = []
+            if state["at"] == "start":
+                def good(s):
+                    s["at"] = "goal"
+                    return s
+
+                def to_b(s):
+                    s["at"] = "b"
+                    return s
+                acts = [Action("good", "m", good),
+                        Action("to_b", "m", to_b, fault=True)]
+            elif state["at"] == "b":
+                def fault_out(s):
+                    s["at"] = "goal"
+                    return s
+                acts = [Action("fault_out", "m", fault_out,
+                               fault=True)]
+            return acts
+
+        model = Model("wedge", init, actions,
+                      liveness=[("reaches_goal",
+                                 lambda s: s["at"] == "goal")])
+        result = explore(model)
+        assert result.complete
+        (v,) = [v for v in result.violations if v.kind == "liveness"]
+        assert labels_of(v) == ["to_b"]
+        assert violation_diagnostic(model, v).rule == "HVD702"
+
+    def test_keep_going_collects_multiple_violations(self):
+        model = _counter_model(5)
+        model.invariants = [
+            ("n_bounded",
+             lambda s: "too big" if s["n"] >= 3 else None)]
+        first = explore(model, stop_on_first=True)
+        every = explore(model, stop_on_first=False)
+        assert len(first.violations) == 1
+        assert len(every.violations) > 1
+
+
+# ==========================================================================
+# Shipped models: full bounded space, zero counterexamples
+# ==========================================================================
+class TestShippedModels:
+    # Pinned space sizes: a silently-shrunk model (an action that
+    # stopped being enabled, a fault that stopped firing) would pass
+    # a bare ok-check while exploring nothing.
+    EXPECTED_STATES = {"ha": 36, "lease": (28, 22), "migration": 202}
+
+    @pytest.mark.parametrize("protocol", machines.PROTOCOLS)
+    def test_full_exploration_clean(self, protocol):
+        expected = self.EXPECTED_STATES[protocol]
+        if not isinstance(expected, tuple):
+            expected = (expected,)
+        models = machines.build(protocol)
+        assert len(models) == len(expected)
+        for model, want in zip(models, expected):
+            result = explore(model)
+            assert result.ok, (
+                model.name,
+                [dataclasses.asdict(v) for v in result.violations])
+            assert result.states == want, (
+                f"{model.name}: bounded space changed "
+                f"({result.states} states, expected {want}) — "
+                "intentional model change? update the pin")
+
+    def test_registry_is_exhaustive(self):
+        assert set(machines.BUGS) == set(machines.PROTOCOLS)
+        with pytest.raises(ValueError):
+            machines.build("nope")
+        with pytest.raises(ValueError):
+            machines.build("ha", bug="not_a_bug")
+
+
+# ==========================================================================
+# Mutation proof: every seeded bug yields a minimized counterexample
+# ==========================================================================
+class TestMutationProof:
+    def test_ha_skip_fence_is_split_brain(self):
+        (model,) = machines.build("ha", bug="skip_fence")
+        result = explore(model)
+        (v,) = result.violations
+        assert v.kind == "safety"
+        assert v.name == "single_writer_per_term"
+        # Minimized: crash the primary, promote the standby (term+1),
+        # resurrect the stale primary, let it write unfenced. No
+        # sync/extra writes survive minimization.
+        assert labels_of(v) == ["p1:crash", "standby:promote",
+                                "p1:restart", "p1:write"]
+
+    def test_lease_actuate_before_ledger_in_both_directions(self):
+        models = machines.build("lease", bug="actuate_before_ledger")
+        firsts = ("preempting", "draining")
+        for model, first in zip(models, firsts):
+            result = explore(model)
+            (v,) = result.violations
+            assert v.kind == "safety"
+            assert v.name == "effects_are_ledgered"
+            # The very first actuation is already unledgered — the
+            # crash isn't even needed to expose the window.
+            assert labels_of(v) == ["arbiter:open",
+                                    f"arbiter:actuate[{first}]"]
+
+    def test_migration_double_import_needs_the_dup_fault(self):
+        (model,) = machines.build("migration", bug="double_import")
+        result = explore(model)
+        (v,) = result.violations
+        assert v.kind == "safety"
+        assert v.name == "no_double_import"
+        assert labels_of(v) == [
+            "source:send", "source:send", "target:deliver[0]",
+            "net:dup[1]", "target:deliver[1]", "target:deliver[1]"]
+        # The counterexample genuinely requires the duplication fault.
+        assert [s.label for s in v.trace if s.fault] == ["net:dup[1]"]
+
+    def test_migration_skip_admit_trips_organically(self):
+        (model,) = machines.build("migration", bug="skip_admit")
+        result = explore(model)
+        (v,) = result.violations
+        assert v.kind == "safety"
+        assert v.name == "watermark_respected"
+        assert labels_of(v) == ["source:send", "source:send",
+                                "target:deliver[0]",
+                                "target:deliver[1]"]
+        # No fault needed: the bug admits past the reserve on the
+        # happy path.
+        assert not any(s.fault for s in v.trace)
+
+
+# ==========================================================================
+# Rendering: HVD70x diagnostics through the hvd-lint machinery
+# ==========================================================================
+class TestRendering:
+    def test_safety_diagnostic_anchors_at_the_spec(self):
+        (model,) = machines.build("migration", bug="double_import")
+        result = explore(model)
+        (diag,) = result_diagnostics(model, result)
+        assert diag.rule == "HVD701"
+        assert "no_double_import" in diag.message
+        # The location is the spec transition that lands in the bad
+        # state, not the model harness.
+        assert diag.file.endswith("migration_spec.py")
+        assert diag.line > 0
+        assert "hvd-model --protocol migration" in diag.hint
+
+    def test_trace_renders_through_the_simulator(self):
+        (model,) = machines.build("ha", bug="skip_fence")
+        result = explore(model)
+        (diag,) = result_diagnostics(model, result)
+        text = render_trace(diag)
+        assert "counterexample (cohort: ha)" in text
+        assert "rank p1:" in text
+        assert "rank standby:" in text
+        assert "[fault]" in text        # crash/restart marked
+        assert "standby:promote" in text
+
+    def test_budget_diagnostic_has_no_trace(self):
+        model = machines.build("migration")[0]
+        result = explore(model, max_states=5)
+        diags = result_diagnostics(model, result)
+        assert [d.rule for d in diags] == ["HVD703"]
+        assert diags[0].trace is None
+        assert render_trace(diags[0]) == ""
+
+
+# ==========================================================================
+# CLI: exit codes, formats, SARIF structure
+# ==========================================================================
+class TestCli:
+    def test_list(self, capsys):
+        assert model_cli.main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for proto in machines.PROTOCOLS:
+            assert f"{proto}:" in out
+        assert "skip_fence" in out and "double_import" in out
+
+    def test_clean_sweep_exits_zero(self, capsys):
+        assert model_cli.main(["--protocol", "all"]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s) across 4 model(s)" in out
+        assert out.count("complete") == 4
+        assert "INCOMPLETE" not in out
+
+    def test_seeded_bug_exits_one_with_counterexample(self, capsys):
+        rc = model_cli.main(["--protocol", "migration",
+                             "--seed-bug", "double_import"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "HVD701" in out
+        assert "counterexample (cohort: migration)" in out
+        assert "[seeded bug: double_import]" in out
+
+    def test_seed_bug_requires_single_protocol(self, capsys):
+        assert model_cli.main(["--seed-bug", "skip_fence"]) == 2
+        assert "single --protocol" in capsys.readouterr().err
+
+    def test_unknown_bug_is_usage_error(self, capsys):
+        rc = model_cli.main(["--protocol", "ha", "--seed-bug", "zzz"])
+        assert rc == 2
+        assert "no seeded bug" in capsys.readouterr().err
+
+    def test_budget_overrun_fails_at_default_severity(self, capsys):
+        rc = model_cli.main(["--protocol", "migration",
+                             "--max-states", "5"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "HVD703" in out and "INCOMPLETE" in out
+
+    def test_fail_on_never_reports_but_exits_zero(self, capsys):
+        rc = model_cli.main(["--protocol", "ha",
+                             "--seed-bug", "skip_fence",
+                             "--fail-on", "never"])
+        assert rc == 0
+        assert "HVD701" in capsys.readouterr().out
+
+    def test_sarif_has_tool_name_and_code_flows(self, capsys):
+        rc = model_cli.main(["--protocol", "lease",
+                             "--seed-bug", "actuate_before_ledger",
+                             "--format", "sarif"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "hvd-model"
+        results = run["results"]
+        assert len(results) == 2    # one per lease direction
+        assert {r["ruleId"] for r in results} == {"HVD701"}
+        for r in results:
+            flows = r["codeFlows"][0]["threadFlows"]
+            assert flows, "counterexample lost on the SARIF path"
+
+    def test_json_format_round_trips(self, capsys):
+        rc = model_cli.main(["--protocol", "ha",
+                             "--seed-bug", "skip_fence",
+                             "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert [d["rule"] for d in payload] == ["HVD701"]
+        assert "single_writer_per_term" in payload[0]["message"]

@@ -159,6 +159,34 @@ class TestLockOrder:
         with cond:
             assert cond.wait(timeout=0.01) is False
 
+    def test_transitive_cycle_through_intermediate(self, sanitize_on):
+        """A->B and B->C recorded separately; C->A closes the cycle
+        only through reachability (no direct A->C edge exists), and the
+        report still names a recorded edge on the offending path."""
+        A = sanitizer.make_lock("tri.A")
+        B = sanitizer.make_lock("tri.B")
+        C = sanitizer.make_lock("tri.C")
+        with A:
+            with B:
+                pass
+        with B:
+            with C:
+                pass
+        with pytest.raises(LockOrderError) as err:
+            with C:
+                with A:
+                    pass
+        msg = str(err.value)
+        assert "acquiring 'tri.A' while holding 'tri.C'" in msg
+        assert "first recorded 'tri.A' -> 'tri.B'" in msg
+        # And neither recorded order was poisoned by the offender.
+        with A:
+            with B:
+                pass
+        with B:
+            with C:
+                pass
+
 
 # ==========================================================================
 # Runtime layer: blocking-call tripwire + thread-leak audit
@@ -235,6 +263,39 @@ class TestTripwire:
         finally:
             release.set()
             leak.join(5)
+
+    def test_findings_dedupe_per_call_site(self, sanitize_on):
+        """A blocking call inside a ms-cadence loop must yield ONE
+        finding, not one multi-KB stack per cycle for hours."""
+        def body():
+            for _ in range(5):
+                sanitizer.check_blocking("urlopen", "http://kv/x")
+        self._run_on_fake_cycle_thread(body)
+        assert len(sanitizer.findings()) == 1
+
+    def test_finding_format_names_kind_call_and_thread(self, sanitize_on):
+        def body():
+            sanitizer.check_blocking("Handle.wait", "grad.7")
+        self._run_on_fake_cycle_thread(body)
+        (finding,) = sanitizer.findings()
+        text = finding.format()
+        assert "hvd-sanitize [blocking-call]" in text
+        assert "Handle.wait(grad.7)" in text
+        assert "fake-cycle" in text
+        assert finding.stack  # the acquisition stack rode along
+
+    def test_allowed_scopes_nest(self, sanitize_on):
+        """allowed() is depth-counted: leaving an inner scope must not
+        re-arm the tripwire while the outer scope is still open."""
+        def body():
+            with sanitizer.allowed("outer"):
+                with sanitizer.allowed("inner"):
+                    sanitizer.check_blocking("urlopen", "http://kv/a")
+                sanitizer.check_blocking("urlopen", "http://kv/b")
+            sanitizer.check_blocking("urlopen", "http://kv/c")
+        self._run_on_fake_cycle_thread(body)
+        assert [f.what for f in sanitizer.findings()] == \
+            ["urlopen(http://kv/c)"]
 
     def test_daemon_threads_pass_the_audit(self, sanitize_on):
         release = threading.Event()
@@ -470,6 +531,19 @@ def test_cli_knobs_md_implies_check(tmp_path):
     proc = _run_cli("--knobs-md", str(tmp_path / "missing.md"))
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "cannot read knob docs" in proc.stdout
+
+
+def test_cli_check_metrics_only():
+    proc = _run_cli("--check-metrics")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_metrics_md_implies_check(tmp_path):
+    """--metrics-md PATH without --check-metrics must still validate
+    the named file; an unreadable explicit path is a finding."""
+    proc = _run_cli("--metrics-md", str(tmp_path / "missing.md"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "cannot read metric docs" in proc.stdout
 
 
 def test_cli_detects_hvd3xx_in_fixtures():
